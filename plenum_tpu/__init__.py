@@ -1,0 +1,24 @@
+"""plenum_tpu — a TPU-native BFT-consensus ledger framework.
+
+A from-scratch redesign of the capability set of hyperledger indy-plenum
+(RBFT consensus, Merkle-tree ledgers, MPT state, Ed25519 client auth, BLS
+multi-signatures) with the crypto hot path — batched Ed25519 verification,
+BLS aggregation/verification, and vectorized SHA-256 Merkle appends —
+offloaded to TPU through JAX/XLA/Pallas behind provider seams.
+
+Layering (see SURVEY.md §1 for the reference's layer map):
+
+    storage/   key-value storage abstraction              (ref: storage/)
+    ledger/    append-only Merkle transaction log         (ref: ledger/)
+    state/     Merkle Patricia Trie with proofs           (ref: state/)
+    network/   transport: sim network + TCP stacks        (ref: stp_zmq/)
+    common/    messages, buses, timer, quorums, config    (ref: plenum/common/)
+    crypto/    Ed25519 / BLS / hashing provider seams     (ref: crypto/, stp_core/crypto/)
+    ops/       JAX/Pallas device kernels (the TPU plane)  (new: tpu-native)
+    parallel/  device mesh & sharding of the crypto plane (new: tpu-native)
+    consensus/ ordering/checkpoint/view-change services   (ref: plenum/server/consensus/)
+    server/    node orchestration + execution layer       (ref: plenum/server/)
+    client/    wallet & client                            (ref: plenum/client/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,159 @@
+"""Schema-validated wire messages.
+
+Reference behavior: plenum/common/messages/message_base.py:80 (MessageBase —
+schema-validated, hashable, serializable dicts discriminated by an `op` field)
+and messages/fields.py (per-field validators applied at ingress,
+node.py validateNodeMsg:1479). Here messages are frozen dataclasses registered
+by op name; `from_dict` validates types/ranges before constructing, so malformed
+traffic is rejected at the edge exactly like the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, fields as dc_fields
+from typing import Any, ClassVar, Optional, get_args, get_origin, Union
+
+
+class MessageValidationError(ValueError):
+    pass
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def message_registry() -> dict[str, type]:
+    return dict(_REGISTRY)
+
+
+def wire_message(cls):
+    """Class decorator: freeze, register under cls.typename."""
+    cls = dataclass(frozen=True, eq=True)(cls)
+    op = getattr(cls, "typename", None)
+    if op:
+        if op in _REGISTRY:
+            raise RuntimeError(f"duplicate message op {op!r}")
+        _REGISTRY[op] = cls
+    return cls
+
+
+def _check_type(name: str, value: Any, annot: Any) -> Any:
+    origin = get_origin(annot)
+    if annot is Any or annot is None:
+        return value
+    if origin is Union:
+        errors = []
+        for arm in get_args(annot):
+            if arm is type(None):
+                if value is None:
+                    return None
+                continue
+            try:
+                return _check_type(name, value, arm)
+            except MessageValidationError as e:
+                errors.append(str(e))
+        raise MessageValidationError(f"{name}: no union arm matched ({errors})")
+    if origin in (list, tuple):
+        if not isinstance(value, (list, tuple)):
+            raise MessageValidationError(f"{name}: expected list, got {type(value).__name__}")
+        args = get_args(annot)
+        if origin is list and args:
+            return tuple(_check_type(f"{name}[]", v, args[0]) for v in value)
+        if origin is tuple and args:
+            if len(args) == 2 and args[1] is Ellipsis:
+                return tuple(_check_type(f"{name}[]", v, args[0]) for v in value)
+            if len(args) != len(value):
+                raise MessageValidationError(f"{name}: expected {len(args)}-tuple")
+            return tuple(_check_type(f"{name}[{i}]", v, a) for i, (v, a) in enumerate(zip(value, args)))
+        return tuple(value)
+    if origin is dict:
+        if not isinstance(value, dict):
+            raise MessageValidationError(f"{name}: expected dict, got {type(value).__name__}")
+        return value
+    if isinstance(annot, type):
+        if annot is tuple and isinstance(value, (list, tuple)):
+            # msgpack/JSON decode tuples as lists; bare `tuple` annotation
+            # accepts any sequence shape (deep-frozen for hashability).
+            return _freeze_seq(value)
+        if annot is float and isinstance(value, int) and not isinstance(value, bool):
+            return float(value)
+        if annot is int and isinstance(value, bool):
+            raise MessageValidationError(f"{name}: expected int, got bool")
+        if not isinstance(value, annot):
+            raise MessageValidationError(
+                f"{name}: expected {annot.__name__}, got {type(value).__name__}")
+    return value
+
+
+def _freeze_seq(value):
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze_seq(v) for v in value)
+    return value
+
+
+class MessageBase:
+    """Mixin API shared by all wire messages (dataclasses add the fields)."""
+
+    typename: ClassVar[str] = ""
+
+    def to_dict(self) -> dict:
+        d = {"op": self.typename}
+        for f in dc_fields(self):
+            d[f.name] = _plainify(getattr(self, f.name))
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MessageBase":
+        kwargs = {}
+        known = {f.name: f for f in dc_fields(cls)}
+        for name, f in known.items():
+            if name in d:
+                kwargs[name] = _check_type(f"{cls.typename}.{name}", d[name],
+                                           _resolve(cls, f))
+            elif f.default is dataclasses.MISSING and f.default_factory is dataclasses.MISSING:
+                raise MessageValidationError(f"{cls.typename}: missing field {name!r}")
+        extra = set(d) - set(known) - {"op"}
+        if extra:
+            raise MessageValidationError(f"{cls.typename}: unknown fields {sorted(extra)}")
+        obj = cls(**kwargs)
+        obj.validate()
+        return obj
+
+    def validate(self) -> None:
+        """Hook for per-message semantic checks (non-negative seqnos etc.)."""
+
+    def _require(self, cond: bool, why: str) -> None:
+        if not cond:
+            raise MessageValidationError(f"{self.typename}: {why}")
+
+
+_TYPE_CACHE: dict[tuple, Any] = {}
+
+
+def _resolve(cls, f):
+    key = (cls, f.name)
+    if key not in _TYPE_CACHE:
+        import typing
+        hints = typing.get_type_hints(cls)
+        for n, t in hints.items():
+            _TYPE_CACHE[(cls, n)] = t
+    return _TYPE_CACHE.get(key, Any)
+
+
+def _plainify(v: Any) -> Any:
+    if isinstance(v, MessageBase):
+        return v.to_dict()
+    if isinstance(v, (list, tuple)):
+        return [_plainify(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _plainify(x) for k, x in v.items()}
+    return v
+
+
+def message_from_dict(d: dict) -> MessageBase:
+    if not isinstance(d, dict) or "op" not in d:
+        raise MessageValidationError(f"not a message: {d!r:.100}")
+    op = d["op"]
+    cls = _REGISTRY.get(op)
+    if cls is None:
+        raise MessageValidationError(f"unknown message op {op!r}")
+    return cls.from_dict(d)

@@ -1,0 +1,259 @@
+"""The node-to-node and node-to-client wire protocol.
+
+Reference behavior: plenum/common/messages/node_messages.py — ~40 typed messages
+discriminated by `op`. Field names here are snake_case but carry the same
+content: 3PC messages are keyed by (inst_id, view_no, pp_seq_no); COMMIT carries
+the sender's BLS signature over the state root (ref :205-209); PRE-PREPARE
+carries the previous batch's aggregated multi-sig (ref :118).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .message_base import MessageBase, wire_message
+
+# Ledger ids (ref plenum/server/node.py:142 — catchup order audit, pool, config, domain)
+AUDIT_LEDGER_ID = 3
+POOL_LEDGER_ID = 0
+CONFIG_LEDGER_ID = 2
+DOMAIN_LEDGER_ID = 1
+VALID_LEDGER_IDS = (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID, AUDIT_LEDGER_ID)
+
+
+class ThreePhaseMsg(MessageBase):
+    """Common shape of PRE-PREPARE / PREPARE / COMMIT."""
+    def validate(self) -> None:
+        self._require(self.inst_id >= 0, "inst_id must be >= 0")
+        self._require(self.view_no >= 0, "view_no must be >= 0")
+        self._require(self.pp_seq_no >= 1, "pp_seq_no must be >= 1")
+
+
+@wire_message
+class PrePrepare(ThreePhaseMsg):
+    typename = "PREPREPARE"
+    inst_id: int
+    view_no: int
+    pp_seq_no: int
+    pp_time: float
+    req_idr: tuple[str, ...]          # digests of requests in this batch
+    discarded: tuple[str, ...]        # digests rejected during dynamic validation
+    digest: str                       # batch digest
+    ledger_id: int
+    state_root: str                   # uncommitted state root AFTER applying batch
+    txn_root: str                     # uncommitted txn-ledger root AFTER applying batch
+    pool_state_root: str = ""
+    audit_txn_root: str = ""
+    bls_multi_sig: Optional[tuple] = None   # prev batch's aggregated sig (ref bls update_pre_prepare)
+    original_view_no: Optional[int] = None  # set when re-ordered after view change
+
+
+@wire_message
+class Prepare(ThreePhaseMsg):
+    typename = "PREPARE"
+    inst_id: int
+    view_no: int
+    pp_seq_no: int
+    pp_time: float
+    digest: str
+    state_root: str
+    txn_root: str
+    audit_txn_root: str = ""
+
+
+@wire_message
+class Commit(ThreePhaseMsg):
+    typename = "COMMIT"
+    inst_id: int
+    view_no: int
+    pp_seq_no: int
+    bls_sig: Optional[str] = None     # sender's BLS sig over the state root (ref :205)
+    bls_sigs: Optional[dict] = None   # per-ledger sigs (multi-sig-per-ledger mode)
+
+
+@wire_message
+class Checkpoint(MessageBase):
+    typename = "CHECKPOINT"
+    inst_id: int
+    view_no: int
+    seq_no_start: int
+    seq_no_end: int
+    digest: str                       # audit-ledger root at seq_no_end (ref checkpoint_service.py:147)
+
+    def validate(self) -> None:
+        self._require(self.seq_no_end >= self.seq_no_start >= 0, "bad checkpoint range")
+
+
+@wire_message
+class InstanceChange(MessageBase):
+    typename = "INSTANCE_CHANGE"
+    view_no: int                      # proposed view
+    reason: int                       # suspicion code
+
+
+@wire_message
+class ViewChange(MessageBase):
+    typename = "VIEW_CHANGE"
+    view_no: int
+    stable_checkpoint: int
+    prepared: tuple[tuple[int, int, str], ...]     # (orig_view_no, pp_seq_no, digest)
+    preprepared: tuple[tuple[int, int, str], ...]
+    checkpoints: tuple[tuple[int, int, int, str], ...]  # Checkpoint tuples (view,start,end,digest)
+
+
+@wire_message
+class ViewChangeAck(MessageBase):
+    typename = "VIEW_CHANGE_ACK"
+    view_no: int
+    name: str                         # author of the ViewChange being acked
+    digest: str
+
+
+@wire_message
+class NewView(MessageBase):
+    typename = "NEW_VIEW"
+    view_no: int
+    view_changes: tuple[tuple[str, str], ...]      # (author, vc digest)
+    checkpoint: tuple[int, int, int, str]          # selected stable checkpoint
+    batches: tuple[tuple[int, int, str], ...]      # (orig_view_no, pp_seq_no, digest) to re-order
+
+
+@wire_message
+class Ordered(MessageBase):
+    """Replica → node: a batch reached commit quorum (internal but serializable)."""
+    typename = "ORDERED"
+    inst_id: int
+    view_no: int
+    pp_seq_no: int
+    pp_time: float
+    req_idr: tuple[str, ...]
+    discarded: tuple[str, ...]
+    ledger_id: int
+    state_root: str
+    txn_root: str
+    audit_txn_root: str = ""
+    original_view_no: Optional[int] = None
+
+
+@wire_message
+class Propagate(MessageBase):
+    typename = "PROPAGATE"
+    request: dict                     # full client request dict
+    sender_client: Optional[str] = None
+
+
+@wire_message
+class LedgerStatus(MessageBase):
+    typename = "LEDGER_STATUS"
+    ledger_id: int
+    txn_seq_no: int
+    merkle_root: str
+    view_no: Optional[int] = None
+    pp_seq_no: Optional[int] = None
+
+
+@wire_message
+class ConsistencyProof(MessageBase):
+    typename = "CONSISTENCY_PROOF"
+    ledger_id: int
+    seq_no_start: int
+    seq_no_end: int
+    view_no: int
+    pp_seq_no: int
+    old_merkle_root: str
+    new_merkle_root: str
+    hashes: tuple[str, ...]
+
+
+@wire_message
+class CatchupReq(MessageBase):
+    typename = "CATCHUP_REQ"
+    ledger_id: int
+    seq_no_start: int
+    seq_no_end: int
+    catchup_till: int
+
+
+@wire_message
+class CatchupRep(MessageBase):
+    typename = "CATCHUP_REP"
+    ledger_id: int
+    txns: dict                        # seq_no(str) -> txn dict
+    cons_proof: tuple[str, ...]
+
+
+@wire_message
+class MessageReq(MessageBase):
+    typename = "MESSAGE_REQUEST"
+    msg_type: str
+    params: dict
+
+
+@wire_message
+class MessageRep(MessageBase):
+    typename = "MESSAGE_RESPONSE"
+    msg_type: str
+    params: dict
+    msg: Optional[dict] = None
+
+
+@wire_message
+class RequestAck(MessageBase):
+    typename = "REQACK"
+    identifier: str
+    req_id: int
+
+
+@wire_message
+class RequestNack(MessageBase):
+    typename = "REQNACK"
+    identifier: str
+    req_id: int
+    reason: str
+
+
+@wire_message
+class Reject(MessageBase):
+    typename = "REJECT"
+    identifier: str
+    req_id: int
+    reason: str
+
+
+@wire_message
+class Reply(MessageBase):
+    typename = "REPLY"
+    result: dict                      # committed txn incl. seq_no, merkle proof
+
+
+@wire_message
+class Batch(MessageBase):
+    """Transport-level coalescing of several messages (ref common/batched.py)."""
+    typename = "BATCH"
+    messages: tuple[dict, ...]
+
+
+@wire_message
+class BatchCommitted(MessageBase):
+    """Observer push of a committed batch (ref node_messages.py:496)."""
+    typename = "BATCH_COMMITTED"
+    requests: tuple[dict, ...]
+    ledger_id: int
+    inst_id: int
+    view_no: int
+    pp_seq_no: int
+    pp_time: float
+    state_root: str
+    txn_root: str
+    seq_no_start: int
+    seq_no_end: int
+
+
+@wire_message
+class ObservedData(MessageBase):
+    typename = "OBSERVED_DATA"
+    msg_type: str
+    msg: dict
+
+
+def three_pc_key(msg) -> tuple[int, int]:
+    return (msg.view_no, msg.pp_seq_no)

@@ -1,0 +1,103 @@
+"""Client request.
+
+Reference behavior: plenum/common/request.py:13 — a request's `digest` is the
+sha256 of the canonical-JSON-serialized signed payload *including* signature;
+`payload_digest` excludes the signature, so two differently-signed copies of the
+same operation share a payload_digest (used for dedup / seq-no mapping).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Optional
+
+from .serialization import signing_serialize
+
+
+class Request:
+    def __init__(self,
+                 identifier: str,
+                 req_id: int,
+                 operation: dict,
+                 signature: Optional[str] = None,
+                 signatures: Optional[dict] = None,  # multi-sig endorsements: idr -> sig
+                 protocol_version: int = 2,
+                 taa_acceptance: Optional[dict] = None,
+                 endorser: Optional[str] = None):
+        self.identifier = identifier
+        self.req_id = req_id
+        self.operation = operation
+        self.signature = signature
+        self.signatures = signatures
+        self.protocol_version = protocol_version
+        self.taa_acceptance = taa_acceptance
+        self.endorser = endorser
+
+    # --- serialization ---------------------------------------------------
+
+    def signing_payload(self) -> dict:
+        d = {"identifier": self.identifier,
+             "reqId": self.req_id,
+             "operation": self.operation,
+             "protocolVersion": self.protocol_version}
+        if self.taa_acceptance is not None:
+            d["taaAcceptance"] = self.taa_acceptance
+        if self.endorser is not None:
+            d["endorser"] = self.endorser
+        return d
+
+    def signing_bytes(self) -> bytes:
+        return signing_serialize(self.signing_payload())
+
+    def to_dict(self) -> dict:
+        d = self.signing_payload()
+        if self.signature is not None:
+            d["signature"] = self.signature
+        if self.signatures is not None:
+            d["signatures"] = self.signatures
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Request":
+        return cls(identifier=d["identifier"],
+                   req_id=d["reqId"],
+                   operation=d["operation"],
+                   signature=d.get("signature"),
+                   signatures=d.get("signatures"),
+                   protocol_version=d.get("protocolVersion", 2),
+                   taa_acceptance=d.get("taaAcceptance"),
+                   endorser=d.get("endorser"))
+
+    # --- digests (ref request.py:87,90) ----------------------------------
+
+    @property
+    def digest(self) -> str:
+        return hashlib.sha256(signing_serialize(self.to_dict())).hexdigest()
+
+    @property
+    def payload_digest(self) -> str:
+        return hashlib.sha256(self.signing_bytes()).hexdigest()
+
+    @property
+    def key(self) -> str:
+        return self.digest
+
+    @property
+    def txn_type(self) -> Optional[str]:
+        return self.operation.get("type")
+
+    def all_signatures(self) -> dict:
+        """idr -> signature for every signer (single or multi-sig endorsement)."""
+        if self.signatures:
+            return dict(self.signatures)
+        if self.signature:
+            return {self.identifier: self.signature}
+        return {}
+
+    def __eq__(self, other):
+        return isinstance(other, Request) and self.to_dict() == other.to_dict()
+
+    def __hash__(self):
+        return hash(self.digest)
+
+    def __repr__(self):
+        return f"Request({self.identifier}, {self.req_id}, {self.txn_type})"

@@ -1,0 +1,45 @@
+"""Canonical serializers.
+
+Reference behavior: plenum/common/serializers/serialization.py — msgpack for the
+ledger/txn log and the wire, canonical JSON (sorted keys, no whitespace) for
+anything that gets signed, so signatures are reproducible across nodes.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import msgpack
+
+
+def pack(obj: Any) -> bytes:
+    """Binary wire/ledger serialization (msgpack, deterministic map order)."""
+    return msgpack.packb(_sort_maps(obj), use_bin_type=True)
+
+
+def unpack(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+def _sort_maps(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: _sort_maps(obj[k]) for k in sorted(obj)}
+    if isinstance(obj, (list, tuple)):
+        return [_sort_maps(v) for v in obj]
+    return obj
+
+
+def signing_serialize(obj: Any) -> bytes:
+    """Canonical JSON used as the message over which signatures are computed."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      ensure_ascii=False).encode()
+
+
+def json_dumps(obj: Any) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def json_loads(data) -> Any:
+    if isinstance(data, (bytes, bytearray)):
+        data = data.decode()
+    return json.loads(data)

@@ -1,0 +1,88 @@
+"""Layered configuration.
+
+Reference behavior: plenum/config.py (module-level tunables) merged by
+common/config_util.py:getConfig with /etc + network + user overrides. Here the
+defaults live on a dataclass; `load_config` layers dict overrides on top, and
+strategy classes remain injectable by reference (SURVEY.md §5 config system).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class Config:
+    # --- 3PC batching (ref plenum/config.py:256-258) ---
+    Max3PCBatchSize: int = 1000
+    Max3PCBatchWait: float = 0.1        # ref default 3s; we run a faster loop
+    Max3PCBatchesInFlight: int = 4
+
+    # --- checkpoints / watermarks (ref config.py:273-276) ---
+    CHK_FREQ: int = 100
+    LOG_SIZE: int = 300
+
+    # --- client timeouts (ref config.py:278-279) ---
+    CLIENT_REQACK_TIMEOUT: float = 5.0
+    CLIENT_REPLY_TIMEOUT: float = 15.0
+
+    # --- monitor / RBFT degradation (ref config.py:140-154) ---
+    DELTA: float = 0.1                  # master throughput ratio floor
+    LAMBDA: float = 240.0               # window for degradation checks
+    OMEGA: float = 20.0                 # latency excess threshold
+    PerfCheckFreq: float = 10.0
+    throughput_averaging_strategy: str = "ema"
+    throughput_first_ts_window: float = 15.0
+
+    # --- receive quotas (ref config.py:250-251) ---
+    LISTENER_MESSAGE_QUOTA: int = 100
+    REMOTES_MESSAGE_QUOTA: int = 100
+
+    # --- view change (ref config.py:294-295) ---
+    VIEW_CHANGE_TIMEOUT: float = 60.0
+    NEW_VIEW_TIMEOUT: float = 30.0
+    INSTANCE_CHANGE_TIMEOUT: float = 120.0
+
+    # --- freshness (ref config.py:263) ---
+    STATE_FRESHNESS_UPDATE_INTERVAL: float = 300.0
+
+    # --- catchup (ref config.py:297) ---
+    CATCHUP_BATCH_SIZE: int = 5
+    CatchupTransactionsTimeout: float = 6.0
+    ConsistencyProofsTimeout: float = 5.0
+
+    # --- propagation ---
+    PROPAGATE_REQUEST_DELAY: float = 0.0
+
+    # --- crypto backend seam: 'cpu' or 'jax' (the north star switch) ---
+    crypto_backend: str = "cpu"
+    # Pad/flush knobs of the device batch plane (plenum_tpu/crypto/batch_plane.py)
+    CRYPTO_BATCH_MAX: int = 4096
+    CRYPTO_BATCH_PAD_POW2: bool = True
+
+    # --- storage ---
+    kv_backend: str = "memory"          # 'memory' | 'file'
+
+    # --- misc ---
+    METRICS_FLUSH_INTERVAL: float = 60.0
+    ACCEPTABLE_DEVIATION_PREPREPARE_SECS: float = 600.0
+    TRACK_UNORDERED: bool = True
+    OUTDATED_REQS_CHECK_INTERVAL: float = 60.0
+
+    def replace(self, **overrides) -> "Config":
+        return dataclasses.replace(self, **overrides)
+
+
+def load_config(*override_layers: Optional[dict]) -> Config:
+    """Defaults overlaid with dict layers (install < network < user), mirroring
+    the reference's getConfig merge order."""
+    merged: dict[str, Any] = {}
+    for layer in override_layers:
+        if layer:
+            merged.update(layer)
+    known = {f.name for f in dataclasses.fields(Config)}
+    unknown = set(merged) - known
+    if unknown:
+        raise KeyError(f"unknown config keys: {sorted(unknown)}")
+    return Config(**merged)

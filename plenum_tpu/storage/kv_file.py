@@ -1,0 +1,87 @@
+"""Durable file-backed KV store.
+
+Append-only log of (op, key, value) records with an in-memory index, compacted
+on close. Fills the role of the reference's LevelDB/RocksDB backends
+(storage/kv_store_leveldb.py:14, kv_store_rocksdb.py:15) for crash-resume
+without native DB deps; a C++ LSM backend can slot in behind the same ABC.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+from .kv_store import KeyValueStorage, encode_key
+from .kv_memory import KvMemory
+
+_PUT, _DEL = 0, 1
+_HDR = struct.Struct(">BII")  # op, key_len, value_len
+
+
+class KvFile(KeyValueStorage):
+    def __init__(self, path: str, name: str = "kv"):
+        os.makedirs(path, exist_ok=True)
+        self._file_path = os.path.join(path, name + ".kvlog")
+        self._mem = KvMemory()
+        self._fh = None
+        self._replay()
+        self._fh = open(self._file_path, "ab")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self._file_path):
+            return
+        with open(self._file_path, "rb") as fh:
+            data = fh.read()
+        off, n = 0, len(data)
+        while off + _HDR.size <= n:
+            op, klen, vlen = _HDR.unpack_from(data, off)
+            if off + _HDR.size + klen + vlen > n:   # torn tail write
+                break
+            off += _HDR.size
+            key = data[off:off + klen]; off += klen
+            val = data[off:off + vlen]; off += vlen
+            if op == _PUT:
+                self._mem.put(key, val)
+            else:
+                self._mem.remove(key)
+        if off < n:
+            # Drop the torn record so appended records aren't misparsed by the
+            # next replay.
+            with open(self._file_path, "r+b") as fh:
+                fh.truncate(off)
+
+    def _append(self, op: int, key: bytes, value: bytes = b"") -> None:
+        self._fh.write(_HDR.pack(op, len(key), len(value)) + key + value)
+        self._fh.flush()
+
+    def put(self, key, value: bytes) -> None:
+        k = encode_key(key)
+        self._append(_PUT, k, bytes(value))
+        self._mem.put(k, value)
+
+    def get(self, key) -> bytes:
+        return self._mem.get(key)
+
+    def remove(self, key) -> None:
+        k = encode_key(key)
+        self._append(_DEL, k)
+        self._mem.remove(k)
+
+    def iterator(self, start=None, end=None, include_value: bool = True) -> Iterator:
+        return self._mem.iterator(start, end, include_value)
+
+    def close(self) -> None:
+        if self._fh is None:
+            return
+        self._fh.close()
+        self._fh = None
+        # Compact: rewrite only live records.
+        tmp = self._file_path + ".compact"
+        with open(tmp, "wb") as fh:
+            for k, v in self._mem.iterator():
+                fh.write(_HDR.pack(_PUT, len(k), len(v)) + k + v)
+        os.replace(tmp, self._file_path)
+
+    @property
+    def size(self) -> int:
+        return self._mem.size

@@ -1,0 +1,48 @@
+"""In-memory KV store (ref storage/kv_in_memory.py) backed by a sorted dict."""
+from __future__ import annotations
+
+from bisect import bisect_left, insort
+from typing import Iterator, Optional
+
+from .kv_store import KeyValueStorage, encode_key
+
+
+class KvMemory(KeyValueStorage):
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []
+
+    def put(self, key, value: bytes) -> None:
+        k = encode_key(key)
+        if k not in self._data:
+            insort(self._keys, k)
+        self._data[k] = bytes(value)
+
+    def get(self, key) -> bytes:
+        k = encode_key(key)
+        if k not in self._data:
+            raise KeyError(key)
+        return self._data[k]
+
+    def remove(self, key) -> None:
+        k = encode_key(key)
+        if k in self._data:
+            del self._data[k]
+            i = bisect_left(self._keys, k)
+            if i < len(self._keys) and self._keys[i] == k:
+                self._keys.pop(i)
+
+    def iterator(self, start=None, end=None, include_value: bool = True) -> Iterator:
+        lo = 0 if start is None else bisect_left(self._keys, encode_key(start))
+        for i in range(lo, len(self._keys)):
+            k = self._keys[i]
+            if end is not None and k > encode_key(end):
+                return
+            yield (k, self._data[k]) if include_value else k
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def size(self) -> int:
+        return len(self._data)

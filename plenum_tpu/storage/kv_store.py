@@ -1,0 +1,65 @@
+"""Key-value storage abstraction.
+
+Reference behavior: storage/kv_store.py:5 — KeyValueStorage ABC with
+put/get/remove/iterator/do_ops_in_batch over LevelDB/RocksDB/memory/file
+backends. Keys and values are bytes; int keys are encoded big-endian so
+lexicographic iteration equals numeric order (ref kv_store_leveldb_int_keys.py).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Iterable, Iterator, Optional, Tuple
+
+
+def encode_key(key) -> bytes:
+    if isinstance(key, bytes):
+        return key
+    if isinstance(key, str):
+        return key.encode()
+    if isinstance(key, int):
+        return key.to_bytes(8, "big")
+    raise TypeError(f"unsupported key type {type(key)}")
+
+
+def decode_int_key(key: bytes) -> int:
+    return int.from_bytes(key, "big")
+
+
+class KeyValueStorage(ABC):
+    @abstractmethod
+    def put(self, key, value: bytes) -> None: ...
+
+    @abstractmethod
+    def get(self, key) -> bytes: ...   # raises KeyError if absent
+
+    @abstractmethod
+    def remove(self, key) -> None: ...
+
+    @abstractmethod
+    def iterator(self, start=None, end=None, include_value: bool = True) -> Iterator: ...
+
+    @abstractmethod
+    def close(self) -> None: ...
+
+    def try_get(self, key) -> Optional[bytes]:
+        try:
+            return self.get(key)
+        except KeyError:
+            return None
+
+    def has_key(self, key) -> bool:
+        return self.try_get(key) is not None
+
+    def do_ops_in_batch(self, batch: Iterable[Tuple[str, object, bytes]]) -> None:
+        """batch of ('put'|'remove', key, value) applied atomically-enough."""
+        for op, key, value in batch:
+            if op == "put":
+                self.put(key, value)
+            elif op == "remove":
+                self.remove(key)
+            else:
+                raise ValueError(f"unknown op {op}")
+
+    @property
+    @abstractmethod
+    def size(self) -> int: ...

@@ -1,0 +1,20 @@
+"""Test configuration.
+
+Per the build contract: tests run JAX on CPU with 8 virtual devices so
+multi-chip sharding is exercised without TPU hardware. Env must be set before
+jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tdir(tmp_path):
+    return str(tmp_path)
