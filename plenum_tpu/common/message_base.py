@@ -28,6 +28,9 @@ def message_registry() -> dict[str, type]:
 def wire_message(cls):
     """Class decorator: freeze, register under cls.typename."""
     cls = dataclass(frozen=True, eq=True)(cls)
+    # dataclass-generated __hash__ breaks on dict-typed fields; hash the
+    # canonical serialization instead so every message is usable in sets/keys.
+    cls.__hash__ = MessageBase._canonical_hash
     op = getattr(cls, "typename", None)
     if op:
         if op in _REGISTRY:
@@ -65,9 +68,13 @@ def _check_type(name: str, value: Any, annot: Any) -> Any:
                 raise MessageValidationError(f"{name}: expected {len(args)}-tuple")
             return tuple(_check_type(f"{name}[{i}]", v, a) for i, (v, a) in enumerate(zip(value, args)))
         return tuple(value)
-    if origin is dict:
+    if origin is dict or annot is dict:
         if not isinstance(value, dict):
             raise MessageValidationError(f"{name}: expected dict, got {type(value).__name__}")
+        for k in value:
+            if not isinstance(k, str):
+                raise MessageValidationError(
+                    f"{name}: dict keys must be str, got {type(k).__name__}")
         return value
     if isinstance(annot, type):
         if annot is tuple and isinstance(value, (list, tuple)):
@@ -125,6 +132,17 @@ class MessageBase:
         if not cond:
             raise MessageValidationError(f"{self.typename}: {why}")
 
+    def _require_non_negative(self, *field_names: str) -> None:
+        for fname in field_names:
+            v = getattr(self, fname)
+            if v is not None:
+                self._require(v >= 0, f"{fname} must be >= 0, got {v}")
+
+    def _canonical_hash(self) -> int:
+        import json
+        return hash(json.dumps(_plainify_for_hash(self.to_dict()),
+                               sort_keys=True, default=str))
+
 
 _TYPE_CACHE: dict[tuple, Any] = {}
 
@@ -137,6 +155,14 @@ def _resolve(cls, f):
         for n, t in hints.items():
             _TYPE_CACHE[(cls, n)] = t
     return _TYPE_CACHE.get(key, Any)
+
+
+def _plainify_for_hash(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {str(k): _plainify_for_hash(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_plainify_for_hash(x) for x in v]
+    return v
 
 
 def _plainify(v: Any) -> Any:

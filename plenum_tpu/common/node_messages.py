@@ -80,6 +80,7 @@ class Checkpoint(MessageBase):
     digest: str                       # audit-ledger root at seq_no_end (ref checkpoint_service.py:147)
 
     def validate(self) -> None:
+        self._require_non_negative("inst_id", "view_no")
         self._require(self.seq_no_end >= self.seq_no_start >= 0, "bad checkpoint range")
 
 
@@ -88,6 +89,9 @@ class InstanceChange(MessageBase):
     typename = "INSTANCE_CHANGE"
     view_no: int                      # proposed view
     reason: int                       # suspicion code
+
+    def validate(self) -> None:
+        self._require_non_negative("view_no")
 
 
 @wire_message
@@ -98,6 +102,9 @@ class ViewChange(MessageBase):
     prepared: tuple[tuple[int, int, str], ...]     # (orig_view_no, pp_seq_no, digest)
     preprepared: tuple[tuple[int, int, str], ...]
     checkpoints: tuple[tuple[int, int, int, str], ...]  # Checkpoint tuples (view,start,end,digest)
+
+    def validate(self) -> None:
+        self._require_non_negative("view_no", "stable_checkpoint")
 
 
 @wire_message
@@ -150,6 +157,9 @@ class LedgerStatus(MessageBase):
     view_no: Optional[int] = None
     pp_seq_no: Optional[int] = None
 
+    def validate(self) -> None:
+        self._require_non_negative("ledger_id", "txn_seq_no", "view_no", "pp_seq_no")
+
 
 @wire_message
 class ConsistencyProof(MessageBase):
@@ -163,6 +173,10 @@ class ConsistencyProof(MessageBase):
     new_merkle_root: str
     hashes: tuple[str, ...]
 
+    def validate(self) -> None:
+        self._require_non_negative("ledger_id", "seq_no_start", "seq_no_end",
+                                   "view_no", "pp_seq_no")
+
 
 @wire_message
 class CatchupReq(MessageBase):
@@ -171,6 +185,11 @@ class CatchupReq(MessageBase):
     seq_no_start: int
     seq_no_end: int
     catchup_till: int
+
+    def validate(self) -> None:
+        self._require_non_negative("ledger_id")
+        self._require(1 <= self.seq_no_start <= self.seq_no_end,
+                      "bad catchup range")
 
 
 @wire_message

@@ -23,7 +23,10 @@ def unpack(data: bytes) -> Any:
 
 def _sort_maps(obj: Any) -> Any:
     if isinstance(obj, dict):
-        return {k: _sort_maps(obj[k]) for k in sorted(obj)}
+        # Mixed-type keys must not crash serialization (ingress validation
+        # rejects them on wire messages, but internal data may use int keys).
+        return {k: _sort_maps(obj[k])
+                for k in sorted(obj, key=lambda k: (type(k).__name__, str(k)))}
     if isinstance(obj, (list, tuple)):
         return [_sort_maps(v) for v in obj]
     return obj

@@ -39,6 +39,8 @@ class StashingRouter:
         self.discarded: list[tuple[Any, Any, str]] = []
 
     def subscribe(self, message_type: type, handler: Callable) -> None:
+        if message_type in self._handlers:
+            raise ValueError(f"handler already registered for {message_type.__name__}")
         self._handlers[message_type] = handler
 
     def subscribe_to(self, bus) -> None:

@@ -1,0 +1,160 @@
+"""Append-only transaction ledger: KV txn log + compact Merkle tree.
+
+Reference behavior: ledger/ledger.py:17 — txns keyed by 1-based seq_no in a KV
+log, every append updates the Merkle tree and returns merkle info (root + audit
+path); supports an uncommitted staging area (appendTxns → commitTxns /
+discardTxns) used by 3PC dynamic validation, genesis loading, and recovery from
+the hash store with txn-log replay as fallback (ledger.py:70-113).
+
+TPU angle: `append_txns` stages and `commit_txns` extends the tree with ALL
+the batch's leaves through the hasher's batch API — with the jax backend this
+is the one-dispatch Merkle append of the north star.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from plenum_tpu.common.serialization import pack, unpack
+from plenum_tpu.storage.kv_store import KeyValueStorage
+from plenum_tpu.storage.kv_memory import KvMemory
+
+from .compact_merkle_tree import CompactMerkleTree
+from .hash_store import HashStore
+from .tree_hasher import TreeHasher
+
+
+def txn_to_leaf(txn: dict) -> bytes:
+    return pack(txn)
+
+
+class Ledger:
+    def __init__(self,
+                 tree: Optional[CompactMerkleTree] = None,
+                 txn_log: Optional[KeyValueStorage] = None,
+                 genesis_txns: Sequence[dict] = ()):
+        self.tree = tree or CompactMerkleTree()
+        self.hasher = self.tree.hasher
+        self._log = txn_log if txn_log is not None else KvMemory()
+        self.seq_no = 0                      # last committed seq_no (1-based)
+        self._uncommitted: list[dict] = []   # staged txns
+        self._uncommitted_tree: Optional[CompactMerkleTree] = None
+        self.recover()
+        if self.size == 0 and genesis_txns:
+            for txn in genesis_txns:
+                self.append(txn)
+
+    # --- recovery (ref ledger.py:70-113) ----------------------------------
+
+    def recover(self) -> None:
+        log_size = self._log.size
+        self.seq_no = log_size
+        if self.tree.tree_size == log_size:
+            return
+        if self.tree.tree_size == self.tree.hash_store.leaf_count and \
+                self.tree.tree_size < log_size:
+            # hash store lags the log: replay the missing tail
+            missing = [self.get_by_seq_no(i)
+                       for i in range(self.tree.tree_size + 1, log_size + 1)]
+            self.tree.extend_batch([txn_to_leaf(t) for t in missing])
+            return
+        if self.tree.tree_size > log_size:
+            # hash store ahead of (or inconsistent with) the log: rebuild
+            self.tree.hash_store.reset()
+            self.tree = CompactMerkleTree(self.hasher, self.tree.hash_store)
+            all_txns = [self.get_by_seq_no(i) for i in range(1, log_size + 1)]
+            self.tree.extend_batch([txn_to_leaf(t) for t in all_txns])
+
+    # --- committed appends ------------------------------------------------
+
+    def append(self, txn: dict) -> dict:
+        """Append one committed txn; returns merkle info for the REPLY."""
+        return self.append_batch([txn])[0]
+
+    def append_batch(self, txns: Sequence[dict]) -> list[dict]:
+        leaves = [txn_to_leaf(t) for t in txns]
+        start = self.seq_no
+        for i, (txn, leaf) in enumerate(zip(txns, leaves)):
+            self._log.put(start + 1 + i, leaf)
+        self.tree.extend_batch(leaves)
+        self.seq_no += len(txns)
+        return [self.merkle_info(start + 1 + i) for i in range(len(txns))]
+
+    # --- uncommitted staging (ref appendTxns/commitTxns/discardTxns) ------
+
+    def append_txns_to_uncommitted(self, txns: Sequence[dict]) -> tuple[bytes, int]:
+        """Stage txns; returns (uncommitted_root, uncommitted_size)."""
+        if self._uncommitted_tree is not None:
+            # shadow exists: extend incrementally instead of rebuilding
+            self._uncommitted_tree.extend_batch([txn_to_leaf(t) for t in txns])
+        self._uncommitted.extend(txns)
+        return self.uncommitted_root_hash, self.uncommitted_size
+
+    def commit_txns(self, count: int) -> tuple[list[dict], list[dict]]:
+        """Commit the first `count` staged txns; returns (txns, merkle_infos)."""
+        assert count <= len(self._uncommitted)
+        txns = self._uncommitted[:count]
+        self._uncommitted = self._uncommitted[count:]
+        self._uncommitted_tree = None
+        infos = self.append_batch(txns)
+        return txns, infos
+
+    def discard_txns(self, count: int) -> None:
+        """Drop the LAST `count` staged txns (revert on 3PC reject)."""
+        assert count <= len(self._uncommitted)
+        if count:
+            self._uncommitted = self._uncommitted[:-count]
+            self._uncommitted_tree = None
+
+    def reset_uncommitted(self) -> None:
+        self._uncommitted = []
+        self._uncommitted_tree = None
+
+    @property
+    def uncommitted_size(self) -> int:
+        return self.seq_no + len(self._uncommitted)
+
+    @property
+    def uncommitted_root_hash(self) -> bytes:
+        if not self._uncommitted:
+            return self.root_hash
+        if self._uncommitted_tree is None:
+            shadow = self.tree.fork()
+            shadow.extend_batch([txn_to_leaf(t) for t in self._uncommitted])
+            self._uncommitted_tree = shadow
+        return self._uncommitted_tree.root_hash
+
+    # --- reads ------------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self.seq_no
+
+    @property
+    def root_hash(self) -> bytes:
+        return self.tree.root_hash
+
+    def get_by_seq_no(self, seq_no: int) -> dict:
+        if not (1 <= seq_no <= self.seq_no):
+            raise KeyError(seq_no)
+        return unpack(self._log.get(seq_no))
+
+    def get_all_txns(self, start: int = 1, end: Optional[int] = None):
+        end = self.seq_no if end is None else min(end, self.seq_no)
+        for i in range(start, end + 1):
+            yield i, self.get_by_seq_no(i)
+
+    def merkle_info(self, seq_no: int) -> dict:
+        """Root + audit path for the txn at seq_no, as wire-friendly hex."""
+        path = self.tree.inclusion_proof(seq_no - 1)
+        return {"seqNo": seq_no,
+                "rootHash": self.root_hash.hex(),
+                "auditPath": [h.hex() for h in path],
+                "treeSize": self.tree.tree_size}
+
+    def consistency_proof(self, old_size: int, new_size: Optional[int] = None) -> list[str]:
+        return [h.hex() for h in self.tree.consistency_proof(
+            old_size, new_size if new_size is not None else self.tree.tree_size)]
+
+    def close(self) -> None:
+        self._log.close()
+        self.tree.hash_store.close()

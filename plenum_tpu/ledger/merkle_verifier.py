@@ -1,0 +1,101 @@
+"""Client/catchup-side proof verification (no tree access needed).
+
+Reference behavior: ledger/merkle_verifier.py — verify RFC-6962 inclusion
+(audit) proofs and consistency proofs against advertised roots. Used by catchup
+to check CatchupRep txn ranges (SURVEY.md §3.4) and by clients on REPLY.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from .tree_hasher import TreeHasher
+
+
+class MerkleVerificationError(Exception):
+    pass
+
+
+class MerkleVerifier:
+    def __init__(self, hasher: TreeHasher | None = None):
+        self.hasher = hasher or TreeHasher()
+
+    def calc_root_from_inclusion(self, leaf_data: bytes, m: int, n: int,
+                                 path: Sequence[bytes]) -> bytes:
+        """Recompute the size-n root from leaf m's data and its audit path
+        (RFC 6962 §2.1.1 verification, bottom-up)."""
+        if not (0 <= m < n):
+            raise MerkleVerificationError(f"bad leaf index {m} for size {n}")
+        h = self.hasher.hash_leaf(leaf_data)
+        fn, sn = m, n - 1
+        for p in path:
+            if sn == 0:
+                raise MerkleVerificationError("proof too long")
+            if fn & 1 or fn == sn:
+                h = self.hasher.hash_children(p, h)
+                if not fn & 1:
+                    while fn & 1 == 0 and fn != 0:
+                        fn >>= 1
+                        sn >>= 1
+            else:
+                h = self.hasher.hash_children(h, p)
+            fn >>= 1
+            sn >>= 1
+        if sn != 0:
+            raise MerkleVerificationError("proof too short")
+        return h
+
+    def verify_inclusion(self, leaf_data: bytes, m: int, n: int,
+                         path: Sequence[bytes], root: bytes) -> bool:
+        try:
+            return self.calc_root_from_inclusion(leaf_data, m, n, path) == root
+        except MerkleVerificationError:
+            return False
+
+    def verify_consistency(self, m: int, n: int, old_root: bytes,
+                           new_root: bytes, proof: Sequence[bytes]) -> bool:
+        """RFC 6962 §2.1.2 consistency-proof verification."""
+        try:
+            self._check_consistency(m, n, old_root, new_root, list(proof))
+            return True
+        except MerkleVerificationError:
+            return False
+
+    def _check_consistency(self, m: int, n: int, old_root: bytes,
+                           new_root: bytes, proof: list[bytes]) -> None:
+        if m > n:
+            raise MerkleVerificationError("old size exceeds new size")
+        if m == n:
+            if old_root != new_root or proof:
+                raise MerkleVerificationError("equal sizes but roots/proof differ")
+            return
+        if m == 0:
+            raise MerkleVerificationError("consistency from empty tree undefined")
+        # m is a power of two exactly when the old root is itself a node of
+        # the new tree; then the proof does not repeat it.
+        node, last = m - 1, n - 1
+        while node & 1:
+            node >>= 1
+            last >>= 1
+        p = iter(proof)
+        try:
+            new_hash = old_hash = next(p) if node else old_root
+            while node:
+                if node & 1:
+                    nxt = next(p)
+                    old_hash = self.hasher.hash_children(nxt, old_hash)
+                    new_hash = self.hasher.hash_children(nxt, new_hash)
+                elif node < last:
+                    new_hash = self.hasher.hash_children(new_hash, next(p))
+                node >>= 1
+                last >>= 1
+            while last:
+                new_hash = self.hasher.hash_children(new_hash, next(p))
+                last >>= 1
+        except StopIteration:
+            raise MerkleVerificationError("proof too short")
+        if any(True for _ in p):
+            raise MerkleVerificationError("proof too long")
+        if old_hash != old_root:
+            raise MerkleVerificationError("old root mismatch")
+        if new_hash != new_root:
+            raise MerkleVerificationError("new root mismatch")

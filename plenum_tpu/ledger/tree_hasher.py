@@ -1,0 +1,76 @@
+"""Merkle tree hashing seam — the first of the three crypto provider seams
+(SURVEY.md §7 stage 2).
+
+Reference behavior: ledger/tree_hasher.py:4 — RFC-6962 domain separation:
+    leaf hash     = SHA256(0x00 || data)
+    interior hash = SHA256(0x01 || left || right)
+Two backends: `cpu` (hashlib, scalar) and `jax` (batched device kernels from
+plenum_tpu.ops.sha256). The batch API is the contract — `hash_leaves` /
+`hash_children_batch` take whole vectors so the device backend issues one
+dispatch per call, never one per hash.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+
+class TreeHasher:
+    """CPU backend (hashlib)."""
+
+    def hash_empty(self) -> bytes:
+        return hashlib.sha256(b"").digest()
+
+    def hash_leaf(self, data: bytes) -> bytes:
+        return hashlib.sha256(b"\x00" + data).digest()
+
+    def hash_children(self, left: bytes, right: bytes) -> bytes:
+        return hashlib.sha256(b"\x01" + left + right).digest()
+
+    # batch API (scalar loop on CPU; one device call on JAX backend)
+    def hash_leaves(self, leaves: Sequence[bytes]) -> list[bytes]:
+        return [self.hash_leaf(l) for l in leaves]
+
+    def hash_children_batch(self, pairs: Sequence[tuple[bytes, bytes]]) -> list[bytes]:
+        return [self.hash_children(l, r) for l, r in pairs]
+
+
+class JaxTreeHasher(TreeHasher):
+    """Device backend: batched SHA-256 (plenum_tpu/ops/sha256.py).
+
+    Scalar calls fall back to hashlib (correctness identical); the wins come
+    from the batch entry points used by Ledger.extend_batch and the catchup
+    verifier.
+    """
+
+    def __init__(self, min_batch: int = 8):
+        # Below min_batch the dispatch overhead beats the VPU win; use hashlib.
+        self._min_batch = min_batch
+
+    def hash_leaves(self, leaves: Sequence[bytes]) -> list[bytes]:
+        if len(leaves) < self._min_batch:
+            return [self.hash_leaf(l) for l in leaves]
+        from plenum_tpu.ops.sha256 import sha256_batch
+        return sha256_batch(list(leaves), prefix=b"\x00")
+
+    def hash_children_batch(self, pairs: Sequence[tuple[bytes, bytes]]) -> list[bytes]:
+        if len(pairs) < self._min_batch:
+            return [self.hash_children(l, r) for l, r in pairs]
+        import jax.numpy as jnp
+        from plenum_tpu.ops.sha256 import (hash_interior, bytes_to_digests,
+                                           digests_to_bytes)
+        import numpy as np
+        n = len(pairs)
+        n_pad = 1
+        while n_pad < n:
+            n_pad *= 2
+        lefts = bytes_to_digests([p[0] for p in pairs] + [b"\x00" * 32] * (n_pad - n))
+        rights = bytes_to_digests([p[1] for p in pairs] + [b"\x00" * 32] * (n_pad - n))
+        out = digests_to_bytes(hash_interior(jnp.asarray(lefts), jnp.asarray(rights)))
+        return out[:n]
+
+
+def make_tree_hasher(backend: str) -> TreeHasher:
+    if backend == "jax":
+        return JaxTreeHasher()
+    return TreeHasher()

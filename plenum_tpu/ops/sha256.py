@@ -1,0 +1,199 @@
+"""Vectorized SHA-256 on device (JAX/XLA), the TPU path for Merkle hashing.
+
+Reference behavior being replaced: ledger/tree_hasher.py:4 — RFC-6962-style
+hashing (leaf = SHA256(0x00 || data), interior = SHA256(0x01 || l || r)) done
+one scalar hashlib call at a time. Here whole batches of messages are hashed in
+one device dispatch: state lives as uint32 lanes of shape [N] so the 64-round
+compression runs element-wise across the batch on the VPU (8x128 lanes), with
+zero data-dependent control flow — the round structure is fully unrolled at
+trace time.
+
+All functions are shape-polymorphic in the batch axis N but static in block
+count B; callers bucket variable-length messages by padded block count so XLA
+compiles one program per bucket (SURVEY.md §7 "constant-shape padding").
+"""
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# --- constants (FIPS 180-4) ----------------------------------------------
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+_H0 = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19], dtype=np.uint32)
+
+
+def _rotr(x, n):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def _compress(state, block):
+    """One SHA-256 compression. state: uint32[N, 8]; block: uint32[N, 16].
+
+    Schedule expansion and the 64 rounds run as fori_loops so the traced graph
+    stays small (fast compiles); all lanes of the batch advance together, which
+    is exactly the VPU-friendly layout.
+    """
+    n = block.shape[0]
+    k_arr = jnp.asarray(_K)
+
+    w_init = jnp.concatenate([block, jnp.zeros((n, 48), jnp.uint32)], axis=1)
+
+    def sched(t, w):
+        wt15 = jax.lax.dynamic_slice_in_dim(w, t - 15, 1, axis=1)[:, 0]
+        wt2 = jax.lax.dynamic_slice_in_dim(w, t - 2, 1, axis=1)[:, 0]
+        wt16 = jax.lax.dynamic_slice_in_dim(w, t - 16, 1, axis=1)[:, 0]
+        wt7 = jax.lax.dynamic_slice_in_dim(w, t - 7, 1, axis=1)[:, 0]
+        s0 = _rotr(wt15, 7) ^ _rotr(wt15, 18) ^ (wt15 >> jnp.uint32(3))
+        s1 = _rotr(wt2, 17) ^ _rotr(wt2, 19) ^ (wt2 >> jnp.uint32(10))
+        new = wt16 + s0 + wt7 + s1
+        return jax.lax.dynamic_update_slice_in_dim(w, new[:, None], t, axis=1)
+
+    w = jax.lax.fori_loop(16, 64, sched, w_init)
+
+    def rounds(t, s):
+        a, b, c, d, e, f, g, h = s
+        wt = jax.lax.dynamic_slice_in_dim(w, t, 1, axis=1)[:, 0]
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + k_arr[t] + wt
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + S0 + maj, a, b, c, d + t1, e, f, g)
+
+    s0 = tuple(state[:, i] for i in range(8))
+    sN = jax.lax.fori_loop(0, 64, rounds, s0)
+    return state + jnp.stack(sN, axis=1)
+
+
+@jax.jit
+def sha256_words(msgs: jax.Array) -> jax.Array:
+    """SHA-256 over pre-padded messages.
+
+    msgs: uint32[N, 16*B] — big-endian words of B already-padded 64-byte blocks.
+    Returns uint32[N, 8] digests. NOTE: padding is part of the hash input, so B
+    must be the standard (minimal) block count for each message.
+    """
+    n_words = msgs.shape[-1]
+    assert n_words % 16 == 0, "messages must be padded to whole 64-byte blocks"
+    state = jnp.broadcast_to(jnp.asarray(_H0), msgs.shape[:-1] + (8,))
+    for blk in range(n_words // 16):
+        state = _compress(state, msgs[..., blk * 16:(blk + 1) * 16])
+    return state
+
+
+def _interior_words(left: jax.Array, right: jax.Array) -> jax.Array:
+    """Pack RFC-6962 interior-node messages entirely on device.
+
+    left/right: uint32[N, 8] child digests. The message is
+    0x01 || left(32B) || right(32B) || 0x80-pad || bitlen(520) = 2 blocks.
+    The 1-byte prefix shifts every word by 8 bits, done with u32 shifts.
+    """
+    cat = jnp.concatenate([left, right], axis=-1)          # [N, 16]
+    lo8 = (cat & jnp.uint32(0xFF)) << jnp.uint32(24)       # carry byte to next word
+    hi24 = cat >> jnp.uint32(8)
+    prev = jnp.concatenate(
+        [jnp.full(cat.shape[:-1] + (1,), 0x01000000, jnp.uint32),
+         lo8[..., :-1]], axis=-1)
+    words = prev | hi24                                     # words 0..15
+    w16 = lo8[..., -1:] | jnp.uint32(0x00800000)            # last byte + 0x80 pad
+    zeros = jnp.zeros(cat.shape[:-1] + (14,), jnp.uint32)
+    bitlen = jnp.full(cat.shape[:-1] + (1,), 65 * 8, jnp.uint32)
+    return jnp.concatenate([words, w16, zeros, bitlen], axis=-1)  # [N, 32]
+
+
+@jax.jit
+def hash_interior(left: jax.Array, right: jax.Array) -> jax.Array:
+    """Batched interior-node hash: uint32[N,8] x uint32[N,8] -> uint32[N,8]."""
+    return sha256_words(_interior_words(left, right))
+
+
+@jax.jit
+def merkle_reduce_pow2(leaf_digests: jax.Array) -> jax.Array:
+    """Root of a complete (power-of-two) subtree, fully on device.
+
+    leaf_digests: uint32[N, 8] with N a power of two. log2(N) rounds of the
+    batched interior hash; each round halves the batch.
+    """
+    h = leaf_digests
+    while h.shape[0] > 1:
+        h = hash_interior(h[0::2], h[1::2])
+    return h[0]
+
+
+# --- host-side packing helpers -------------------------------------------
+
+def pad_to_words(data: bytes) -> np.ndarray:
+    """Standard SHA-256 padding; returns uint32 big-endian words (1-D)."""
+    length = len(data)
+    padded = bytearray(data)
+    padded.append(0x80)
+    while len(padded) % 64 != 56:
+        padded.append(0)
+    padded += (length * 8).to_bytes(8, "big")
+    return np.frombuffer(bytes(padded), dtype=">u4").astype(np.uint32)
+
+
+def n_blocks_for(length: int) -> int:
+    """Standard (minimal) SHA-256 block count for a message of `length` bytes."""
+    return (length + 9 + 63) // 64
+
+
+def digests_to_bytes(digests) -> list[bytes]:
+    """uint32[N, 8] -> list of 32-byte digests."""
+    arr = np.asarray(digests).astype(">u4")
+    return [arr[i].tobytes() for i in range(arr.shape[0])]
+
+
+def bytes_to_digests(hashes: Sequence[bytes]) -> np.ndarray:
+    """list of 32-byte digests -> uint32[N, 8]."""
+    return np.frombuffer(b"".join(hashes), dtype=">u4").astype(np.uint32).reshape(len(hashes), 8)
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def sha256_batch(msgs: Sequence[bytes], prefix: bytes = b"") -> list[bytes]:
+    """Hash a batch of byte strings on device.
+
+    Messages are bucketed by their standard block count (padding is part of the
+    hash, so block count can't be fudged); within a bucket the batch axis is
+    padded to a power of two so XLA compiles O(log N) programs per bucket size,
+    not one per batch size.
+    """
+    if not msgs:
+        return []
+    buckets: dict[int, list[int]] = {}
+    for i, m in enumerate(msgs):
+        buckets.setdefault(n_blocks_for(len(prefix) + len(m)), []).append(i)
+    out: list[bytes] = [b""] * len(msgs)
+    for nb, idxs in buckets.items():
+        n_pad = _pow2_at_least(len(idxs))
+        words = np.zeros((n_pad, nb * 16), dtype=np.uint32)
+        for j, i in enumerate(idxs):
+            words[j] = pad_to_words(prefix + msgs[i])
+        dig = digests_to_bytes(sha256_words(jnp.asarray(words)))
+        for j, i in enumerate(idxs):
+            out[i] = dig[j]
+    return out

@@ -35,6 +35,8 @@ class KvFile(KeyValueStorage):
         off, n = 0, len(data)
         while off + _HDR.size <= n:
             op, klen, vlen = _HDR.unpack_from(data, off)
+            if op not in (_PUT, _DEL):   # corrupt header: stop, keep prefix
+                break
             if off + _HDR.size + klen + vlen > n:   # torn tail write
                 break
             off += _HDR.size

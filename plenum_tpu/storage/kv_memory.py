@@ -34,9 +34,10 @@ class KvMemory(KeyValueStorage):
 
     def iterator(self, start=None, end=None, include_value: bool = True) -> Iterator:
         lo = 0 if start is None else bisect_left(self._keys, encode_key(start))
+        hi = None if end is None else encode_key(end)
         for i in range(lo, len(self._keys)):
             k = self._keys[i]
-            if end is not None and k > encode_key(end):
+            if hi is not None and k > hi:
                 return
             yield (k, self._data[k]) if include_value else k
 

@@ -1,0 +1,79 @@
+"""Device SHA-256 kernel vs hashlib ground truth."""
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from plenum_tpu.ops.sha256 import (sha256_words, sha256_batch, hash_interior,
+                                   merkle_reduce_pow2, pad_to_words,
+                                   n_blocks_for, digests_to_bytes,
+                                   bytes_to_digests)
+
+
+def ref_hash(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def test_empty_and_abc():
+    assert sha256_batch([b""]) == [ref_hash(b"")]
+    assert sha256_batch([b"abc"]) == [ref_hash(b"abc")]
+
+
+def test_random_lengths_match_hashlib():
+    rng = random.Random(42)
+    msgs = [rng.randbytes(rng.randint(0, 300)) for _ in range(64)]
+    assert sha256_batch(msgs) == [ref_hash(m) for m in msgs]
+
+
+def test_prefix_applied():
+    msgs = [b"leafdata", b"x" * 100]
+    assert sha256_batch(msgs, prefix=b"\x00") == [ref_hash(b"\x00" + m) for m in msgs]
+
+
+def test_block_boundary_lengths():
+    # 55/56/63/64/119/120 bytes straddle padding edges
+    for n in [55, 56, 63, 64, 119, 120, 128]:
+        m = bytes(range(256))[:n] * 1
+        assert sha256_batch([m]) == [ref_hash(m)], f"len {n}"
+
+
+def test_n_blocks_for():
+    assert n_blocks_for(0) == 1
+    assert n_blocks_for(55) == 1
+    assert n_blocks_for(56) == 2   # padding needs 9 bytes
+    assert n_blocks_for(119) == 2
+    assert n_blocks_for(120) == 3
+
+
+def test_hash_interior_matches_rfc6962_shape():
+    rng = random.Random(7)
+    lefts = [rng.randbytes(32) for _ in range(17)]
+    rights = [rng.randbytes(32) for _ in range(17)]
+    out = hash_interior(jnp.asarray(bytes_to_digests(lefts)),
+                        jnp.asarray(bytes_to_digests(rights)))
+    expect = [ref_hash(b"\x01" + l + r) for l, r in zip(lefts, rights)]
+    assert digests_to_bytes(out) == expect
+
+
+def test_merkle_reduce_pow2_vs_host():
+    rng = random.Random(9)
+    leaves = [rng.randbytes(32) for _ in range(16)]
+
+    def host_root(hs):
+        if len(hs) == 1:
+            return hs[0]
+        nxt = [ref_hash(b"\x01" + hs[i] + hs[i + 1]) for i in range(0, len(hs), 2)]
+        return host_root(nxt)
+
+    root = merkle_reduce_pow2(jnp.asarray(bytes_to_digests(leaves)))
+    assert digests_to_bytes(root[None])[0] == host_root(leaves)
+
+
+def test_digest_bytes_roundtrip():
+    rng = random.Random(1)
+    hs = [rng.randbytes(32) for _ in range(5)]
+    assert digests_to_bytes(jnp.asarray(bytes_to_digests(hs))) == hs

@@ -305,3 +305,64 @@ def test_stash_overflow_recorded():
 def test_config_unknown_key_raises():
     with pytest.raises(KeyError):
         load_config({"CHK_FRQ": 10})
+
+
+# --- regression tests for second review round -----------------------------
+
+def test_dict_field_messages_hashable():
+    c = Commit(inst_id=0, view_no=0, pp_seq_no=1, bls_sigs={"0": "sig"})
+    assert hash(c) == hash(Commit.from_dict(c.to_dict()))
+    assert c in {c}
+
+
+def test_dict_field_rejects_non_str_keys():
+    d = Propagate(request={"identifier": "a"}, sender_client=None).to_dict()
+    d["request"] = {1: "a", "b": 2}
+    with pytest.raises(MessageValidationError):
+        message_from_dict(d)
+
+
+def test_negative_fields_rejected_everywhere():
+    from plenum_tpu.common.node_messages import (InstanceChange, ViewChange,
+                                                 LedgerStatus, CatchupReq)
+    with pytest.raises(MessageValidationError):
+        InstanceChange.from_dict({"op": "INSTANCE_CHANGE", "view_no": -3, "reason": 0})
+    with pytest.raises(MessageValidationError):
+        Checkpoint.from_dict({"op": "CHECKPOINT", "inst_id": -5, "view_no": 0,
+                              "seq_no_start": 0, "seq_no_end": 1, "digest": "d"})
+    with pytest.raises(MessageValidationError):
+        LedgerStatus.from_dict({"op": "LEDGER_STATUS", "ledger_id": 1,
+                                "txn_seq_no": -1, "merkle_root": "r"})
+    with pytest.raises(MessageValidationError):
+        CatchupReq.from_dict({"op": "CATCHUP_REQ", "ledger_id": 1,
+                              "seq_no_start": 5, "seq_no_end": 2, "catchup_till": 9})
+
+
+def test_pack_mixed_key_types_no_crash():
+    assert unpack(pack({1: "a", "b": 2})) == {1: "a", "b": 2}
+
+
+def test_kv_file_corrupt_op_byte_stops_replay(tdir):
+    import os, struct
+    kv = KvFile(tdir, "t")
+    kv.put("a", b"1")
+    kv.put("b", b"2")
+    kv.close()
+    p = os.path.join(tdir, "t.kvlog")
+    data = open(p, "rb").read()
+    # corrupt the op byte of the second record
+    second_off = 9 + 1 + 1
+    patched = bytearray(data)
+    patched[second_off] = 7
+    open(p, "wb").write(bytes(patched))
+    kv2 = KvFile(tdir, "t")
+    assert kv2.get("a") == b"1"       # prefix survives
+    assert kv2.try_get("b") is None   # corrupt record dropped, not misread
+    kv2.close()
+
+
+def test_stashing_duplicate_subscribe_raises():
+    router = StashingRouter()
+    router.subscribe(Checkpoint, lambda m, frm: PROCESS)
+    with pytest.raises(ValueError):
+        router.subscribe(Checkpoint, lambda m, frm: PROCESS)
