@@ -139,9 +139,13 @@ class MessageBase:
                 self._require(v >= 0, f"{fname} must be >= 0, got {v}")
 
     def _canonical_hash(self) -> int:
-        import json
-        return hash(json.dumps(_plainify_for_hash(self.to_dict()),
-                               sort_keys=True, default=str))
+        cached = self.__dict__.get("_hash_cache")
+        if cached is None:
+            import json
+            cached = hash(json.dumps(_plainify_for_hash(self.to_dict()),
+                                     sort_keys=True, default=str))
+            object.__setattr__(self, "_hash_cache", cached)
+        return cached
 
 
 _TYPE_CACHE: dict[tuple, Any] = {}

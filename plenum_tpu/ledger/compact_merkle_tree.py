@@ -66,7 +66,6 @@ class CompactMerkleTree:
         get = self._level_hash
         new_at_level: dict[int, bytes] = {i: h for i, h in
                                           zip(range(base, level_count), leaf_hashes)}
-        all_new: list[dict[int, bytes]] = [new_at_level]
         while level_count >= 2:
             parent_first = level_start // 2
             parent_count = level_count // 2
@@ -88,7 +87,6 @@ class CompactMerkleTree:
             level_start = parent_first
             level_count = parent_count
             new_at_level = new_parent
-            all_new.append(new_parent)
         self.tree_size += len(leaf_hashes)
         self._peaks = self._compute_peaks(self.tree_size)
 
@@ -149,7 +147,9 @@ class CompactMerkleTree:
         """Audit path for leaf index m (0-based) in the size-n tree
         (RFC 6962 §2.1.1 PATH(m, D[n]))."""
         n = self.tree_size if n is None else n
-        assert 0 <= m < n <= self.tree_size
+        if not (0 <= m < n <= self.tree_size):
+            raise ValueError(f"leaf {m} out of range for size {n} "
+                             f"(tree has {self.tree_size})")
         return self._path(m, 0, n)
 
     def _path(self, m: int, lo: int, hi: int) -> list[bytes]:
@@ -165,7 +165,9 @@ class CompactMerkleTree:
         """PROOF(m, D[n]) that the size-m tree is a prefix of the size-n tree
         (RFC 6962 §2.1.2)."""
         n = self.tree_size if n is None else n
-        assert 0 < m <= n <= self.tree_size
+        if not (0 < m <= n <= self.tree_size):
+            raise ValueError(f"bad consistency range {m}..{n} "
+                             f"(tree has {self.tree_size})")
         if m == n:
             return []
         return self._subproof(m, 0, n, True)

@@ -48,6 +48,12 @@ class Ledger:
     def recover(self) -> None:
         log_size = self._log.size
         self.seq_no = log_size
+        # First sync the tree with its own hash store: a fresh CompactMerkleTree
+        # handed a persisted store must pick up the stored leaves (callers need
+        # not remember CompactMerkleTree.recover()).
+        hs_count = self.tree.hash_store.leaf_count
+        if self.tree.tree_size < hs_count:
+            self.tree = CompactMerkleTree.recover(self.hasher, self.tree.hash_store)
         if self.tree.tree_size == log_size:
             return
         if self.tree.tree_size == self.tree.hash_store.leaf_count and \
@@ -91,7 +97,8 @@ class Ledger:
 
     def commit_txns(self, count: int) -> tuple[list[dict], list[dict]]:
         """Commit the first `count` staged txns; returns (txns, merkle_infos)."""
-        assert count <= len(self._uncommitted)
+        if count > len(self._uncommitted):
+            raise ValueError(f"commit {count} > {len(self._uncommitted)} staged")
         txns = self._uncommitted[:count]
         self._uncommitted = self._uncommitted[count:]
         self._uncommitted_tree = None
@@ -100,7 +107,8 @@ class Ledger:
 
     def discard_txns(self, count: int) -> None:
         """Drop the LAST `count` staged txns (revert on 3PC reject)."""
-        assert count <= len(self._uncommitted)
+        if count > len(self._uncommitted):
+            raise ValueError(f"discard {count} > {len(self._uncommitted)} staged")
         if count:
             self._uncommitted = self._uncommitted[:-count]
             self._uncommitted_tree = None

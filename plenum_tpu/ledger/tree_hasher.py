@@ -59,7 +59,6 @@ class JaxTreeHasher(TreeHasher):
         import jax.numpy as jnp
         from plenum_tpu.ops.sha256 import (hash_interior, bytes_to_digests,
                                            digests_to_bytes)
-        import numpy as np
         n = len(pairs)
         n_pad = 1
         while n_pad < n:

@@ -222,3 +222,35 @@ def test_ledger_recovery_hash_store_lagging(tdir):
     log2 = KvFile(tdir + "/log", "txns")
     l2 = Ledger(CompactMerkleTree(hash_store=HashStore(KvMemory())), log2)
     assert l2.size == 10 and l2.root_hash == root
+
+
+def test_fresh_tree_over_persisted_store_recovers(tdir):
+    """Review finding: Ledger must recover even when handed a non-recovered
+    tree over a persisted hash store."""
+    log = KvFile(tdir + "/log", "txns")
+    store_kv = KvFile(tdir + "/hs", "hashes")
+    l = Ledger(CompactMerkleTree(hash_store=HashStore(store_kv)), log)
+    l.append_batch([_txn(i) for i in range(5)])
+    root = l.root_hash
+    l.close()
+    # reopen with a FRESH tree (not CompactMerkleTree.recover)
+    l2 = Ledger(CompactMerkleTree(hash_store=HashStore(KvFile(tdir + "/hs", "hashes"))),
+                KvFile(tdir + "/log", "txns"))
+    assert l2.size == 5
+    assert l2.root_hash == root
+    assert l2.merkle_info(1)["seqNo"] == 1
+    l2.close()
+
+
+def test_proof_range_errors_are_value_errors():
+    t = CompactMerkleTree()
+    t.append(b"x")
+    with pytest.raises(ValueError):
+        t.inclusion_proof(5)
+    with pytest.raises(ValueError):
+        t.consistency_proof(0, 1)
+    l = Ledger()
+    with pytest.raises(ValueError):
+        l.commit_txns(3)
+    with pytest.raises(ValueError):
+        l.discard_txns(1)
