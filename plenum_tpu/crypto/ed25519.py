@@ -19,7 +19,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from plenum_tpu.utils.base58 import b58decode, b58encode
+from plenum_tpu.utils.base58 import b58encode
 
 try:
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -79,12 +79,35 @@ class Ed25519Verifier(ABC):
         return bool(self.verify_batch([(msg, sig, vk)])[0])
 
 
+def _precheck(msg, sig, vk) -> bool:
+    """Canonicality checks shared by BOTH backends so they can never disagree
+    (a backend-verdict split on the same bytes would fork a BFT pool):
+    reject non-canonical point encodings (y >= p) and S >= L, which OpenSSL
+    accepts but RFC 8032 strict verification rejects."""
+    try:
+        if len(sig) != 64 or len(vk) != 32 or not isinstance(msg, (bytes, bytearray)):
+            return False
+        if _ops.decompress(bytes(vk)) is None:
+            return False
+        if _ops.decompress(bytes(sig[:32])) is None:
+            return False
+        return int.from_bytes(bytes(sig[32:]), "little") < _ops.L
+    except Exception:
+        return False
+
+
 class CpuEd25519Verifier(Ed25519Verifier):
     """Scalar loop over the C library — the measured CPU baseline."""
+
+    def __init__(self):
+        if not _HAVE_CRYPTOGRAPHY:   # fail loudly, not per-signature False
+            raise ImportError("cryptography package required for cpu backend")
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
         out = np.zeros(len(items), dtype=bool)
         for i, (msg, sig, vk) in enumerate(items):
+            if not _precheck(msg, sig, vk):
+                continue
             try:
                 Ed25519PublicKey.from_public_bytes(bytes(vk)).verify(bytes(sig), bytes(msg))
                 out[i] = True
@@ -101,15 +124,19 @@ class JaxEd25519Verifier(Ed25519Verifier):
     Device: one verify_kernel dispatch over the padded batch.
     """
 
-    def __init__(self, min_batch: int = 1):
+    def __init__(self, min_batch: int = 1, cache_size: int = 65536):
+        # verkeys are attacker-supplied; the cache must be bounded (FIFO evict)
         self._pt_cache: dict[bytes, Optional[tuple[int, int]]] = {}
+        self._cache_size = cache_size
         self._min_batch = min_batch
 
     def _decompress_cached(self, vk: bytes) -> Optional[tuple[int, int]]:
-        hit = self._pt_cache.get(vk)
-        if hit is None and vk not in self._pt_cache:
-            hit = _ops.decompress(vk)
-            self._pt_cache[vk] = hit
+        if vk in self._pt_cache:
+            return self._pt_cache[vk]
+        hit = _ops.decompress(vk)
+        if len(self._pt_cache) >= self._cache_size:
+            self._pt_cache.pop(next(iter(self._pt_cache)))
+        self._pt_cache[vk] = hit
         return hit
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
@@ -120,19 +147,23 @@ class JaxEd25519Verifier(Ed25519Verifier):
             return verdict
         idxs, s_vals, h_vals, neg_a, r_aff = [], [], [], [], []
         for i, (msg, sig, vk) in enumerate(items):
-            if len(sig) != 64 or len(vk) != 32:
-                continue
-            a = self._decompress_cached(bytes(vk))
-            if a is None:
-                continue
-            r = _ops.decompress(sig[:32])
-            if r is None:
-                continue
-            s = int.from_bytes(sig[32:], "little")
-            if s >= _ops.L:
-                continue
-            h = int.from_bytes(
-                hashlib.sha512(sig[:32] + vk + msg).digest(), "little") % _ops.L
+            try:
+                msg, sig, vk = bytes(msg), bytes(sig), bytes(vk)
+                if len(sig) != 64 or len(vk) != 32:
+                    continue
+                a = self._decompress_cached(vk)
+                if a is None:
+                    continue
+                r = _ops.decompress(sig[:32])
+                if r is None:
+                    continue
+                s = int.from_bytes(sig[32:], "little")
+                if s >= _ops.L:
+                    continue
+                h = int.from_bytes(
+                    hashlib.sha512(sig[:32] + vk + msg).digest(), "little") % _ops.L
+            except Exception:
+                continue    # contract: malformed input is a False verdict
             idxs.append(i)
             s_vals.append(s)
             h_vals.append(h)

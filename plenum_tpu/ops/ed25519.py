@@ -25,14 +25,15 @@ Design notes (TPU-first):
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 # The limb arithmetic REQUIRES 64-bit integers; without x64 JAX silently
-# truncates to int32 and every verdict is garbage. Force it on import.
+# truncates to int32 and every verdict is garbage. This is a deliberate
+# framework-wide setting (import side effect): all plenum_tpu kernels are
+# explicit about dtypes, and a guard in verify_kernel rejects int32 inputs in
+# case another library flips the flag back.
 jax.config.update("jax_enable_x64", True)
 
 # --- curve constants (RFC 8032) ------------------------------------------
@@ -190,6 +191,8 @@ def verify_kernel(s_bits, h_bits, ax, ay, az, at, rx, ry):
     rx, ry: int64[N, 10] affine coords of R.
     Returns bool[N].
     """
+    if s_bits.dtype != jnp.int64:
+        raise TypeError("verify_kernel needs int64 inputs — jax x64 mode is off")
     n = ax.shape[0]
     ones = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), (n, NLIMB))
     zeros = jnp.zeros((n, NLIMB), jnp.int64)

@@ -1,0 +1,104 @@
+"""State with committed/uncommitted heads over the MPT.
+
+Reference behavior: state/pruning_state.py:14 — `set/get` act on the
+uncommitted head; `commit()` promotes it; `revertToHead` rewinds to any stored
+root (3PC revert path, ref ordering_service._revert:1229). Reads can target
+either head (`get(..., committed=True)` reads the committed root, as request
+handlers do for committed data vs dynamic validation on uncommitted).
+
+Content-addressed trie nodes make revert O(1): both heads are just root
+hashes into the same node store.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from plenum_tpu.storage.kv_store import KeyValueStorage
+from plenum_tpu.storage.kv_memory import KvMemory
+
+from .trie import Trie, BLANK_ROOT
+
+
+class PruningState:
+    def __init__(self, db: Optional[KeyValueStorage] = None):
+        self._db = db if db is not None else KvMemory()
+        root = self._db.try_get(b"__committed_head__") or BLANK_ROOT
+        self._trie = Trie(self._db, root)
+        self._committed_root = root
+
+    # --- writes (uncommitted head) ----------------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._trie.set(key, value)
+
+    def remove(self, key: bytes) -> bool:
+        return self._trie.remove(key)
+
+    # --- reads ------------------------------------------------------------
+
+    def get(self, key: bytes, committed: bool = True) -> Optional[bytes]:
+        if committed:
+            return Trie(self._db, self._committed_root).get(key)
+        return self._trie.get(key)
+
+    def get_for_root(self, key: bytes, root_hash: bytes) -> Optional[bytes]:
+        """Historic read at any stored root (ts-store reads)."""
+        return Trie(self._db, root_hash).get(key)
+
+    def as_dict(self, committed: bool = False) -> dict:
+        trie = Trie(self._db, self._committed_root) if committed else self._trie
+        return trie.to_dict()
+
+    # --- heads ------------------------------------------------------------
+
+    @property
+    def head_hash(self) -> bytes:
+        return self._trie.root_hash
+
+    @property
+    def committed_head_hash(self) -> bytes:
+        return self._committed_root
+
+    def commit(self, root_hash: Optional[bytes] = None) -> None:
+        """Promote the uncommitted head (or an explicit earlier root)."""
+        target = root_hash if root_hash is not None else self._trie.root_hash
+        if target != self._trie.root_hash:
+            # committing a root other than the current head: rewind to it
+            self._trie.root_hash = target
+        self._committed_root = target
+        self._db.put(b"__committed_head__", target)
+
+    def revert_to_head(self, root_hash: Optional[bytes] = None) -> None:
+        """Rewind the uncommitted head (default: back to committed)."""
+        target = root_hash if root_hash is not None else self._committed_root
+        self._trie.root_hash = target
+
+    # --- proofs (ref pruning_state.py:105-123) ----------------------------
+
+    def generate_state_proof(self, key: bytes, root_hash: Optional[bytes] = None,
+                             serialize: bool = False):
+        trie = Trie(self._db, root_hash if root_hash is not None
+                    else self._committed_root)
+        proof = trie.produce_proof(key)
+        if serialize:
+            from . import rlp
+            return rlp.encode(proof)
+        return proof
+
+    @staticmethod
+    def verify_state_proof(root_hash: bytes, key: bytes, value: Optional[bytes],
+                           proof) -> bool:
+        """Check that `key` maps to `value` (None = absent) under root_hash."""
+        from . import rlp as _rlp
+        if isinstance(proof, (bytes, bytearray)):
+            proof = _rlp.decode(bytes(proof))
+        try:
+            present, got = Trie.verify_proof(root_hash, key, list(proof))
+        except Exception:
+            return False
+        if value is None:
+            return not present
+        return present and got == value
+
+    def close(self) -> None:
+        self._db.close()

@@ -1,0 +1,337 @@
+"""Merkle Patricia Trie (Ethereum shape) over a KV node store.
+
+Reference behavior: state/trie/pruning_trie.py:215 — hex-nibble trie with
+RLP-encoded nodes hashed with SHA3-256 (hashlib sha3_256, as the reference's
+state/util/utils.py), content-addressed in a KV db so any historic root stays
+readable; state proofs are the RLP node lists along a key's path.
+
+Node encodings (standard MPT):
+  blank      -> b''
+  leaf       -> [hex_prefix(path, t=1), value]
+  extension  -> [hex_prefix(path, t=0), ref]
+  branch     -> [ref0 .. ref15, value]
+A ref is the node's RLP if shorter than 32 bytes, else its sha3 hash (the
+RLP stored in the db under that hash).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from plenum_tpu.storage.kv_store import KeyValueStorage
+from plenum_tpu.storage.kv_memory import KvMemory
+
+from . import rlp
+
+BLANK_NODE = b""
+BLANK_ROOT = hashlib.sha3_256(rlp.encode(b"")).digest()
+
+
+def sha3(data: bytes) -> bytes:
+    return hashlib.sha3_256(data).digest()
+
+
+def bytes_to_nibbles(key: bytes) -> list[int]:
+    out = []
+    for b in key:
+        out.append(b >> 4)
+        out.append(b & 0x0F)
+    return out
+
+
+def hex_prefix_encode(nibbles: list[int], leaf: bool) -> bytes:
+    flag = 2 if leaf else 0
+    if len(nibbles) % 2:
+        packed = [((flag + 1) << 4) | nibbles[0]]
+        rest = nibbles[1:]
+    else:
+        packed = [flag << 4]
+        rest = nibbles
+    for i in range(0, len(rest), 2):
+        packed.append((rest[i] << 4) | rest[i + 1])
+    return bytes(packed)
+
+
+def hex_prefix_decode(data: bytes) -> tuple[list[int], bool]:
+    if not data:
+        raise rlp.RlpError("empty hex-prefix")
+    flag = data[0] >> 4
+    leaf = bool(flag & 2)
+    nibbles = [data[0] & 0x0F] if flag & 1 else []
+    for b in data[1:]:
+        nibbles.append(b >> 4)
+        nibbles.append(b & 0x0F)
+    return nibbles, leaf
+
+
+class Trie:
+    def __init__(self, db: Optional[KeyValueStorage] = None,
+                 root_hash: bytes = BLANK_ROOT):
+        self.db = db if db is not None else KvMemory()
+        self.root_node = self._decode_ref_root(root_hash)
+
+    # --- refs -------------------------------------------------------------
+
+    def _store(self, node) -> object:
+        """node (decoded form) -> ref (inline rlp-decoded node or 32B hash)."""
+        if node == BLANK_NODE:
+            return b""
+        enc = rlp.encode(node)
+        if len(enc) < 32:
+            return node
+        h = sha3(enc)
+        self.db.put(h, enc)
+        return h
+
+    def _load(self, ref):
+        if ref == b"" or ref == BLANK_NODE:
+            return BLANK_NODE
+        if isinstance(ref, bytes) and len(ref) == 32:
+            enc = self.db.try_get(ref)
+            if enc is None:
+                raise KeyError(f"missing trie node {ref.hex()}")
+            return rlp.decode(enc)
+        return ref          # inline node (list)
+
+    def _decode_ref_root(self, root_hash: bytes):
+        if root_hash == BLANK_ROOT:
+            return BLANK_NODE
+        enc = self.db.try_get(root_hash)
+        if enc is None:
+            raise KeyError(f"unknown state root {root_hash.hex()}")
+        return rlp.decode(enc)
+
+    @property
+    def root_hash(self) -> bytes:
+        if self.root_node == BLANK_NODE:
+            return BLANK_ROOT
+        enc = rlp.encode(self.root_node)
+        h = sha3(enc)
+        self.db.put(h, enc)     # root is always persisted by hash
+        return h
+
+    @root_hash.setter
+    def root_hash(self, value: bytes) -> None:
+        self.root_node = self._decode_ref_root(value)
+
+    # --- node kind --------------------------------------------------------
+
+    @staticmethod
+    def _kind(node) -> str:
+        if node == BLANK_NODE:
+            return "blank"
+        if len(node) == 2:
+            _, leaf = hex_prefix_decode(node[0])
+            return "leaf" if leaf else "extension"
+        return "branch"
+
+    # --- get --------------------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._get(self.root_node, bytes_to_nibbles(key))
+
+    def _get(self, node, path):
+        if node == BLANK_NODE:
+            return None
+        kind = self._kind(node)
+        if kind == "branch":
+            if not path:
+                return node[16] if node[16] != b"" else None
+            sub = self._load(node[path[0]])
+            return self._get(sub, path[1:])
+        nibbles, leaf = hex_prefix_decode(node[0])
+        if leaf:
+            return node[1] if nibbles == path else None
+        if path[:len(nibbles)] == nibbles:
+            return self._get(self._load(node[1]), path[len(nibbles):])
+        return None
+
+    # --- set --------------------------------------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if value == b"":
+            raise ValueError("empty value not allowed (use remove)")
+        self.root_node = self._set(self.root_node, bytes_to_nibbles(key), value)
+
+    def _set(self, node, path, value):
+        if node == BLANK_NODE:
+            return [hex_prefix_encode(path, True), value]
+        kind = self._kind(node)
+        if kind == "branch":
+            if not path:
+                node = list(node)
+                node[16] = value
+                return node
+            node = list(node)
+            sub = self._load(node[path[0]])
+            node[path[0]] = self._store(self._set(sub, path[1:], value))
+            return node
+        nibbles, leaf = hex_prefix_decode(node[0])
+        common = 0
+        while (common < len(nibbles) and common < len(path)
+               and nibbles[common] == path[common]):
+            common += 1
+        if leaf and nibbles == path:
+            return [node[0], value]
+        if not leaf and common == len(nibbles):
+            # descend into extension
+            sub = self._load(node[1])
+            new_sub = self._set(sub, path[common:], value)
+            return [node[0], self._store(new_sub)]
+        # split: build a branch at the divergence point
+        branch = [b""] * 16 + [b""]
+        # remainder of existing node
+        rem = nibbles[common:]
+        if leaf:
+            if rem:
+                branch[rem[0]] = self._store(
+                    [hex_prefix_encode(rem[1:], True), node[1]])
+            else:
+                branch[16] = node[1]
+        else:
+            if rem:
+                if len(rem) == 1:
+                    branch[rem[0]] = node[1]
+                else:
+                    branch[rem[0]] = self._store(
+                        [hex_prefix_encode(rem[1:], False), node[1]])
+            else:  # common == len(nibbles) handled above
+                raise AssertionError("unreachable")
+        # remainder of new path
+        prem = path[common:]
+        if prem:
+            branch[prem[0]] = self._store(
+                [hex_prefix_encode(prem[1:], True), value])
+        else:
+            branch[16] = value
+        if common:
+            return [hex_prefix_encode(path[:common], False), self._store(branch)]
+        return branch
+
+    # --- remove -----------------------------------------------------------
+
+    def remove(self, key: bytes) -> bool:
+        new_root, changed = self._remove(self.root_node, bytes_to_nibbles(key))
+        if changed:
+            self.root_node = new_root
+        return changed
+
+    def _remove(self, node, path):
+        if node == BLANK_NODE:
+            return node, False
+        kind = self._kind(node)
+        if kind == "branch":
+            if not path:
+                if node[16] == b"":
+                    return node, False
+                node = list(node)
+                node[16] = b""
+                return self._normalize_branch(node), True
+            sub = self._load(node[path[0]])
+            new_sub, changed = self._remove(sub, path[1:])
+            if not changed:
+                return node, False
+            node = list(node)
+            node[path[0]] = self._store(new_sub)
+            return self._normalize_branch(node), True
+        nibbles, leaf = hex_prefix_decode(node[0])
+        if leaf:
+            return (BLANK_NODE, True) if nibbles == path else (node, False)
+        if path[:len(nibbles)] != nibbles:
+            return node, False
+        new_sub, changed = self._remove(self._load(node[1]), path[len(nibbles):])
+        if not changed:
+            return node, False
+        return self._merge_extension(nibbles, new_sub), True
+
+    def _normalize_branch(self, branch):
+        """Collapse a branch left with <2 occupied slots."""
+        occupied = [i for i in range(16) if branch[i] != b""]
+        has_value = branch[16] != b""
+        if len(occupied) + (1 if has_value else 0) > 1:
+            return branch
+        if has_value:
+            return [hex_prefix_encode([], True), branch[16]]
+        if not occupied:
+            return BLANK_NODE
+        i = occupied[0]
+        sub = self._load(branch[i])
+        return self._merge_extension([i], sub)
+
+    def _merge_extension(self, prefix_nibbles, sub):
+        """Prepend prefix_nibbles to sub (collapsing chains)."""
+        if sub == BLANK_NODE:
+            return BLANK_NODE
+        kind = self._kind(sub)
+        if kind == "branch":
+            if not prefix_nibbles:
+                return sub
+            return [hex_prefix_encode(prefix_nibbles, False), self._store(sub)]
+        nibbles, leaf = hex_prefix_decode(sub[0])
+        return [hex_prefix_encode(prefix_nibbles + nibbles, leaf), sub[1]]
+
+    # --- iteration / export ----------------------------------------------
+
+    def to_dict(self) -> dict[bytes, bytes]:
+        out = {}
+        self._walk(self.root_node, [], out)
+        return out
+
+    def _walk(self, node, path, out):
+        if node == BLANK_NODE:
+            return
+        kind = self._kind(node)
+        if kind == "branch":
+            if node[16] != b"":
+                out[self._nibbles_to_bytes(path)] = node[16]
+            for i in range(16):
+                if node[i] != b"":
+                    self._walk(self._load(node[i]), path + [i], out)
+            return
+        nibbles, leaf = hex_prefix_decode(node[0])
+        if leaf:
+            out[self._nibbles_to_bytes(path + nibbles)] = node[1]
+        else:
+            self._walk(self._load(node[1]), path + nibbles, out)
+
+    @staticmethod
+    def _nibbles_to_bytes(nibbles) -> bytes:
+        assert len(nibbles) % 2 == 0
+        return bytes((nibbles[i] << 4) | nibbles[i + 1]
+                     for i in range(0, len(nibbles), 2))
+
+    # --- proofs (ref pruning_state.py:105-123) ----------------------------
+
+    def produce_proof(self, key: bytes) -> list[bytes]:
+        """RLP-encoded nodes along the path of `key` (root first)."""
+        proof: list[bytes] = []
+        self._prove(self.root_node, bytes_to_nibbles(key), proof, True)
+        return proof
+
+    def _prove(self, node, path, proof, is_root):
+        if node == BLANK_NODE:
+            return
+        enc = rlp.encode(node)
+        if is_root or len(enc) >= 32:
+            proof.append(enc)
+        kind = self._kind(node)
+        if kind == "branch":
+            if path:
+                self._prove(self._load(node[path[0]]), path[1:], proof, False)
+            return
+        nibbles, leaf = hex_prefix_decode(node[0])
+        if not leaf and path[:len(nibbles)] == nibbles:
+            self._prove(self._load(node[1]), path[len(nibbles):], proof, False)
+
+    @staticmethod
+    def verify_proof(root_hash: bytes, key: bytes, proof: list[bytes]):
+        """-> (present: bool, value or None); raises on malformed proof."""
+        db = KvMemory()
+        for p in proof:
+            db.put(sha3(p), p)
+        try:
+            trie = Trie(db, root_hash)
+            value = trie.get(key)
+        except KeyError as e:
+            raise rlp.RlpError(f"incomplete proof: {e}")
+        return (value is not None, value)

@@ -195,3 +195,35 @@ def test_base58_roundtrip():
     assert b58encode(b"\x00\x00a") .startswith("11")
     with pytest.raises(ValueError):
         b58decode("0OIl")
+
+
+# --- backend-agreement regression tests (review round 3) ------------------
+
+def test_backends_agree_on_noncanonical_encodings():
+    """Non-canonical point encodings (y >= p) must be rejected by BOTH
+    backends — a backend verdict split would fork the pool."""
+    bad_vk = (ops.P + 1).to_bytes(32, "little")
+    sig = bad_vk + (0).to_bytes(32, "little")
+    for backend in ("cpu", "jax"):
+        v = make_verifier(backend)
+        assert not v.verify(b"msg", sig, bad_vk), backend
+    # canonical-but-valid still passes both
+    s = Ed25519Signer(b"\x09" * 32)
+    m = b"agree"
+    for backend in ("cpu", "jax"):
+        assert make_verifier(backend).verify(m, s.sign(m), s.verkey), backend
+
+
+def test_non_bytes_items_return_false_not_raise():
+    for backend in ("cpu", "jax"):
+        v = make_verifier(backend)
+        out = v.verify_batch([("str-msg", "s" * 64, b"\x00" * 32),
+                              (b"m", None, b"\x00" * 32)])
+        assert not out.any(), backend
+
+
+def test_pt_cache_bounded():
+    v = JaxEd25519Verifier(cache_size=4)
+    for i in range(10):
+        v._decompress_cached(i.to_bytes(32, "little"))
+    assert len(v._pt_cache) == 4
