@@ -99,8 +99,9 @@ class ViewChange(MessageBase):
     typename = "VIEW_CHANGE"
     view_no: int
     stable_checkpoint: int
-    prepared: tuple[tuple[int, int, str], ...]     # (orig_view_no, pp_seq_no, digest)
-    preprepared: tuple[tuple[int, int, str], ...]
+    # BatchID 4-tuples: (view_no, pp_view_no, pp_seq_no, pp_digest)
+    prepared: tuple[tuple[int, int, int, str], ...]
+    preprepared: tuple[tuple[int, int, int, str], ...]
     checkpoints: tuple[tuple[int, int, int, str], ...]  # Checkpoint tuples (view,start,end,digest)
 
     def validate(self) -> None:
@@ -121,7 +122,7 @@ class NewView(MessageBase):
     view_no: int
     view_changes: tuple[tuple[str, str], ...]      # (author, vc digest)
     checkpoint: tuple[int, int, int, str]          # selected stable checkpoint
-    batches: tuple[tuple[int, int, str], ...]      # (orig_view_no, pp_seq_no, digest) to re-order
+    batches: tuple[tuple[int, int, int, str], ...]  # BatchIDs to re-order in the new view
 
 
 @wire_message

@@ -1,0 +1,172 @@
+"""BLS wiring into the 3PC flow: sign state roots at COMMIT, aggregate at
+order time, embed the previous batch's multi-sig into the next PRE-PREPARE.
+
+Reference behavior: plenum/bls/bls_bft_replica_plenum.py:21 —
+update_pre_prepare :80 / validate_pre_prepare :43 / update_commit :99
+(_sign_state :227) / validate_commit :55 / process_commit :144 /
+process_order :154 (_calculate_all_multi_sigs :261) — and plenum/bls/
+bls_store.py (root-hash → multi-sig KV used by state-proof reads).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from plenum_tpu.common.node_messages import Commit, PrePrepare
+from plenum_tpu.common.quorums import Quorums
+from plenum_tpu.common.serialization import json_dumps, json_loads
+from plenum_tpu.crypto.bls import BlsCryptoSigner, BlsCryptoVerifier
+from plenum_tpu.crypto.multi_signature import (MultiSignature,
+                                               MultiSignatureValue)
+from plenum_tpu.storage.kv_store import KeyValueStorage
+
+
+class BlsKeyRegister:
+    """node name → BLS verkey, sourced from the pool ledger NODE txns
+    (ref plenum/bls/bls_key_register_pool_manager.py). Injectable for tests."""
+
+    def __init__(self, keys: Optional[dict[str, str]] = None):
+        self._keys: dict[str, str] = dict(keys or {})
+
+    def get_key_by_name(self, node_name: str) -> Optional[str]:
+        return self._keys.get(node_name)
+
+    def set_key(self, node_name: str, verkey: Optional[str]) -> None:
+        if verkey is None:
+            self._keys.pop(node_name, None)
+        else:
+            self._keys[node_name] = verkey
+
+    def known_nodes(self) -> list[str]:
+        return list(self._keys)
+
+
+class BlsStore:
+    """Persistent root-hash → MultiSignature map consulted by state-proof
+    reads (ref plenum/bls/bls_store.py)."""
+
+    def __init__(self, kv: KeyValueStorage):
+        self._kv = kv
+
+    def put(self, multi_sig: MultiSignature) -> None:
+        self._kv.put(multi_sig.value.state_root_hash.encode(),
+                     json_dumps(multi_sig.to_list()).encode())
+
+    def get(self, state_root_hash: str) -> Optional[MultiSignature]:
+        data = self._kv.get(state_root_hash.encode())
+        if data is None:
+            return None
+        return MultiSignature.from_list(json_loads(data))
+
+
+class BlsBftReplica:
+    PPR_NO_BLS_MULTISIG = 0      # benign: previous batch had no quorum yet
+    PPR_BLS_MULTISIG_WRONG = 1
+    CM_BLS_SIG_WRONG = 2
+
+    def __init__(self,
+                 node_name: str,
+                 bls_signer: Optional[BlsCryptoSigner],
+                 bls_verifier: BlsCryptoVerifier,
+                 key_register: BlsKeyRegister,
+                 bls_store: Optional[BlsStore] = None,
+                 quorums: Optional[Quorums] = None):
+        self._node_name = node_name
+        self._signer = bls_signer
+        self._verifier = bls_verifier
+        self._register = key_register
+        self._store = bls_store
+        self._quorums = quorums or Quorums(4)
+        # (view_no, pp_seq_no) -> {node_name: sig}
+        self._sigs: dict[tuple[int, int], dict[str, str]] = {}
+        # state_root -> MultiSignature for recently ordered batches
+        self._recent_multi_sigs: dict[str, MultiSignature] = {}
+
+    def set_quorums(self, quorums: Quorums) -> None:
+        self._quorums = quorums
+
+    # --- signed payload ---------------------------------------------------
+
+    @staticmethod
+    def _signed_value(pre_prepare: PrePrepare) -> MultiSignatureValue:
+        return MultiSignatureValue(
+            ledger_id=pre_prepare.ledger_id,
+            state_root_hash=pre_prepare.state_root,
+            pool_state_root_hash=pre_prepare.pool_state_root,
+            txn_root_hash=pre_prepare.txn_root,
+            timestamp=pre_prepare.pp_time)
+
+    # --- PRE-PREPARE ------------------------------------------------------
+
+    def update_pre_prepare(self, params: dict, state_root: str) -> dict:
+        """Attach the previous batch's aggregated multi-sig (by state root)."""
+        ms = self._recent_multi_sigs.get(state_root)
+        if ms is not None:
+            params["bls_multi_sig"] = tuple(ms.to_list())
+        return params
+
+    def validate_pre_prepare(self, pre_prepare: PrePrepare, sender: str) -> Optional[int]:
+        if pre_prepare.bls_multi_sig is None:
+            return None
+        try:
+            ms = MultiSignature.from_list(list(pre_prepare.bls_multi_sig))
+        except (ValueError, TypeError, IndexError, KeyError):
+            return self.PPR_BLS_MULTISIG_WRONG
+        verkeys = [self._register.get_key_by_name(n) for n in ms.participants]
+        if any(v is None for v in verkeys):
+            return self.PPR_BLS_MULTISIG_WRONG
+        if not self._quorums.bls_signatures.is_reached(len(ms.participants)):
+            return self.PPR_BLS_MULTISIG_WRONG
+        if not self._verifier.verify_multi_sig(ms.signature,
+                                               ms.value.as_single_value(),
+                                               verkeys):
+            return self.PPR_BLS_MULTISIG_WRONG
+        return None
+
+    # --- COMMIT -----------------------------------------------------------
+
+    def update_commit(self, params: dict, pre_prepare: PrePrepare) -> dict:
+        if self._signer is not None:
+            value = self._signed_value(pre_prepare)
+            params["bls_sig"] = self._signer.sign(value.as_single_value())
+        return params
+
+    def validate_commit(self, commit: Commit, sender_node: str,
+                        pre_prepare: PrePrepare) -> Optional[int]:
+        if commit.bls_sig is None:
+            return None
+        verkey = self._register.get_key_by_name(sender_node)
+        if verkey is None:
+            return None           # node has no registered BLS key: sig ignored
+        value = self._signed_value(pre_prepare)
+        if not self._verifier.verify_sig(commit.bls_sig,
+                                         value.as_single_value(), verkey):
+            return self.CM_BLS_SIG_WRONG
+        return None
+
+    def process_commit(self, commit: Commit, sender_node: str) -> None:
+        if commit.bls_sig is None:
+            return
+        key = (commit.view_no, commit.pp_seq_no)
+        self._sigs.setdefault(key, {})[sender_node] = commit.bls_sig
+
+    # --- order ------------------------------------------------------------
+
+    def process_order(self, key: tuple[int, int],
+                      pre_prepare: PrePrepare) -> Optional[MultiSignature]:
+        sigs = self._sigs.get(key, {})
+        if not self._quorums.bls_signatures.is_reached(len(sigs)):
+            return None
+        participants = tuple(sorted(sigs))
+        agg = self._verifier.create_multi_sig([sigs[n] for n in participants])
+        ms = MultiSignature(signature=agg, participants=participants,
+                            value=self._signed_value(pre_prepare))
+        self._recent_multi_sigs[pre_prepare.state_root] = ms
+        if len(self._recent_multi_sigs) > 10:
+            oldest = next(iter(self._recent_multi_sigs))
+            del self._recent_multi_sigs[oldest]
+        if self._store is not None:
+            self._store.put(ms)
+        return ms
+
+    def gc(self, stable_3pc: tuple[int, int]) -> None:
+        self._sigs = {k: v for k, v in self._sigs.items() if k > stable_3pc}

@@ -1,0 +1,601 @@
+"""The 3PC ordering hot loop: PRE-PREPARE → PREPARE → COMMIT → Ordered.
+
+Reference behavior: plenum/server/consensus/ordering_service.py:60 —
+process_preprepare :501, process_prepare :223, process_commit :436, batch
+creation send_3pc_batch :1961 / create_3pc_batch :2038, in-order emission
+_do_order :1475, out-of-order commit stash :191,1642, uncommitted apply/revert
+_apply_pre_prepare :1138 / _revert :1229, and the view-change re-ordering hooks
+:2380-2455. Message admission mirrors ordering_service_msg_validator.py:
+discard stale traffic, stash future-view / outside-watermark / catching-up
+traffic under typed reasons and replay when the blocking condition clears.
+
+Only the master instance applies requests to uncommitted state; backups order
+the same traffic for the RBFT monitor comparison without touching state
+(SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from plenum_tpu.common.event_bus import ExternalBus, InternalBus
+from plenum_tpu.common.internal_messages import (NewViewCheckpointsApplied,
+                                                 RaisedSuspicion, ReqKey,
+                                                 RequestPropagates,
+                                                 ViewChangeStarted)
+from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID, VALID_LEDGER_IDS,
+                                             Commit, Ordered, PrePrepare,
+                                             Prepare)
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.stashing import (DISCARD, PROCESS, STASH, StashReason,
+                                        StashingRouter)
+from plenum_tpu.common.suspicion_codes import Suspicions
+from plenum_tpu.common.timer import TimerService
+from plenum_tpu.config import Config
+
+from .batch_executor import AppliedBatch, BatchExecutor
+from .batch_id import BatchID
+from .bls_bft_replica import BlsBftReplica
+from .consensus_shared_data import ConsensusSharedData
+
+
+def _orig_view(pp: PrePrepare) -> int:
+    """Original view of a (possibly re-ordered) batch; view 0 is a valid
+    original view, so never use `or` here."""
+    return pp.original_view_no if pp.original_view_no is not None else pp.view_no
+
+
+class OrderingService:
+    def __init__(self,
+                 data: ConsensusSharedData,
+                 timer: TimerService,
+                 bus: InternalBus,
+                 network: ExternalBus,
+                 executor: Optional[BatchExecutor],
+                 bls: Optional[BlsBftReplica] = None,
+                 config: Optional[Config] = None,
+                 get_request: Optional[Callable[[str], Optional[Request]]] = None):
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._network = network
+        self._executor = executor
+        self._bls = bls
+        self._config = config or Config()
+        self._get_request = get_request or (lambda digest: None)
+
+        # 3PC logs (all keyed by (view_no, pp_seq_no))
+        self.sent_preprepares: dict[tuple[int, int], PrePrepare] = {}
+        self.prePrepares: dict[tuple[int, int], PrePrepare] = {}
+        self.prepares: dict[tuple[int, int], dict[str, Prepare]] = {}
+        self.commits: dict[tuple[int, int], dict[str, Commit]] = {}
+        self.ordered: set[tuple[int, int]] = set()
+        self._commits_sent: set[tuple[int, int]] = set()
+        self._stashed_ooo_commits: dict[tuple[int, int], PrePrepare] = {}
+        # Old-view pre-prepares kept for re-ordering after a view change,
+        # keyed by (original view, pp_seq_no).
+        self.old_view_preprepares: dict[tuple[int, int], PrePrepare] = {}
+
+        # Finalized requests awaiting batching (primary only), per ledger.
+        self.request_queues: dict[int, OrderedDict] = {
+            lid: OrderedDict() for lid in VALID_LEDGER_IDS}
+        # Master-only stack of applied-but-unordered batches for revert.
+        self._applied_unordered: list[tuple[int, BatchID]] = []
+
+        self._stasher = StashingRouter()
+        self._stasher.subscribe(PrePrepare, self.process_preprepare)
+        self._stasher.subscribe(Prepare, self.process_prepare)
+        self._stasher.subscribe(Commit, self.process_commit)
+        self._stasher.subscribe_to(network)
+
+        bus.subscribe(ReqKey, self.process_req_key)
+        bus.subscribe(ViewChangeStarted, self.process_view_change_started)
+        bus.subscribe(NewViewCheckpointsApplied,
+                      self.process_new_view_checkpoints_applied)
+
+        self._batch_wait_scheduled = False
+
+    # ------------------------------------------------------------------ #
+    # request intake                                                     #
+    # ------------------------------------------------------------------ #
+
+    def process_req_key(self, msg: ReqKey) -> None:
+        """A finalized request became available for ordering."""
+        req = self._get_request(msg.digest)
+        if req is None:
+            return
+        ledger_id = (self._executor.ledger_id_for(req)
+                     if self._executor else DOMAIN_LEDGER_ID)
+        self.request_queues.setdefault(ledger_id, OrderedDict())[msg.digest] = None
+        self._stasher.process_all_stashed(StashReason.MISSING_REQUESTS)
+
+    # ------------------------------------------------------------------ #
+    # batch creation (primary)                                           #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def is_primary(self) -> bool:
+        return self._data.is_primary
+
+    def service(self) -> None:
+        """Called each prod cycle: primaries turn queued requests into batches."""
+        if not self.is_primary or self._data.waiting_for_new_view:
+            return
+        if not self._data.is_participating:
+            return
+        self.send_3pc_batch()
+
+    def send_3pc_batch(self, ledger_id: Optional[int] = None,
+                       force_empty: bool = False) -> int:
+        """Create and broadcast PRE-PREPAREs from queued requests
+        (ref send_3pc_batch :1961). Returns number of batches sent."""
+        sent = 0
+        ledgers = [ledger_id] if ledger_id is not None else list(self.request_queues)
+        for lid in ledgers:
+            queue = self.request_queues.setdefault(lid, OrderedDict())
+            if not queue and not force_empty:
+                continue
+            while queue or force_empty:
+                if self._data.pp_seq_no + 1 > self._data.high_watermark:
+                    break
+                digests = []
+                while queue and len(digests) < self._config.Max3PCBatchSize:
+                    digests.append(queue.popitem(last=False)[0])
+                self._send_one_batch(lid, digests)
+                sent += 1
+                if force_empty:
+                    break
+        return sent
+
+    def _send_one_batch(self, ledger_id: int, digests: list[str]) -> None:
+        reqs = [r for r in (self._get_request(d) for d in digests) if r is not None]
+        pp_time = self._timer.get_current_time()
+        view_no = self._data.view_no
+        pp_seq_no = self._data.pp_seq_no + 1
+        applied = self._apply(ledger_id, reqs, pp_time, view_no, pp_seq_no)
+        params = dict(
+            inst_id=self._data.inst_id,
+            view_no=view_no,
+            pp_seq_no=pp_seq_no,
+            pp_time=pp_time,
+            req_idr=tuple(applied.valid_digests),
+            discarded=tuple(applied.discarded),
+            digest=self._batch_digest(applied.valid_digests, view_no, pp_seq_no),
+            ledger_id=ledger_id,
+            state_root=applied.state_root,
+            txn_root=applied.txn_root,
+            pool_state_root=applied.pool_state_root,
+            audit_txn_root=applied.audit_txn_root,
+        )
+        if self._bls is not None:
+            params = self._bls.update_pre_prepare(params, self._last_state_root(ledger_id))
+        pre_prepare = PrePrepare(**params)
+        self._data.pp_seq_no = pp_seq_no
+        self._data.last_batch_timestamp = pp_time
+        key = (view_no, pp_seq_no)
+        self.sent_preprepares[key] = pre_prepare
+        self.prePrepares[key] = pre_prepare
+        batch_id = BatchID(view_no, _orig_view(pre_prepare),
+                           pp_seq_no, pre_prepare.digest)
+        self._data.preprepare_batch(batch_id)
+        if self._data.is_master:
+            self._applied_unordered.append((ledger_id, batch_id))
+        self._network.send(pre_prepare)
+
+    def _apply(self, ledger_id, reqs, pp_time, view_no, pp_seq_no) -> AppliedBatch:
+        if self._data.is_master and self._executor is not None:
+            return self._executor.apply_batch(ledger_id, reqs, pp_time,
+                                              view_no, pp_seq_no)
+        digests = tuple(r.digest for r in reqs)
+        return AppliedBatch("", "", "", "", digests, ())
+
+    def _last_state_root(self, ledger_id: int) -> str:
+        """State root of the previous batch on this ledger (what the previous
+        multi-sig signed) — used to look up the sig to embed."""
+        for key in sorted(self.prePrepares, reverse=True):
+            pp = self.prePrepares[key]
+            if pp.ledger_id == ledger_id and key in self.ordered:
+                return pp.state_root
+        return ""
+
+    @staticmethod
+    def _batch_digest(digests, view_no: int, pp_seq_no: int) -> str:
+        import hashlib
+        h = hashlib.sha256()
+        h.update(f"{view_no}:{pp_seq_no}:".encode())
+        for d in digests:
+            h.update(d.encode())
+        return h.hexdigest()
+
+    # ------------------------------------------------------------------ #
+    # admission control                                                  #
+    # ------------------------------------------------------------------ #
+
+    def _validate(self, msg) -> object:
+        """PROCESS / DISCARD / STASH(reason) — ref ordering_service_msg_validator."""
+        if msg.inst_id != self._data.inst_id:
+            return DISCARD
+        if not self._data.is_participating:
+            return STASH(StashReason.CATCHING_UP)
+        if msg.view_no < self._data.view_no:
+            return DISCARD
+        if msg.view_no > self._data.view_no:
+            return STASH(StashReason.FUTURE_VIEW)
+        if self._data.waiting_for_new_view:
+            return STASH(StashReason.WAITING_FOR_NEW_VIEW)
+        if (msg.view_no, msg.pp_seq_no) in self.ordered:
+            return DISCARD
+        if msg.pp_seq_no <= self._data.low_watermark:
+            return DISCARD
+        if msg.pp_seq_no > self._data.high_watermark:
+            return STASH(StashReason.OUTSIDE_WATERMARKS)
+        return PROCESS
+
+    def _suspect(self, suspicion, sender: str) -> None:
+        self._bus.send(RaisedSuspicion(inst_id=self._data.inst_id,
+                                       code=suspicion.code,
+                                       reason=f"{suspicion.reason} (from {sender})"))
+
+    # ------------------------------------------------------------------ #
+    # PRE-PREPARE                                                        #
+    # ------------------------------------------------------------------ #
+
+    def process_preprepare(self, msg: PrePrepare, sender: str):
+        verdict = self._validate(msg)
+        if verdict is not PROCESS:
+            return verdict
+        if sender != self._data.primary_name:
+            self._suspect(Suspicions.PPR_FRM_NON_PRIMARY, sender)
+            return DISCARD
+        key = (msg.view_no, msg.pp_seq_no)
+        if key in self.prePrepares and self.prePrepares[key].digest != msg.digest:
+            self._suspect(Suspicions.DUPLICATE_PPR_SENT, sender)
+            return DISCARD
+        if key in self.sent_preprepares:
+            return PROCESS                         # our own broadcast echoed
+        # Re-ordered batches legitimately carry their original timestamp; only
+        # fresh batches face the clock-deviation check.
+        is_reordered = (msg.original_view_no is not None
+                        and msg.original_view_no != msg.view_no)
+        now = self._timer.get_current_time()
+        if (not is_reordered and
+                abs(msg.pp_time - now) > self._config.ACCEPTABLE_DEVIATION_PREPREPARE_SECS):
+            self._suspect(Suspicions.PPR_TIME_WRONG, sender)
+            return DISCARD
+        # Expect strictly consecutive batches from one primary.
+        expected = self._last_preprepared_seq() + 1
+        if msg.pp_seq_no > expected:
+            return STASH(StashReason.FUTURE_3PC)
+        # All referenced requests must be finalized locally before we can apply.
+        missing = [d for d in msg.req_idr if self._get_request(d) is None]
+        if missing and self._data.is_master:
+            self._bus.send(RequestPropagates(bad_requests=tuple(missing)))
+            return STASH(StashReason.MISSING_REQUESTS)
+        if self._bls is not None:
+            fault = self._bls.validate_pre_prepare(msg, sender)
+            if fault is not None:
+                self._suspect(Suspicions.PPR_BLS_MULTISIG_WRONG, sender)
+                return DISCARD
+        return self._process_valid_preprepare(msg, sender)
+
+    def _last_preprepared_seq(self) -> int:
+        seqs = [k[1] for k in self.prePrepares if k[0] == self._data.view_no]
+        floor = max(self._data.low_watermark, self._data.last_ordered_3pc[1])
+        return max(seqs + [floor])
+
+    def _process_valid_preprepare(self, msg: PrePrepare, sender: str):
+        key = (msg.view_no, msg.pp_seq_no)
+        # Re-apply the batch and cross-check every root (ref :871-931).
+        if self._data.is_master and self._executor is not None:
+            reqs = [self._get_request(d) for d in msg.req_idr]
+            applied = self._executor.apply_batch(msg.ledger_id, reqs, msg.pp_time,
+                                                 msg.view_no, msg.pp_seq_no)
+            fault = None
+            if tuple(applied.discarded) != tuple(msg.discarded):
+                fault = Suspicions.PPR_REJECT_WRONG
+            elif applied.state_root != msg.state_root:
+                fault = Suspicions.PPR_STATE_WRONG
+            elif applied.txn_root != msg.txn_root:
+                fault = Suspicions.PPR_TXN_WRONG
+            elif (msg.audit_txn_root and
+                  applied.audit_txn_root != msg.audit_txn_root):
+                fault = Suspicions.PPR_AUDIT_TXN_ROOT_WRONG
+            if fault is not None:
+                self._executor.revert_last_batch(msg.ledger_id)
+                self._suspect(fault, sender)
+                return DISCARD
+            batch_id = BatchID(msg.view_no, _orig_view(msg),
+                               msg.pp_seq_no, msg.digest)
+            self._applied_unordered.append((msg.ledger_id, batch_id))
+        else:
+            batch_id = BatchID(msg.view_no, _orig_view(msg),
+                               msg.pp_seq_no, msg.digest)
+        self.prePrepares[key] = msg
+        self._data.preprepare_batch(batch_id)
+        # Commits that raced ahead of this pre-prepare: validate their BLS
+        # sigs now that we know the signed roots; evict liars.
+        if self._bls is not None:
+            for voter, commit in list(self.commits.get(key, {}).items()):
+                if self._bls.validate_commit(commit, voter, msg) is not None:
+                    del self.commits[key][voter]
+                    self._suspect(Suspicions.CM_BLS_WRONG, voter)
+                else:
+                    self._bls.process_commit(commit, voter)
+        self._send_prepare(msg)
+        # A stashed future pre-prepare may now be consecutive.
+        self._stasher.process_all_stashed(StashReason.FUTURE_3PC)
+        self._try_prepare_quorum(key)
+        return PROCESS
+
+    def _send_prepare(self, pp: PrePrepare) -> None:
+        if self.is_primary:
+            return                                  # primary never sends PREPARE
+        prepare = Prepare(inst_id=pp.inst_id, view_no=pp.view_no,
+                          pp_seq_no=pp.pp_seq_no, pp_time=pp.pp_time,
+                          digest=pp.digest, state_root=pp.state_root,
+                          txn_root=pp.txn_root, audit_txn_root=pp.audit_txn_root)
+        self._network.send(prepare)
+        # Our own vote counts toward the prepare quorum.
+        key = (pp.view_no, pp.pp_seq_no)
+        self.prepares.setdefault(key, {})[self._data.node_name] = prepare
+
+    # ------------------------------------------------------------------ #
+    # PREPARE                                                            #
+    # ------------------------------------------------------------------ #
+
+    def process_prepare(self, msg: Prepare, sender: str):
+        verdict = self._validate(msg)
+        if verdict is not PROCESS:
+            return verdict
+        if sender == self._data.primary_name:
+            self._suspect(Suspicions.PR_FRM_PRIMARY, sender)
+            return DISCARD
+        key = (msg.view_no, msg.pp_seq_no)
+        votes = self.prepares.setdefault(key, {})
+        if sender in votes:
+            if votes[sender].digest != msg.digest:
+                self._suspect(Suspicions.DUPLICATE_PR_SENT, sender)
+            return DISCARD
+        pp = self.prePrepares.get(key)
+        if pp is not None and msg.digest != pp.digest:
+            self._suspect(Suspicions.PR_DIGEST_WRONG, sender)
+            return DISCARD
+        votes[sender] = msg
+        self._try_prepare_quorum(key)
+        return PROCESS
+
+    def _try_prepare_quorum(self, key: tuple[int, int]) -> None:
+        pp = self.prePrepares.get(key)
+        if pp is None or key in self._commits_sent:
+            return
+        votes = self.prepares.get(key, {})
+        matching = sum(1 for p in votes.values() if p.digest == pp.digest)
+        if not self._data.quorums.prepare.is_reached(matching):
+            return
+        self._data.prepare_batch(BatchID(pp.view_no, _orig_view(pp),
+                                         pp.pp_seq_no, pp.digest))
+        self._send_commit(pp, key)
+
+    def _send_commit(self, pp: PrePrepare, key: tuple[int, int]) -> None:
+        params = dict(inst_id=pp.inst_id, view_no=key[0], pp_seq_no=key[1])
+        if self._bls is not None:
+            params = self._bls.update_commit(params, pp)
+        commit = Commit(**params)
+        self._commits_sent.add(key)
+        self._network.send(commit)
+        # Count our own commit vote.
+        self.commits.setdefault(key, {})[self._data.node_name] = commit
+        if self._bls is not None:
+            self._bls.process_commit(commit, self._data.node_name)
+        self._try_order(key)
+
+    # ------------------------------------------------------------------ #
+    # COMMIT                                                             #
+    # ------------------------------------------------------------------ #
+
+    def process_commit(self, msg: Commit, sender: str):
+        verdict = self._validate(msg)
+        if verdict is not PROCESS:
+            return verdict
+        key = (msg.view_no, msg.pp_seq_no)
+        votes = self.commits.setdefault(key, {})
+        if sender in votes:
+            return DISCARD
+        pp = self.prePrepares.get(key)
+        if pp is not None and self._bls is not None:
+            fault = self._bls.validate_commit(msg, sender, pp)
+            if fault is not None:
+                self._suspect(Suspicions.CM_BLS_WRONG, sender)
+                return DISCARD
+        votes[sender] = msg
+        # A commit arriving before its pre-prepare can't have its BLS sig
+        # checked yet; _process_valid_preprepare re-validates stored votes, so
+        # only validated sigs ever reach aggregation.
+        if pp is not None and self._bls is not None:
+            self._bls.process_commit(msg, sender)
+        self._try_order(key)
+        return PROCESS
+
+    # ------------------------------------------------------------------ #
+    # ordering                                                           #
+    # ------------------------------------------------------------------ #
+
+    def _can_order(self, key: tuple[int, int]) -> bool:
+        if key in self.ordered:
+            return False
+        if self.prePrepares.get(key) is None:
+            return False
+        if key not in self._commits_sent:
+            return False                 # we haven't prepared it ourselves yet
+        votes = len(self.commits.get(key, {}))
+        return self._data.quorums.commit.is_reached(votes)
+
+    def _try_order(self, key: tuple[int, int]) -> None:
+        if not self._can_order(key):
+            return
+        pp = self.prePrepares[key]
+        # In-order constraint: pp_seq_no must directly follow the last ordered
+        # batch; otherwise stash the completed commit (ref :191,1642).
+        if key[1] != self._data.last_ordered_3pc[1] + 1:
+            self._stashed_ooo_commits[key] = pp
+            return
+        self._order(key, pp)
+        # Drain any consecutive stashed completions.
+        while True:
+            next_key = self._find_stashed_next()
+            if next_key is None:
+                break
+            self._order(next_key, self._stashed_ooo_commits.pop(next_key))
+
+    def _find_stashed_next(self):
+        for k in sorted(self._stashed_ooo_commits):
+            if k[1] == self._data.last_ordered_3pc[1] + 1 and self._can_order(k):
+                return k
+        return None
+
+    def _order(self, key: tuple[int, int], pp: PrePrepare) -> None:
+        self.ordered.add(key)
+        self._data.last_ordered_3pc = key
+        # Ordered requests must never be re-proposed from this node's queue.
+        for queue in self.request_queues.values():
+            for digest in pp.req_idr:
+                queue.pop(digest, None)
+        batch_id = BatchID(pp.view_no, _orig_view(pp),
+                           pp.pp_seq_no, pp.digest)
+        self._data.free_batch(batch_id)
+        self._applied_unordered = [(lid, b) for (lid, b) in self._applied_unordered
+                                   if b != batch_id]
+        if self._bls is not None:
+            self._bls.process_order(key, pp)
+        ordered = Ordered(inst_id=pp.inst_id, view_no=key[0],
+                          pp_seq_no=key[1], pp_time=pp.pp_time,
+                          req_idr=pp.req_idr, discarded=pp.discarded,
+                          ledger_id=pp.ledger_id, state_root=pp.state_root,
+                          txn_root=pp.txn_root,
+                          audit_txn_root=pp.audit_txn_root,
+                          original_view_no=pp.original_view_no)
+        self._bus.send(ordered)
+
+    # ------------------------------------------------------------------ #
+    # revert / catchup / view change                                     #
+    # ------------------------------------------------------------------ #
+
+    def revert_unordered_batches(self) -> int:
+        """Undo every applied-but-unordered batch, newest first (ref :1229)."""
+        count = 0
+        while self._applied_unordered:
+            ledger_id, batch_id = self._applied_unordered.pop()
+            if self._executor is not None and self._data.is_master:
+                self._executor.revert_last_batch(ledger_id)
+            self._data.free_batch(batch_id)
+            # Reverted requests go back in the queue (ref :2201) — they will
+            # either be re-ordered from the old-view pre-prepare or re-batched.
+            pp = self.prePrepares.get((batch_id.view_no, batch_id.pp_seq_no))
+            if pp is not None:
+                queue = self.request_queues.setdefault(ledger_id, OrderedDict())
+                for digest in pp.req_idr:
+                    queue[digest] = None
+            count += 1
+        return count
+
+    def catchup_started(self) -> None:
+        self.revert_unordered_batches()
+        self._data.is_participating = False
+
+    def caught_up_till_3pc(self, last_3pc: tuple[int, int]) -> None:
+        """Adopt the 3PC position reached through catchup (ref :2223)."""
+        if last_3pc > self._data.last_ordered_3pc:
+            self._data.last_ordered_3pc = last_3pc
+            self._data.pp_seq_no = max(self._data.pp_seq_no, last_3pc[1])
+            self._data.low_watermark = max(self._data.low_watermark, last_3pc[1])
+            self._data.stable_checkpoint = max(self._data.stable_checkpoint,
+                                               last_3pc[1])
+        # Everything at or below the new position is history.
+        for store in (self.prePrepares, self.sent_preprepares,
+                      self.prepares, self.commits):
+            for k in [k for k in store if k[1] <= last_3pc[1]]:
+                del store[k]
+        self._stashed_ooo_commits = {
+            k: v for k, v in self._stashed_ooo_commits.items()
+            if k[1] > last_3pc[1]}
+        self._data.is_participating = True
+        self._stasher.process_all_stashed(StashReason.CATCHING_UP)
+        self._stasher.process_all_stashed(StashReason.OUTSIDE_WATERMARKS)
+
+    def process_view_change_started(self, msg: ViewChangeStarted) -> None:
+        """Entering a view change: revert uncommitted work, remember old-view
+        pre-prepares for possible re-ordering (ref :2380)."""
+        self.revert_unordered_batches()
+        for key, pp in self.prePrepares.items():
+            if key not in self.ordered:
+                orig = pp.original_view_no if pp.original_view_no is not None else key[0]
+                self.old_view_preprepares[(orig, key[1])] = pp
+        self.prePrepares = {k: v for k, v in self.prePrepares.items()
+                            if k in self.ordered}
+        self.sent_preprepares.clear()
+        self.prepares.clear()
+        self.commits.clear()
+        self._commits_sent.clear()
+        self._stashed_ooo_commits.clear()
+
+    def process_new_view_checkpoints_applied(self, msg: NewViewCheckpointsApplied) -> None:
+        """Re-order the prepared batches carried into the new view
+        (ref process_new_view_checkpoints_applied :2380)."""
+        # A new primary must continue the sequence, never reuse ordered seqnos.
+        self._data.pp_seq_no = max(self._data.pp_seq_no,
+                                   self._data.last_ordered_3pc[1],
+                                   msg.checkpoint[2])
+        for (_view, orig_view, pp_seq_no, digest) in msg.batches:
+            if pp_seq_no <= self._data.last_ordered_3pc[1]:
+                continue
+            old_pp = self.old_view_preprepares.get((orig_view, pp_seq_no))
+            if old_pp is None or old_pp.digest != digest:
+                continue                     # will be recovered via catchup
+            # These requests ride the re-ordered batch; don't re-batch them.
+            for queue in self.request_queues.values():
+                for d in old_pp.req_idr:
+                    queue.pop(d, None)
+            import dataclasses
+            new_pp = dataclasses.replace(old_pp, view_no=self._data.view_no,
+                                         original_view_no=orig_view)
+            key = (self._data.view_no, pp_seq_no)
+            if self.is_primary:
+                self.sent_preprepares[key] = new_pp
+                self.prePrepares[key] = new_pp
+                self._data.pp_seq_no = max(self._data.pp_seq_no, pp_seq_no)
+                if self._data.is_master and self._executor is not None:
+                    reqs = [self._get_request(d) for d in new_pp.req_idr]
+                    self._executor.apply_batch(new_pp.ledger_id, reqs,
+                                               new_pp.pp_time,
+                                               self._data.view_no, pp_seq_no)
+                    self._applied_unordered.append(
+                        (new_pp.ledger_id,
+                         BatchID(self._data.view_no, orig_view, pp_seq_no, digest)))
+                self._data.preprepare_batch(
+                    BatchID(self._data.view_no, orig_view, pp_seq_no, digest))
+                self._network.send(new_pp)
+            else:
+                # Non-primaries re-admit the batch through the normal path when
+                # the primary's re-sent PRE-PREPARE arrives; nothing to do now.
+                self._data.pp_seq_no = max(self._data.pp_seq_no, pp_seq_no)
+        self._stasher.process_all_stashed(StashReason.WAITING_FOR_NEW_VIEW)
+        self._stasher.process_all_stashed(StashReason.FUTURE_VIEW)
+
+    # ------------------------------------------------------------------ #
+    # GC                                                                 #
+    # ------------------------------------------------------------------ #
+
+    def gc(self, stable_3pc: tuple[int, int]) -> None:
+        """Drop 3PC log entries at or below a stabilized checkpoint."""
+        seq = stable_3pc[1]
+        for store in (self.prePrepares, self.sent_preprepares,
+                      self.prepares, self.commits):
+            for k in [k for k in store if k[1] <= seq]:
+                del store[k]
+        self.ordered = {k for k in self.ordered if k[1] > seq}
+        self._commits_sent = {k for k in self._commits_sent if k[1] > seq}
+        self.old_view_preprepares = {k: v for k, v in self.old_view_preprepares.items()
+                                     if k[1] > seq}
+        if self._bls is not None:
+            self._bls.gc(stable_3pc)
+        self._stasher.process_all_stashed(StashReason.OUTSIDE_WATERMARKS)

@@ -1,0 +1,153 @@
+"""Replica: one protocol instance = shared data + the consensus services wired
+over a private internal bus.
+
+Reference behavior: plenum/server/replica.py:84 (service wiring :151-171) and
+replicas.py:19 (the master + backup collection; RBFT runs f+1 instances and
+the monitor compares master vs backup throughput, SURVEY.md §2.3). Event glue
+reproduced here: NewViewAccepted → checkpoint reset → NewViewCheckpointsApplied
+→ ordering re-orders; CheckpointStabilized → ordering GC.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from plenum_tpu.common.event_bus import ExternalBus, InternalBus
+from plenum_tpu.common.internal_messages import (CheckpointStabilized,
+                                                 NewViewAccepted,
+                                                 NewViewCheckpointsApplied)
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.timer import TimerService
+from plenum_tpu.config import Config
+
+from .batch_executor import BatchExecutor
+from .bls_bft_replica import BlsBftReplica
+from .checkpoint_service import CheckpointService
+from .consensus_shared_data import ConsensusSharedData, replica_name
+from .ordering_service import OrderingService
+from .primary_selector import RoundRobinPrimariesSelector
+from .view_change_service import ViewChangeService
+from .view_change_trigger_service import ViewChangeTriggerService
+
+
+class Replica:
+    def __init__(self,
+                 node_name: str,
+                 inst_id: int,
+                 validators: list[str],
+                 timer: TimerService,
+                 network: ExternalBus,
+                 executor: Optional[BatchExecutor] = None,
+                 bls: Optional[BlsBftReplica] = None,
+                 config: Optional[Config] = None,
+                 get_request: Optional[Callable[[str], Optional[Request]]] = None,
+                 checkpoint_digest_provider=None,
+                 instance_count: int = 1,
+                 external_internal_bus: Optional[InternalBus] = None):
+        self.name = replica_name(node_name, inst_id)
+        self.inst_id = inst_id
+        self.config = config or Config()
+        self.internal_bus = external_internal_bus or InternalBus()
+        self.network = network
+
+        self._data = ConsensusSharedData(self.name, validators, inst_id,
+                                         is_master=(inst_id == 0))
+        selector = RoundRobinPrimariesSelector()
+        self._data.primaries = selector.select_primaries(
+            0, instance_count, validators)
+
+        self.bls = bls
+        if bls is not None:
+            bls.set_quorums(self._data.quorums)
+
+        self.ordering = OrderingService(
+            data=self._data, timer=timer, bus=self.internal_bus,
+            network=network, executor=executor, bls=bls, config=self.config,
+            get_request=get_request)
+        self.checkpointer = CheckpointService(
+            data=self._data, bus=self.internal_bus, network=network,
+            config=self.config,
+            checkpoint_digest_provider=checkpoint_digest_provider)
+        self.view_changer = ViewChangeService(
+            data=self._data, timer=timer, bus=self.internal_bus,
+            network=network, config=self.config, selector=selector,
+            instance_count=instance_count)
+        self.vc_trigger = ViewChangeTriggerService(
+            data=self._data, timer=timer, bus=self.internal_bus,
+            network=network, config=self.config)
+
+        self.internal_bus.subscribe(NewViewAccepted, self._on_new_view_accepted)
+        self.internal_bus.subscribe(CheckpointStabilized, self._on_checkpoint_stable)
+
+    # --- event glue -------------------------------------------------------
+
+    def _on_new_view_accepted(self, msg: NewViewAccepted) -> None:
+        self.checkpointer.process_new_view_accepted(msg.checkpoint)
+        self.internal_bus.send(NewViewCheckpointsApplied(
+            view_no=msg.view_no, checkpoint=msg.checkpoint, batches=msg.batches))
+
+    def _on_checkpoint_stable(self, msg: CheckpointStabilized) -> None:
+        self.ordering.gc(msg.last_stable_3pc)
+
+    # --- accessors --------------------------------------------------------
+
+    @property
+    def data(self) -> ConsensusSharedData:
+        return self._data
+
+    @property
+    def is_master(self) -> bool:
+        return self._data.is_master
+
+    @property
+    def is_primary(self) -> bool:
+        return self._data.is_primary
+
+    @property
+    def view_no(self) -> int:
+        return self._data.view_no
+
+    @property
+    def last_ordered_3pc(self) -> tuple[int, int]:
+        return self._data.last_ordered_3pc
+
+    def set_validators(self, validators: list[str]) -> None:
+        self._data.set_validators(validators)
+        if self.bls is not None:
+            self.bls.set_quorums(self._data.quorums)
+
+    def service(self) -> None:
+        """One prod cycle: primaries flush queued requests into batches."""
+        self.ordering.service()
+
+
+class Replicas:
+    """The RBFT instance collection: instance 0 is the master, the rest shadow
+    (ref replicas.py:19, adjustReplicas node.py:1260)."""
+
+    def __init__(self, make_replica: Callable[[int], Replica]):
+        self._make = make_replica
+        self._replicas: list[Replica] = []
+
+    def grow_to(self, count: int) -> None:
+        while len(self._replicas) < count:
+            self._replicas.append(self._make(len(self._replicas)))
+
+    def shrink_to(self, count: int) -> None:
+        del self._replicas[count:]
+
+    @property
+    def master(self) -> Replica:
+        return self._replicas[0]
+
+    def __iter__(self):
+        return iter(self._replicas)
+
+    def __len__(self):
+        return len(self._replicas)
+
+    def __getitem__(self, inst_id: int) -> Replica:
+        return self._replicas[inst_id]
+
+    def service_all(self) -> None:
+        for replica in self._replicas:
+            replica.service()

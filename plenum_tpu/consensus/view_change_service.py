@@ -1,0 +1,365 @@
+"""View change: replace the primary while preserving every batch that could
+have been ordered anywhere.
+
+Reference behavior: plenum/server/consensus/view_change_service.py:28 —
+on NeedViewChange each node bumps the view, reverts in-flight work
+(ViewChangeStarted → OrderingService), broadcasts a ViewChange message carrying
+its prepared/preprepared certificates and checkpoints (_build_view_change_msg
+:141), and acks other nodes' ViewChange messages to the new primary. The new
+primary, holding n-f ViewChange messages each backed by an ack quorum, runs
+NewViewBuilder (:358): pick the highest checkpoint supported by a strong
+quorum (calc_checkpoint :363), then for every pp_seq_no in the window select
+the batch certified prepared by a strong quorum of non-contradicting votes and
+preprepared by a weak quorum (calc_batches :398), stopping at the first
+null-batch gap. Everyone validates the NewView against their own collected
+votes and finishes (_finish_view_change :314).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from plenum_tpu.common.event_bus import ExternalBus, InternalBus
+from plenum_tpu.common.internal_messages import (NeedViewChange,
+                                                 NewViewAccepted,
+                                                 NewViewCheckpointsApplied,
+                                                 PrimarySelected,
+                                                 RaisedSuspicion,
+                                                 ViewChangeStarted)
+from plenum_tpu.common.node_messages import (Checkpoint, NewView, ViewChange,
+                                             ViewChangeAck)
+from plenum_tpu.common.serialization import json_dumps
+from plenum_tpu.common.stashing import (DISCARD, PROCESS, STASH, StashReason,
+                                        StashingRouter)
+from plenum_tpu.common.suspicion_codes import Suspicions
+from plenum_tpu.common.timer import TimerService
+from plenum_tpu.config import Config
+
+from .batch_id import BatchID
+from .consensus_shared_data import ConsensusSharedData
+from .primary_selector import RoundRobinPrimariesSelector
+
+
+def view_change_digest(vc: ViewChange) -> str:
+    return hashlib.sha256(json_dumps(vc.to_dict()).encode()).hexdigest()
+
+
+class NewViewBuilder:
+    """Pure selection rules over a set of ViewChange votes (ref :358-493)."""
+
+    def __init__(self, data: ConsensusSharedData):
+        self._data = data
+
+    def calc_checkpoint(self, vcs: list[ViewChange]) -> Optional[tuple]:
+        best: Optional[tuple] = None
+        for vc in vcs:
+            for cp in vc.checkpoints:
+                cp = tuple(cp)
+                end = cp[2]
+                # enough nodes could still use it (their stable <= end)
+                usable = sum(1 for v in vcs if end >= v.stable_checkpoint)
+                if not self._data.quorums.strong.is_reached(usable):
+                    continue
+                # enough nodes actually hold it
+                holders = sum(1 for v in vcs if cp in {tuple(c) for c in v.checkpoints})
+                if not self._data.quorums.strong.is_reached(holders):
+                    continue
+                if best is None or end > best[2]:
+                    best = cp
+        return best
+
+    def calc_batches(self, cp: tuple, vcs: list[ViewChange]) -> Optional[list[BatchID]]:
+        batches: list[BatchID] = []
+        pp_seq_no = cp[2] + 1
+        while pp_seq_no <= cp[2] + self._data.log_size:
+            bid = self._find_batch(vcs, pp_seq_no)
+            if bid is not None:
+                batches.append(bid)
+                pp_seq_no += 1
+                continue
+            if self._null_batch_certified(vcs, pp_seq_no):
+                break                    # sequential ordering: stop at first gap
+            return None                  # quorum not yet available
+        return batches
+
+    def _find_batch(self, vcs, pp_seq_no) -> Optional[BatchID]:
+        for vc in vcs:
+            for raw in vc.prepared:
+                bid = BatchID.from_seq(raw)
+                if bid.pp_seq_no != pp_seq_no:
+                    continue
+                if (self._prepared_certified(bid, vcs)
+                        and self._preprepared_certified(bid, vcs)):
+                    return bid
+        return None
+
+    def _prepared_certified(self, bid: BatchID, vcs) -> bool:
+        def not_contradicting(vc: ViewChange) -> bool:
+            if bid.pp_seq_no <= vc.stable_checkpoint:
+                return False
+            for raw in vc.prepared:
+                other = BatchID.from_seq(raw)
+                if other.pp_seq_no != bid.pp_seq_no:
+                    continue
+                # A vote contradicts unless it is from an older view, or the
+                # same view with identical identity.
+                if other.view_no > bid.view_no:
+                    return False
+                if other.view_no >= bid.view_no and (
+                        other.pp_digest != bid.pp_digest
+                        or other.pp_view_no != bid.pp_view_no):
+                    return False
+            return True
+        return self._data.quorums.strong.is_reached(
+            sum(1 for vc in vcs if not_contradicting(vc)))
+
+    def _preprepared_certified(self, bid: BatchID, vcs) -> bool:
+        def witnessed(vc: ViewChange) -> bool:
+            for raw in vc.preprepared:
+                other = BatchID.from_seq(raw)
+                if (other.pp_seq_no == bid.pp_seq_no
+                        and other.pp_view_no == bid.pp_view_no
+                        and other.pp_digest == bid.pp_digest
+                        and other.view_no >= bid.view_no):
+                    return True
+            return False
+        return self._data.quorums.weak.is_reached(
+            sum(1 for vc in vcs if witnessed(vc)))
+
+    def _null_batch_certified(self, vcs, pp_seq_no) -> bool:
+        def has_no_prepare(vc: ViewChange) -> bool:
+            if pp_seq_no <= vc.stable_checkpoint:
+                return False
+            return all(BatchID.from_seq(raw).pp_seq_no != pp_seq_no
+                       for raw in vc.prepared)
+        return self._data.quorums.strong.is_reached(
+            sum(1 for vc in vcs if has_no_prepare(vc)))
+
+
+class ViewChangeService:
+    def __init__(self,
+                 data: ConsensusSharedData,
+                 timer: TimerService,
+                 bus: InternalBus,
+                 network: ExternalBus,
+                 config: Optional[Config] = None,
+                 selector: Optional[RoundRobinPrimariesSelector] = None,
+                 instance_count: int = 1):
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._network = network
+        self._config = config or Config()
+        self._selector = selector or RoundRobinPrimariesSelector()
+        self._instance_count = instance_count
+        self._builder = NewViewBuilder(data)
+
+        # per view: author node -> ViewChange
+        self._view_changes: dict[int, dict[str, ViewChange]] = {}
+        # per view: vc digest -> set of ack'ing nodes
+        self._acks: dict[int, dict[tuple[str, str], set[str]]] = {}
+        self._new_view: Optional[NewView] = None
+        # A NewView citing votes we haven't received yet, retried on each vote.
+        self._pending_new_view: Optional[tuple[NewView, str]] = None
+
+        self._stasher = StashingRouter()
+        self._stasher.subscribe(ViewChange, self.process_view_change)
+        self._stasher.subscribe(ViewChangeAck, self.process_view_change_ack)
+        self._stasher.subscribe(NewView, self.process_new_view)
+        self._stasher.subscribe_to(network)
+
+        bus.subscribe(NeedViewChange, self.process_need_view_change)
+
+    # --- starting a view change ------------------------------------------
+
+    def process_need_view_change(self, msg: NeedViewChange) -> None:
+        proposed = msg.view_no if msg.view_no is not None else self._data.view_no + 1
+        if proposed <= self._data.view_no and self._data.view_no != 0:
+            return
+        self._start_view_change(proposed)
+
+    def _start_view_change(self, proposed: int) -> None:
+        self._data.view_no = proposed
+        self._data.waiting_for_new_view = True
+        self._new_view = None
+        self._data.primaries = self._selector.select_primaries(
+            proposed, self._instance_count, self._data.validators)
+        # Snapshot the certificates BEFORE ViewChangeStarted: the ordering
+        # service's revert clears the in-flight lists (ref _build_view_change_msg
+        # :141 runs on pre-clean state).
+        vc = ViewChange(
+            view_no=proposed,
+            stable_checkpoint=self._data.stable_checkpoint,
+            prepared=tuple(b.to_list() for b in self._data.prepared),
+            preprepared=tuple(b.to_list() for b in self._data.preprepared),
+            checkpoints=tuple((c.view_no, c.seq_no_start, c.seq_no_end, c.digest)
+                              for c in self._data.checkpoints),
+        )
+        self._bus.send(ViewChangeStarted(view_no=proposed))
+        self._bus.send(PrimarySelected(view_no=proposed,
+                                       primaries=tuple(self._data.primaries)))
+        self._record_view_change(vc, self._data.node_name)
+        self._network.send(vc)
+        # Replay any ViewChange/NewView traffic that arrived before we moved.
+        self._stasher.process_all_stashed(StashReason.FUTURE_VIEW)
+        self._schedule_timeout(proposed)
+        self._try_build_or_finish()
+
+    def _schedule_timeout(self, view_no: int) -> None:
+        def on_timeout():
+            if self._data.waiting_for_new_view and self._data.view_no == view_no:
+                # View change didn't complete: escalate to the next view.
+                self._bus.send(NeedViewChange(view_no=view_no + 1))
+        self._timer.schedule(self._config.NEW_VIEW_TIMEOUT, on_timeout)
+
+    # --- collecting votes -------------------------------------------------
+
+    def process_view_change(self, msg: ViewChange, sender: str):
+        if msg.view_no < self._data.view_no:
+            return DISCARD
+        if msg.view_no > self._data.view_no or not self._data.waiting_for_new_view:
+            return STASH(StashReason.FUTURE_VIEW)
+        self._record_view_change(msg, sender)
+        # Ack the author's vote to the would-be primary (ref: acks routed to
+        # the new primary so it can prove vote authenticity).
+        primary = self._data.primary_name
+        ack = ViewChangeAck(view_no=msg.view_no, name=sender,
+                            digest=view_change_digest(msg))
+        if primary == self._data.node_name:
+            self.process_view_change_ack(ack, self._data.node_name)
+        else:
+            self._network.send(ack, dst=[primary])
+        self._try_build_or_finish()
+        return PROCESS
+
+    def _record_view_change(self, vc: ViewChange, author: str) -> None:
+        self._view_changes.setdefault(vc.view_no, {})[author] = vc
+
+    def process_view_change_ack(self, msg: ViewChangeAck, sender: str):
+        if msg.view_no < self._data.view_no:
+            return DISCARD
+        if msg.view_no > self._data.view_no or not self._data.waiting_for_new_view:
+            return STASH(StashReason.FUTURE_VIEW)
+        self._acks.setdefault(msg.view_no, {}).setdefault(
+            (msg.name, msg.digest), set()).add(sender)
+        self._try_build_or_finish()
+        return PROCESS
+
+    # --- primary: building NEW_VIEW --------------------------------------
+
+    def _is_new_primary(self) -> bool:
+        return self._data.primary_name == self._data.node_name
+
+    def _acked(self, view_no: int, author: str, vc: ViewChange) -> bool:
+        votes = self._acks.get(view_no, {}).get(
+            (author, view_change_digest(vc)), set())
+        # The author's own broadcast counts implicitly; n-f-1 others must agree.
+        return self._data.quorums.view_change_ack.is_reached(len(votes))
+
+    def _try_build_or_finish(self) -> None:
+        if not self._data.waiting_for_new_view:
+            return
+        view_no = self._data.view_no
+        if self._is_new_primary() and self._new_view is None:
+            self._try_build_new_view(view_no)
+        if self._pending_new_view is not None:
+            nv, nv_sender = self._pending_new_view
+            if nv.view_no == view_no:
+                self._pending_new_view = None
+                self.process_new_view(nv, nv_sender)
+            else:
+                self._pending_new_view = None
+        self._try_finish(view_no)
+
+    def _try_build_new_view(self, view_no: int) -> None:
+        vcs_by_author = self._view_changes.get(view_no, {})
+        confirmed = {a: vc for a, vc in vcs_by_author.items()
+                     if a == self._data.node_name or self._acked(view_no, a, vc)}
+        if not self._data.quorums.view_change.is_reached(len(confirmed)):
+            return
+        vcs = list(confirmed.values())
+        cp = self._builder.calc_checkpoint(vcs)
+        if cp is None:
+            return
+        batches = self._builder.calc_batches(cp, vcs)
+        if batches is None:
+            return
+        nv = NewView(view_no=view_no,
+                     view_changes=tuple(sorted(
+                         (a, view_change_digest(vc)) for a, vc in confirmed.items())),
+                     checkpoint=cp,
+                     batches=tuple(b.to_list() for b in batches))
+        self._new_view = nv
+        self._network.send(nv)
+        self._finish(nv)
+
+    # --- everyone: accepting NEW_VIEW -------------------------------------
+
+    def process_new_view(self, msg: NewView, sender: str):
+        if msg.view_no < self._data.view_no:
+            return DISCARD
+        if msg.view_no > self._data.view_no or not self._data.waiting_for_new_view:
+            return STASH(StashReason.FUTURE_VIEW)
+        if sender != self._data.primary_name:
+            self._bus.send(RaisedSuspicion(
+                inst_id=self._data.inst_id,
+                code=Suspicions.NEW_VIEW_INVALID.code,
+                reason=f"NEW_VIEW from non-primary {sender}"))
+            return DISCARD
+        # The primary's selection is never taken on trust: re-run the builder
+        # over the cited votes and require an identical result (ref
+        # _finish_view_change validates NewView against local state).
+        if not self._data.quorums.view_change.is_reached(len(msg.view_changes)):
+            return self._reject_new_view("NEW_VIEW cites too few ViewChanges")
+        own = self._view_changes.get(msg.view_no, {})
+        cited: list[ViewChange] = []
+        for author, digest in msg.view_changes:
+            if author not in self._data.validators:
+                return self._reject_new_view(f"NEW_VIEW cites unknown node {author}")
+            vc = own.get(author)
+            if vc is None:
+                # Wait for the missing vote to arrive, then re-validate.
+                self._pending_new_view = (msg, sender)
+                return PROCESS
+            if view_change_digest(vc) != digest:
+                return self._reject_new_view(
+                    f"NEW_VIEW cites a ViewChange by {author} that differs "
+                    f"from the one we received")
+            cited.append(vc)
+        cp = self._builder.calc_checkpoint(cited)
+        if cp is None or tuple(cp) != tuple(msg.checkpoint):
+            return self._reject_new_view("NEW_VIEW checkpoint does not follow "
+                                         "from the cited votes")
+        batches = self._builder.calc_batches(cp, cited)
+        if batches is None or [tuple(b.to_list()) for b in batches] != \
+                [tuple(b) for b in msg.batches]:
+            return self._reject_new_view("NEW_VIEW batches do not follow "
+                                         "from the cited votes")
+        self._pending_new_view = None
+        self._finish(msg)
+        return PROCESS
+
+    def _reject_new_view(self, why: str):
+        self._bus.send(RaisedSuspicion(inst_id=self._data.inst_id,
+                                       code=Suspicions.NEW_VIEW_INVALID.code,
+                                       reason=why))
+        return DISCARD
+
+    def _try_finish(self, view_no: int) -> None:
+        if self._new_view is not None and not self._is_new_primary():
+            self._finish(self._new_view)
+
+    def _finish(self, nv: NewView) -> None:
+        """_finish_view_change :314 — leave the waiting state and hand the
+        selected checkpoint + batches to checkpoint/ordering services."""
+        if not self._data.waiting_for_new_view:
+            return
+        self._new_view = nv
+        self._data.waiting_for_new_view = False
+        self._bus.send(NewViewAccepted(view_no=nv.view_no,
+                                       checkpoint=tuple(nv.checkpoint),
+                                       batches=tuple(nv.batches)))
+        # Old vote state is now garbage.
+        self._view_changes = {v: d for v, d in self._view_changes.items()
+                              if v > nv.view_no}
+        self._acks = {v: d for v, d in self._acks.items() if v > nv.view_no}

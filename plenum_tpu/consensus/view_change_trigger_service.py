@@ -1,0 +1,79 @@
+"""Turning suspicions into InstanceChange votes and votes into view changes.
+
+Reference behavior: plenum/server/consensus/view_change_trigger_service.py:23
+and server/view_change/instance_change_provider.py:30 — any local
+VoteForViewChange (monitor degradation, primary disconnect, freshness stall,
+protocol suspicion) becomes a broadcast InstanceChange for view+1; a quorum of
+f+1 matching votes from distinct nodes starts the actual view change
+(_try_start_view_change_by_instance_change :128). Votes expire after a TTL so
+stale grievances can't combine across epochs.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from plenum_tpu.common.event_bus import ExternalBus, InternalBus
+from plenum_tpu.common.internal_messages import (NeedViewChange,
+                                                 VoteForViewChange)
+from plenum_tpu.common.node_messages import InstanceChange
+from plenum_tpu.common.timer import TimerService
+from plenum_tpu.config import Config
+
+from .consensus_shared_data import ConsensusSharedData
+
+
+class ViewChangeTriggerService:
+    def __init__(self,
+                 data: ConsensusSharedData,
+                 timer: TimerService,
+                 bus: InternalBus,
+                 network: ExternalBus,
+                 config: Optional[Config] = None):
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._network = network
+        self._config = config or Config()
+        # proposed view -> node -> vote timestamp
+        self._votes: dict[int, dict[str, float]] = {}
+
+        bus.subscribe(VoteForViewChange, self.process_vote_for_view_change)
+        network.subscribe(InstanceChange, self.process_instance_change)
+
+    # --- local suspicion → broadcast vote ---------------------------------
+
+    def process_vote_for_view_change(self, msg: VoteForViewChange) -> None:
+        proposed = msg.view_no if msg.view_no is not None else self._data.view_no + 1
+        ic = InstanceChange(view_no=proposed, reason=msg.suspicion_code)
+        self._record_vote(proposed, self._data.node_name)
+        self._network.send(ic)
+        self._try_start(proposed)
+
+    # --- peer votes -------------------------------------------------------
+
+    def process_instance_change(self, msg: InstanceChange, sender: str) -> None:
+        if msg.view_no <= self._data.view_no:
+            return
+        self._record_vote(msg.view_no, sender)
+        self._try_start(msg.view_no)
+
+    def _record_vote(self, view_no: int, voter: str) -> None:
+        self._votes.setdefault(view_no, {})[voter] = self._timer.get_current_time()
+
+    def _live_votes(self, view_no: int) -> int:
+        now = self._timer.get_current_time()
+        ttl = self._config.INSTANCE_CHANGE_TIMEOUT
+        votes = self._votes.get(view_no, {})
+        for voter in [v for v, ts in votes.items() if now - ts > ttl]:
+            del votes[voter]
+        return len(votes)
+
+    def _try_start(self, view_no: int) -> None:
+        if view_no <= self._data.view_no:
+            return
+        if self._data.quorums.view_change_done is None:
+            return
+        if self._data.quorums.propagate.is_reached(self._live_votes(view_no)):
+            # f+1 nodes want this view: at least one is honest, so join.
+            self._votes.pop(view_no, None)
+            self._bus.send(NeedViewChange(view_no=view_no))

@@ -1,0 +1,214 @@
+"""BLS multi-signatures over BN254.
+
+Reference behavior: crypto/bls/bls_crypto.py (BlsCryptoSigner/BlsCryptoVerifier
+ABCs) + crypto/bls/indy_crypto/bls_crypto_indy_crypto.py (Ursa impl: sign :68,
+verify :79, verify_multi_sig :94, aggregate MultiSignature.new :101, PoP :107).
+Scheme: signatures in G1, verkeys in G2; aggregation is plain point addition,
+multi-sig verification is a 2-pairing product check. Proof-of-possession binds
+a verkey to its secret key under a separate hash domain, defeating rogue-key
+attacks exactly as the reference's PoP does.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from plenum_tpu.utils.base58 import b58decode, b58encode
+
+from . import bn254 as c
+
+_MSG_DOMAIN = b"plenum_tpu/bls/msg/v1"
+_POP_DOMAIN = b"plenum_tpu/bls/pop/v1"
+
+
+# --- point serialization (uncompressed, infinity-flagged) --------------------
+
+def g1_to_bytes(pt: c.G1Point) -> bytes:
+    if pt is None:
+        return b"\x00" * 64
+    return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+def g1_from_bytes(data: bytes) -> c.G1Point:
+    if len(data) != 64:
+        raise ValueError("G1 point must be 64 bytes")
+    if data == b"\x00" * 64:
+        return None
+    pt = (int.from_bytes(data[:32], "big"), int.from_bytes(data[32:], "big"))
+    if not c.g1_is_on_curve(pt):
+        raise ValueError("G1 point not on curve")
+    return pt
+
+
+def g2_to_bytes(pt: c.G2Point) -> bytes:
+    if pt is None:
+        return b"\x00" * 128
+    (x0, x1), (y0, y1) = pt
+    return b"".join(v.to_bytes(32, "big") for v in (x0, x1, y0, y1))
+
+
+def g2_from_bytes(data: bytes) -> c.G2Point:
+    if len(data) != 128:
+        raise ValueError("G2 point must be 128 bytes")
+    if data == b"\x00" * 128:
+        return None
+    vals = [int.from_bytes(data[i:i + 32], "big") for i in range(0, 128, 32)]
+    pt = ((vals[0], vals[1]), (vals[2], vals[3]))
+    if not c.g2_is_on_curve(pt):
+        raise ValueError("G2 point not on curve")
+    return pt
+
+
+# --- keys and signatures -----------------------------------------------------
+
+class BlsSignKey:
+    def __init__(self, seed: Optional[bytes] = None):
+        seed = seed if seed is not None else os.urandom(32)
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        self.seed = seed
+        self.sk = (int.from_bytes(seed, "big") % (c.R - 1)) + 1
+        self._pk = c.g2_mul(c.G2_GEN, self.sk)
+
+    @property
+    def verkey(self) -> str:
+        return b58encode(g2_to_bytes(self._pk))
+
+    def sign(self, message: bytes) -> str:
+        sig = c.g1_mul(c.hash_to_g1(message, _MSG_DOMAIN), self.sk)
+        return b58encode(g1_to_bytes(sig))
+
+    def generate_pop(self) -> str:
+        """Proof of possession: sign the verkey bytes under the PoP domain."""
+        h = c.hash_to_g1(g2_to_bytes(self._pk), _POP_DOMAIN)
+        return b58encode(g1_to_bytes(c.g1_mul(h, self.sk)))
+
+
+def _decode_sig(signature: str) -> c.G1Point:
+    return g1_from_bytes(b58decode(signature))
+
+
+def _decode_vk(verkey: str) -> c.G2Point:
+    pt = g2_from_bytes(b58decode(verkey))
+    if pt is None or not c.g2_in_subgroup(pt):
+        raise ValueError("verkey not in G2 subgroup")
+    return pt
+
+
+def verify(signature: str, message: bytes, verkey: str) -> bool:
+    """e(σ, G2) == e(H(m), pk)  ⇔  e(σ, G2)·e(-H(m), pk)... — done as one
+    2-pair product check with a shared final exponentiation."""
+    try:
+        sig = _decode_sig(signature)
+        pk = _decode_vk(verkey)
+    except (ValueError, KeyError):
+        return False
+    h = c.hash_to_g1(message, _MSG_DOMAIN)
+    return c.pairing_check([(c.G2_GEN, c.g1_neg(sig)), (pk, h)])
+
+
+def verify_pop(pop: str, verkey: str) -> bool:
+    try:
+        sig = _decode_sig(pop)
+        pk = _decode_vk(verkey)
+    except (ValueError, KeyError):
+        return False
+    h = c.hash_to_g1(b58decode(verkey), _POP_DOMAIN)
+    return c.pairing_check([(c.G2_GEN, c.g1_neg(sig)), (pk, h)])
+
+
+def aggregate_sigs(signatures: Sequence[str]) -> str:
+    agg: c.G1Point = None
+    for s in signatures:
+        agg = c.g1_add(agg, _decode_sig(s))
+    return b58encode(g1_to_bytes(agg))
+
+
+def aggregate_verkeys(verkeys: Sequence[str]) -> c.G2Point:
+    agg: c.G2Point = None
+    for v in verkeys:
+        agg = c.g2_add(agg, _decode_vk(v))
+    return agg
+
+
+def verify_multi_sig(signature: str, message: bytes,
+                     verkeys: Sequence[str]) -> bool:
+    """Verify an aggregated signature by all of `verkeys` over one message
+    (ref Bls.verify_multi_sig :94 — PoP model, so plain key aggregation)."""
+    if not verkeys:
+        return False
+    try:
+        sig = _decode_sig(signature)
+        pk = aggregate_verkeys(verkeys)
+    except (ValueError, KeyError):
+        return False
+    h = c.hash_to_g1(message, _MSG_DOMAIN)
+    return c.pairing_check([(c.G2_GEN, c.g1_neg(sig)), (pk, h)])
+
+
+# --- provider seam (ref crypto/bls/bls_crypto.py ABCs) ----------------------
+
+class BlsCryptoSigner:
+    """Holds this node's BLS secret; signs state roots during COMMIT."""
+
+    def __init__(self, seed: Optional[bytes] = None):
+        self._key = BlsSignKey(seed)
+
+    @property
+    def pk(self) -> str:
+        return self._key.verkey
+
+    def sign(self, message: bytes) -> str:
+        return self._key.sign(message)
+
+    def generate_pop(self) -> str:
+        return self._key.generate_pop()
+
+    @staticmethod
+    def generate_keys(seed: Optional[bytes] = None) -> tuple[str, str]:
+        """(verkey, pop) for key-distribution txns (ref bls_key_manager)."""
+        key = BlsSignKey(seed)
+        return key.verkey, BlsSignKey(seed=key.seed).generate_pop()
+
+
+class BlsCryptoVerifier:
+    """Stateless verification provider; caches decoded verkeys."""
+
+    def __init__(self):
+        self._vk_cache: dict[str, c.G2Point] = {}
+
+    def _pk(self, verkey: str) -> c.G2Point:
+        pt = self._vk_cache.get(verkey)
+        if pt is None:
+            pt = _decode_vk(verkey)
+            self._vk_cache[verkey] = pt
+        return pt
+
+    def verify_sig(self, signature: str, message: bytes, verkey: str) -> bool:
+        try:
+            sig = _decode_sig(signature)
+            pk = self._pk(verkey)
+        except (ValueError, KeyError):
+            return False
+        h = c.hash_to_g1(message, _MSG_DOMAIN)
+        return c.pairing_check([(c.G2_GEN, c.g1_neg(sig)), (pk, h)])
+
+    def verify_multi_sig(self, signature: str, message: bytes,
+                         verkeys: Sequence[str]) -> bool:
+        if not verkeys:
+            return False
+        try:
+            sig = _decode_sig(signature)
+            pk: c.G2Point = None
+            for v in verkeys:
+                pk = c.g2_add(pk, self._pk(v))
+        except (ValueError, KeyError):
+            return False
+        h = c.hash_to_g1(message, _MSG_DOMAIN)
+        return c.pairing_check([(c.G2_GEN, c.g1_neg(sig)), (pk, h)])
+
+    def create_multi_sig(self, signatures: Sequence[str]) -> str:
+        return aggregate_sigs(signatures)
+
+    def verify_key_proof_of_possession(self, pop: str, verkey: str) -> bool:
+        return verify_pop(pop, verkey)

@@ -85,7 +85,8 @@ def _precheck(msg, sig, vk) -> bool:
     reject non-canonical point encodings (y >= p) and S >= L, which OpenSSL
     accepts but RFC 8032 strict verification rejects."""
     try:
-        if len(sig) != 64 or len(vk) != 32 or not isinstance(msg, (bytes, bytearray)):
+        if len(sig) != 64 or len(vk) != 32 or not isinstance(
+                msg, (bytes, bytearray, memoryview)):
             return False
         if _ops.decompress(bytes(vk)) is None:
             return False

@@ -1,0 +1,54 @@
+"""Multi-signature value types.
+
+Reference behavior: crypto/bls/bls_multi_signature.py — MultiSignatureValue is
+the canonical tuple every node BLS-signs at COMMIT time (ledger id, state root,
+pool state root, txn root, timestamp); MultiSignature pairs the aggregated
+signature with the participant list and the signed value. Serialized into
+PRE-PREPARE (bls_multi_sig field) and the BlsStore keyed by state root.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+from plenum_tpu.common.serialization import signing_serialize
+
+
+class MultiSignatureValue(NamedTuple):
+    ledger_id: int
+    state_root_hash: str
+    pool_state_root_hash: str
+    txn_root_hash: str
+    timestamp: float
+
+    def as_single_value(self) -> bytes:
+        """Canonical bytes that get BLS-signed (ref as_single_value)."""
+        return signing_serialize({
+            "ledger_id": self.ledger_id,
+            "state_root_hash": self.state_root_hash,
+            "pool_state_root_hash": self.pool_state_root_hash,
+            "txn_root_hash": self.txn_root_hash,
+            "timestamp": self.timestamp,
+        })
+
+    def to_list(self) -> list:
+        return [self.ledger_id, self.state_root_hash, self.pool_state_root_hash,
+                self.txn_root_hash, self.timestamp]
+
+    @classmethod
+    def from_list(cls, items: Sequence) -> "MultiSignatureValue":
+        return cls(int(items[0]), str(items[1]), str(items[2]), str(items[3]),
+                   float(items[4]))
+
+
+class MultiSignature(NamedTuple):
+    signature: str                     # aggregated BLS sig (base58)
+    participants: tuple[str, ...]      # node names whose sigs were aggregated
+    value: MultiSignatureValue
+
+    def to_list(self) -> list:
+        return [self.signature, list(self.participants), self.value.to_list()]
+
+    @classmethod
+    def from_list(cls, items: Sequence) -> "MultiSignature":
+        return cls(str(items[0]), tuple(items[1]),
+                   MultiSignatureValue.from_list(items[2]))

@@ -1,0 +1,6 @@
+from .sim_random import SimRandom
+from .sim_network import SimNetwork, Discard, Deliver, Stash, Rule
+from .sim_network import match_frm, match_dst, match_type
+
+__all__ = ["SimRandom", "SimNetwork", "Discard", "Deliver", "Stash", "Rule",
+           "match_frm", "match_dst", "match_type"]

@@ -1,0 +1,172 @@
+"""Deterministic in-process network fabric for multi-node simulation.
+
+Reference behavior: plenum/test/simulation/sim_network.py:98 — peers are
+ExternalBus instances wired through a rule chain; each rule can Discard (with
+probability), Stash, or Deliver (with random delay) messages matched by
+predicate. All delays go through the TimerService, all randomness through
+SimRandom, so a whole pool run is replayable from a seed. Messages make a
+round trip through the real wire serializer so schema bugs surface in sims.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, NamedTuple, Optional, Union
+
+from plenum_tpu.common.event_bus import ExternalBus
+from plenum_tpu.common.message_base import MessageBase, message_from_dict
+from plenum_tpu.common.serialization import pack, unpack
+from plenum_tpu.common.timer import TimerService
+
+from .sim_random import SimRandom
+
+
+class Discard(NamedTuple):
+    probability: float = 1.0
+
+
+class Deliver(NamedTuple):
+    min_delay: float = 0.0
+    max_delay: float = 0.0
+
+
+class Stash(NamedTuple):
+    pass
+
+
+Action = Union[Discard, Deliver, Stash]
+Selector = Callable[[Any, str, str], bool]   # (msg, frm, dst) -> bool
+
+
+class Rule(NamedTuple):
+    action: Action
+    selectors: tuple
+
+
+def match_frm(frm: Union[str, Iterable[str]]) -> Selector:
+    names = {frm} if isinstance(frm, str) else set(frm)
+    return lambda _msg, f, _dst: f in names
+
+
+def match_dst(dst: Union[str, Iterable[str]]) -> Selector:
+    names = {dst} if isinstance(dst, str) else set(dst)
+    return lambda _msg, _frm, d: d in names
+
+
+def match_type(t: Union[type, Iterable[type]]) -> Selector:
+    types = t if isinstance(t, type) else tuple(t)
+    return lambda msg, _frm, _dst: isinstance(msg, types)
+
+
+class SimNetwork:
+    """Full-mesh fabric: every peer's ExternalBus sends into the rule chain;
+    surviving messages are scheduled for delivery on the shared timer."""
+
+    def __init__(self, timer: TimerService, random: Optional[SimRandom] = None,
+                 wire_roundtrip: bool = True):
+        self._timer = timer
+        self._random = random or SimRandom()
+        self._wire_roundtrip = wire_roundtrip
+        self._peers: dict[str, ExternalBus] = {}
+        self._rules: list[Rule] = []
+        self._stashed: list[tuple[Any, str, str]] = []
+        self.min_latency = 0.01
+        self.max_latency = 0.5
+        self.sent_count = 0
+        self.delivered_count = 0
+
+    # --- peers -----------------------------------------------------------
+
+    def create_peer(self, name: str,
+                    send_handler: Optional[Callable] = None) -> ExternalBus:
+        if name in self._peers:
+            raise ValueError(f"peer {name!r} already exists")
+        handler = send_handler or (lambda msg, dst, frm=name: self._send(frm, msg, dst))
+        bus = ExternalBus(handler)
+        self._peers[name] = bus
+        return bus
+
+    def remove_peer(self, name: str) -> None:
+        self._peers.pop(name, None)
+        self._refresh_connecteds()
+
+    @property
+    def peer_names(self) -> list[str]:
+        return list(self._peers)
+
+    def connect_all(self) -> None:
+        self._refresh_connecteds()
+
+    def _refresh_connecteds(self) -> None:
+        all_names = set(self._peers)
+        for name, bus in self._peers.items():
+            bus.update_connecteds(all_names - {name})
+
+    # --- rules -----------------------------------------------------------
+
+    def add_rule(self, action: Action, *selectors: Selector) -> Rule:
+        rule = Rule(action=action, selectors=selectors)
+        self._rules.append(rule)
+        return rule
+
+    def remove_rule(self, rule: Rule) -> None:
+        if rule in self._rules:
+            self._rules.remove(rule)
+            self._replay_stashed()
+
+    def set_latency(self, min_value: float, max_value: float) -> None:
+        self.min_latency = min_value
+        self.max_latency = max_value
+
+    def _replay_stashed(self) -> None:
+        stashed, self._stashed = self._stashed, []
+        for msg, frm, dst in stashed:
+            self._route(msg, frm, dst)
+
+    # --- transmission ----------------------------------------------------
+
+    def _send(self, frm: str, msg: Any, dst) -> None:
+        if dst is None:
+            targets = [n for n in self._peers if n != frm]
+        else:
+            targets = [d for d in dst]
+        for d in targets:
+            self.sent_count += 1
+            self._route(msg, frm, d)
+
+    def _route(self, msg: Any, frm: str, dst: str) -> None:
+        # Last-added rule wins, like a filter stack.
+        for rule in reversed(self._rules):
+            if not all(sel(msg, frm, dst) for sel in rule.selectors):
+                continue
+            if isinstance(rule.action, Discard):
+                if self._random.float(0.0, 1.0) <= rule.action.probability:
+                    return
+                continue
+            if isinstance(rule.action, Stash):
+                self._stashed.append((msg, frm, dst))
+                return
+            if isinstance(rule.action, Deliver):
+                delay = self._random.float(rule.action.min_delay, rule.action.max_delay)
+                self._schedule(delay, msg, frm, dst)
+                return
+        delay = self._random.float(self.min_latency, self.max_latency)
+        self._schedule(delay, msg, frm, dst)
+
+    def _schedule(self, delay: float, msg: Any, frm: str, dst: str) -> None:
+        if self._wire_roundtrip and isinstance(msg, MessageBase):
+            # Serialize now (sender's view), deserialize at delivery — exactly
+            # what a real wire does, so schema violations fail loudly in sims.
+            data = pack(msg.to_dict())
+            deliver = lambda: self._deliver_wire(data, frm, dst)
+        else:
+            deliver = lambda: self._deliver(msg, frm, dst)
+        self._timer.schedule(delay, deliver)
+
+    def _deliver_wire(self, data: bytes, frm: str, dst: str) -> None:
+        self._deliver(message_from_dict(unpack(data)), frm, dst)
+
+    def _deliver(self, msg: Any, frm: str, dst: str) -> None:
+        bus = self._peers.get(dst)
+        if bus is None:
+            return
+        self.delivered_count += 1
+        bus.process_incoming(msg, frm)

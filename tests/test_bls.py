@@ -1,0 +1,98 @@
+"""BLS over BN254: pairing properties, sign/verify, aggregation, PoP
+(ref crypto/bls/indy_crypto/bls_crypto_indy_crypto.py behavior)."""
+import pytest
+
+from plenum_tpu.crypto import bn254 as c
+from plenum_tpu.crypto.bls import (BlsCryptoSigner, BlsCryptoVerifier,
+                                   BlsSignKey, aggregate_sigs, verify,
+                                   verify_multi_sig, verify_pop)
+from plenum_tpu.crypto.multi_signature import (MultiSignature,
+                                               MultiSignatureValue)
+
+
+def test_pairing_bilinearity():
+    a, b = 31337, 271828
+    e = c.pairing(c.G2_GEN, c.G1_GEN)
+    lhs = c.pairing(c.g2_mul(c.G2_GEN, a), c.g1_mul(c.G1_GEN, b))
+    assert lhs == c.f12_pow(e, a * b % c.R)
+    assert e != c.F12_ONE
+
+
+def test_group_orders():
+    assert c.g1_mul(c.G1_GEN, c.R) is None
+    assert c.g2_mul(c.G2_GEN, c.R) is None
+    assert c.g2_in_subgroup(c.G2_GEN)
+
+
+def test_hash_to_g1_deterministic_and_valid():
+    p1 = c.hash_to_g1(b"state-root-1")
+    p2 = c.hash_to_g1(b"state-root-1")
+    p3 = c.hash_to_g1(b"state-root-2")
+    assert p1 == p2 != p3
+    assert c.g1_is_on_curve(p1) and c.g1_is_on_curve(p3)
+
+
+def test_sign_verify_roundtrip():
+    key = BlsSignKey(seed=b"\x01" * 32)
+    sig = key.sign(b"message")
+    assert verify(sig, b"message", key.verkey)
+    assert not verify(sig, b"other", key.verkey)
+    other = BlsSignKey(seed=b"\x02" * 32)
+    assert not verify(sig, b"message", other.verkey)
+
+
+def test_signing_is_deterministic():
+    k1 = BlsSignKey(seed=b"\x07" * 32)
+    k2 = BlsSignKey(seed=b"\x07" * 32)
+    assert k1.verkey == k2.verkey
+    assert k1.sign(b"m") == k2.sign(b"m")
+
+
+def test_multi_sig_aggregate_and_verify():
+    keys = [BlsSignKey(seed=bytes([i]) * 32) for i in range(1, 5)]
+    msg = b"the-state-root"
+    agg = aggregate_sigs([k.sign(msg) for k in keys])
+    vks = [k.verkey for k in keys]
+    assert verify_multi_sig(agg, msg, vks)
+    # missing participant -> fail
+    assert not verify_multi_sig(agg, msg, vks[:3])
+    # wrong message -> fail
+    assert not verify_multi_sig(agg, b"x", vks)
+    # aggregated sig is not a valid single sig for any one key
+    assert not verify(agg, msg, vks[0])
+
+
+def test_proof_of_possession():
+    key = BlsSignKey(seed=b"\x09" * 32)
+    pop = key.generate_pop()
+    assert verify_pop(pop, key.verkey)
+    other = BlsSignKey(seed=b"\x0a" * 32)
+    assert not verify_pop(pop, other.verkey)
+    # a message signature must not double as a PoP (domain separation)
+    assert not verify_pop(key.sign(b58 := key.verkey.encode()), key.verkey)
+
+
+def test_provider_seam():
+    signer = BlsCryptoSigner(seed=b"\x11" * 32)
+    verifier = BlsCryptoVerifier()
+    sig = signer.sign(b"root")
+    assert verifier.verify_sig(sig, b"root", signer.pk)
+    signer2 = BlsCryptoSigner(seed=b"\x12" * 32)
+    agg = verifier.create_multi_sig([sig, signer2.sign(b"root")])
+    assert verifier.verify_multi_sig(agg, b"root", [signer.pk, signer2.pk])
+    assert verifier.verify_key_proof_of_possession(signer.generate_pop(),
+                                                   signer.pk)
+
+
+def test_garbage_inputs_rejected_not_raised():
+    key = BlsSignKey(seed=b"\x13" * 32)
+    assert not verify("not-base58-!!!", b"m", key.verkey)
+    assert not verify(key.sign(b"m"), b"m", "bogus-verkey")
+    assert not verify_multi_sig(key.sign(b"m"), b"m", [])
+
+
+def test_multi_signature_value_roundtrip():
+    value = MultiSignatureValue(1, "sr", "psr", "tr", 1234.5)
+    ms = MultiSignature("sig58", ("Alpha", "Beta"), value)
+    assert MultiSignature.from_list(ms.to_list()) == ms
+    assert b"state_root_hash" in value.as_single_value()
