@@ -1,0 +1,111 @@
+"""Benchmark: batched Ed25519 verification throughput on device vs CPU.
+
+This is the north-star hot path (SURVEY.md §3.2: CoreAuthNr.authenticate →
+libsodium scalar verify, n× per request across the pool; BASELINE.md: the
+reference publishes no numbers, so the CPU backend of this framework — a
+scalar loop over the C Ed25519 implementation, the same work the reference
+does per request — is the measured baseline denominator).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+
+def make_items(n: int):
+    """n deterministic (msg, sig64, verkey32) triples, distinct keys."""
+    try:
+        from plenum_tpu.crypto.ed25519 import Ed25519Signer
+        items = []
+        for i in range(n):
+            signer = Ed25519Signer(hashlib.sha256(b"bench%d" % (i % 64)).digest())
+            msg = b"bench message %d" % i
+            items.append((msg, signer.sign(msg), signer.verkey))
+        return items
+    except Exception:
+        # no `cryptography` package: pure-Python signing (slow, host-only)
+        from plenum_tpu.ops import ed25519 as ops
+        P, L, D = ops.P, ops.L, ops.D
+
+        def add(p1, p2):
+            x1, y1 = p1
+            x2, y2 = p2
+            dd = D * x1 * x2 * y1 * y2 % P
+            return ((x1 * y2 + x2 * y1) * pow(1 + dd, P - 2, P) % P,
+                    (y1 * y2 + x1 * x2) * pow(1 - dd + P, P - 2, P) % P)
+
+        def mul(k, pt):
+            acc = (0, 1)
+            while k:
+                if k & 1:
+                    acc = add(acc, pt)
+                pt = add(pt, pt)
+                k >>= 1
+            return acc
+
+        def comp(pt):
+            return (pt[1] | ((pt[0] & 1) << 255)).to_bytes(32, "little")
+
+        B = (ops.BX, ops.BY)
+        keys = {}
+        items = []
+        for i in range(n):
+            ki = i % 16
+            if ki not in keys:
+                hd = hashlib.sha512(hashlib.sha256(b"bench%d" % ki).digest()).digest()
+                a = int.from_bytes(hd[:32], "little")
+                a = (a & ((1 << 254) - 8)) | (1 << 254)
+                keys[ki] = (a, hd[32:], comp(mul(a, B)))
+            a, prefix, vk = keys[ki]
+            msg = b"bench message %d" % i
+            r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
+            r_c = comp(mul(r, B))
+            h = int.from_bytes(hashlib.sha512(r_c + vk + msg).digest(), "little") % L
+            s = (r + h * a) % L
+            items.append((msg, r_c + s.to_bytes(32, "little"), vk))
+        return items
+
+
+def bench_jax(items, iters: int = 5) -> float:
+    from plenum_tpu.crypto.ed25519 import JaxEd25519Verifier
+    v = JaxEd25519Verifier()
+    ok = v.verify_batch(items)          # warmup: compile + point-cache fill
+    assert ok.all(), "bench signatures must verify"
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        v.verify_batch(items)
+    dt = time.perf_counter() - t0
+    return iters * len(items) / dt
+
+
+def bench_cpu(items) -> float:
+    try:
+        from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+        v = CpuEd25519Verifier()
+    except Exception:
+        return 0.0
+    v.verify_batch(items[:8])           # warmup
+    t0 = time.perf_counter()
+    ok = v.verify_batch(items)
+    dt = time.perf_counter() - t0
+    assert ok.all()
+    return len(items) / dt
+
+
+def main():
+    items = make_items(2048)
+    jax_tps = bench_jax(items)
+    cpu_tps = bench_cpu(items[:256])
+    print(json.dumps({
+        "metric": "ed25519_batch_verify_throughput",
+        "value": round(jax_tps, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(jax_tps / cpu_tps, 3) if cpu_tps else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -111,6 +111,12 @@ class BlsBftReplica:
             ms = MultiSignature.from_list(list(pre_prepare.bls_multi_sig))
         except (ValueError, TypeError, IndexError, KeyError):
             return self.PPR_BLS_MULTISIG_WRONG
+        # Participants must be DISTINCT registered validators: aggregation is
+        # plain point addition, so one colluding node's signature repeated
+        # n-f times would otherwise verify as a quorum multi-sig (rogue
+        # self-aggregation).
+        if len(set(ms.participants)) != len(ms.participants):
+            return self.PPR_BLS_MULTISIG_WRONG
         verkeys = [self._register.get_key_by_name(n) for n in ms.participants]
         if any(v is None for v in verkeys):
             return self.PPR_BLS_MULTISIG_WRONG

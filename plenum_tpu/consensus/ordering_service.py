@@ -153,14 +153,19 @@ class OrderingService:
         view_no = self._data.view_no
         pp_seq_no = self._data.pp_seq_no + 1
         applied = self._apply(ledger_id, reqs, pp_time, view_no, pp_seq_no)
+        # req_idr carries ALL digests in apply order (valid AND rejected):
+        # validators must re-apply the exact same sequence or a rejection that
+        # depends on an earlier request in the same batch would diverge;
+        # `discarded` marks which of them dynamic validation refused.
+        all_digests = tuple(r.digest for r in reqs)
         params = dict(
             inst_id=self._data.inst_id,
             view_no=view_no,
             pp_seq_no=pp_seq_no,
             pp_time=pp_time,
-            req_idr=tuple(applied.valid_digests),
+            req_idr=all_digests,
             discarded=tuple(applied.discarded),
-            digest=self._batch_digest(applied.valid_digests, view_no, pp_seq_no),
+            digest=self._batch_digest(all_digests, view_no, pp_seq_no),
             ledger_id=ledger_id,
             state_root=applied.state_root,
             txn_root=applied.txn_root,
@@ -467,9 +472,12 @@ class OrderingService:
                                    if b != batch_id]
         if self._bls is not None:
             self._bls.process_order(key, pp)
+        discarded_set = set(pp.discarded)
         ordered = Ordered(inst_id=pp.inst_id, view_no=key[0],
                           pp_seq_no=key[1], pp_time=pp.pp_time,
-                          req_idr=pp.req_idr, discarded=pp.discarded,
+                          req_idr=tuple(d for d in pp.req_idr
+                                        if d not in discarded_set),
+                          discarded=pp.discarded,
                           ledger_id=pp.ledger_id, state_root=pp.state_root,
                           txn_root=pp.txn_root,
                           audit_txn_root=pp.audit_txn_root,

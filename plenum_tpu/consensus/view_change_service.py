@@ -83,15 +83,24 @@ class NewViewBuilder:
         return batches
 
     def _find_batch(self, vcs, pp_seq_no) -> Optional[BatchID]:
+        # Among all certified candidates at this seq, pick the highest-view
+        # certificate (PBFT selection rule: a batch prepared in a later view
+        # supersedes earlier ones), tie-broken fully deterministically so the
+        # primary and every validator compute the identical NewView.
+        best: Optional[BatchID] = None
         for vc in vcs:
             for raw in vc.prepared:
                 bid = BatchID.from_seq(raw)
                 if bid.pp_seq_no != pp_seq_no:
                     continue
+                if best is not None and (bid.view_no, bid.pp_view_no,
+                                         bid.pp_digest) <= \
+                        (best.view_no, best.pp_view_no, best.pp_digest):
+                    continue
                 if (self._prepared_certified(bid, vcs)
                         and self._preprepared_certified(bid, vcs)):
-                    return bid
-        return None
+                    best = bid
+        return best
 
     def _prepared_certified(self, bid: BatchID, vcs) -> bool:
         def not_contradicting(vc: ViewChange) -> bool:
@@ -277,7 +286,12 @@ class ViewChangeService:
                      if a == self._data.node_name or self._acked(view_no, a, vc)}
         if not self._data.quorums.view_change.is_reached(len(confirmed)):
             return
-        vcs = list(confirmed.values())
+        # Iterate votes in the SAME author-sorted order process_new_view will
+        # reconstruct from the published view_changes tuple: the builder's
+        # selection is iteration-order-sensitive, and any divergence makes
+        # validators reject a correct NewView.
+        ordered = sorted(confirmed.items())
+        vcs = [vc for _, vc in ordered]
         cp = self._builder.calc_checkpoint(vcs)
         if cp is None:
             return
@@ -285,8 +299,8 @@ class ViewChangeService:
         if batches is None:
             return
         nv = NewView(view_no=view_no,
-                     view_changes=tuple(sorted(
-                         (a, view_change_digest(vc)) for a, vc in confirmed.items())),
+                     view_changes=tuple(
+                         (a, view_change_digest(vc)) for a, vc in ordered),
                      checkpoint=cp,
                      batches=tuple(b.to_list() for b in batches))
         self._new_view = nv

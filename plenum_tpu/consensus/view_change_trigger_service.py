@@ -71,8 +71,6 @@ class ViewChangeTriggerService:
     def _try_start(self, view_no: int) -> None:
         if view_no <= self._data.view_no:
             return
-        if self._data.quorums.view_change_done is None:
-            return
         if self._data.quorums.propagate.is_reached(self._live_votes(view_no)):
             # f+1 nodes want this view: at least one is honest, so join.
             self._votes.pop(view_no, None)

@@ -168,7 +168,7 @@ class BlsCryptoSigner:
     def generate_keys(seed: Optional[bytes] = None) -> tuple[str, str]:
         """(verkey, pop) for key-distribution txns (ref bls_key_manager)."""
         key = BlsSignKey(seed)
-        return key.verkey, BlsSignKey(seed=key.seed).generate_pop()
+        return key.verkey, key.generate_pop()
 
 
 class BlsCryptoVerifier:

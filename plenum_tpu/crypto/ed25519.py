@@ -90,8 +90,9 @@ def _precheck(msg, sig, vk) -> bool:
             return False
         if _ops.decompress(bytes(vk)) is None:
             return False
-        if _ops.decompress(bytes(sig[:32])) is None:
-            return False
+        # R is deliberately NOT validated here: both backends resolve a bad R
+        # by the recomputed-R' byte compare (ref10 semantics), so the verdicts
+        # still agree and the hot path skips a per-signature modular sqrt.
         return int.from_bytes(bytes(sig[32:]), "little") < _ops.L
     except Exception:
         return False
@@ -120,25 +121,44 @@ class CpuEd25519Verifier(Ed25519Verifier):
 class JaxEd25519Verifier(Ed25519Verifier):
     """Batched device verification.
 
-    Host prep per item: split sig into (R, S); decompress A (cached per verkey)
-    and R; reject non-canonical S or invalid points; h = SHA512(R||A||M) mod L.
+    Host prep per item: split sig into (R, S); decompress A once per verkey
+    (cached as ready-to-ship -A limb rows); reject non-canonical S or invalid
+    A; h = SHA512(R||A||M) mod L. R is NOT decompressed — the kernel
+    recomputes R' and compares its compressed form against the raw signature
+    bytes (ref10 semantics), so the only per-item bigint work left on host is
+    one sha512 and one mod-L reduction.
     Device: one verify_kernel dispatch over the padded batch.
     """
 
     def __init__(self, min_batch: int = 1, cache_size: int = 65536):
         # verkeys are attacker-supplied; the cache must be bounded (FIFO evict)
-        self._pt_cache: dict[bytes, Optional[tuple[int, int]]] = {}
+        # value: (ax, ay, at) int64[10] rows for -A, or None for invalid keys
+        self._pt_cache: dict[bytes, Optional[tuple]] = {}
         self._cache_size = cache_size
         self._min_batch = min_batch
 
-    def _decompress_cached(self, vk: bytes) -> Optional[tuple[int, int]]:
+    def _neg_a_limbs(self, vk: bytes) -> Optional[tuple]:
         if vk in self._pt_cache:
             return self._pt_cache[vk]
-        hit = _ops.decompress(vk)
+        a = _ops.decompress(vk)
+        if a is None:
+            rows = None
+        else:
+            x, y = (_ops.P - a[0]) % _ops.P, a[1]          # -A = (-x, y)
+            rows = (_ops.int_to_limbs(x), _ops.int_to_limbs(y),
+                    _ops.int_to_limbs(x * y % _ops.P))
         if len(self._pt_cache) >= self._cache_size:
             self._pt_cache.pop(next(iter(self._pt_cache)))
-        self._pt_cache[vk] = hit
-        return hit
+        self._pt_cache[vk] = rows
+        return rows
+
+    # kept for tests/back-compat: cached decompression of a verkey
+    def _decompress_cached(self, vk: bytes):
+        rows = self._neg_a_limbs(vk)
+        if rows is None:
+            return None
+        return ((_ops.P - _ops.limbs_to_int(rows[0])) % _ops.P,
+                _ops.limbs_to_int(rows[1]))
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
         import jax.numpy as jnp
@@ -146,17 +166,14 @@ class JaxEd25519Verifier(Ed25519Verifier):
         verdict = np.zeros(n, dtype=bool)
         if n == 0:
             return verdict
-        idxs, s_vals, h_vals, neg_a, r_aff = [], [], [], [], []
+        idxs, s_vals, h_vals, a_rows, r_enc = [], [], [], [], []
         for i, (msg, sig, vk) in enumerate(items):
             try:
                 msg, sig, vk = bytes(msg), bytes(sig), bytes(vk)
                 if len(sig) != 64 or len(vk) != 32:
                     continue
-                a = self._decompress_cached(vk)
-                if a is None:
-                    continue
-                r = _ops.decompress(sig[:32])
-                if r is None:
+                rows = self._neg_a_limbs(vk)
+                if rows is None:
                     continue
                 s = int.from_bytes(sig[32:], "little")
                 if s >= _ops.L:
@@ -168,8 +185,8 @@ class JaxEd25519Verifier(Ed25519Verifier):
             idxs.append(i)
             s_vals.append(s)
             h_vals.append(h)
-            neg_a.append(((_ops.P - a[0]) % _ops.P, a[1]))  # -A = (-x, y)
-            r_aff.append(r)
+            a_rows.append(rows)
+            r_enc.append(sig[:32])
         if not idxs:
             return verdict
         m = len(idxs)
@@ -177,19 +194,20 @@ class JaxEd25519Verifier(Ed25519Verifier):
         while m_pad < max(m, self._min_batch):
             m_pad *= 2
         pad = m_pad - m
-        s_bits = _ops.scalar_bits(s_vals + [0] * pad)
-        h_bits = _ops.scalar_bits(h_vals + [0] * pad)
-        # pad with the identity check [0]B + [0](-B) == O? simplest: repeat
-        # the first row; its verdict is discarded.
-        neg_a += [neg_a[0]] * pad
-        r_aff += [r_aff[0]] * pad
-        ax, ay, az, at = _ops.points_to_limbs(neg_a)
-        rx = np.stack([_ops.int_to_limbs(x) for x, _ in r_aff])
-        ry = np.stack([_ops.int_to_limbs(y) for _, y in r_aff])
+        # padding repeats the first row; its verdict is discarded
+        s_bits = _ops.scalar_bits(s_vals + [s_vals[0]] * pad)
+        h_bits = _ops.scalar_bits(h_vals + [h_vals[0]] * pad)
+        a_rows += [a_rows[0]] * pad
+        r_enc += [r_enc[0]] * pad
+        ax = np.stack([r[0] for r in a_rows])
+        ay = np.stack([r[1] for r in a_rows])
+        at = np.stack([r[2] for r in a_rows])
+        az = np.tile(_ops.int_to_limbs(1), (m_pad, 1))
+        ry, r_sign = _ops.r_bytes_to_limbs(r_enc)
         ok = np.asarray(_ops.verify_kernel(
             jnp.asarray(s_bits), jnp.asarray(h_bits),
             jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(az), jnp.asarray(at),
-            jnp.asarray(rx), jnp.asarray(ry)))
+            jnp.asarray(ry), jnp.asarray(r_sign)))
         for j, i in enumerate(idxs):
             verdict[i] = bool(ok[j])
         return verdict

@@ -1,0 +1,78 @@
+"""Registry of ledgers, states, and named stores.
+
+Reference behavior: plenum/server/database_manager.py:11 — one place mapping
+ledger_id -> (ledger, state) plus named specialty stores (BLS store :112,
+ts store :116, idr cache :120). Handlers and batch handlers reach storage only
+through this registry, which is what lets tests swap in-memory stores and the
+node bootstrap wire real ones.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.state.pruning_state import PruningState
+
+BLS_STORE_LABEL = "bls"
+TS_STORE_LABEL = "ts"
+IDR_CACHE_LABEL = "idr"
+SEQ_NO_DB_LABEL = "seq_no_db"
+NODE_STATUS_DB_LABEL = "node_status_db"
+
+
+class DatabaseManager:
+    def __init__(self):
+        self._ledgers: dict[int, Ledger] = {}
+        self._states: dict[int, Optional[PruningState]] = {}
+        self._stores: dict[str, object] = {}
+
+    # --- ledgers / states -------------------------------------------------
+
+    def register_ledger(self, ledger_id: int, ledger: Ledger,
+                        state: Optional[PruningState] = None) -> None:
+        self._ledgers[ledger_id] = ledger
+        self._states[ledger_id] = state
+
+    def get_ledger(self, ledger_id: int) -> Optional[Ledger]:
+        return self._ledgers.get(ledger_id)
+
+    def get_state(self, ledger_id: int) -> Optional[PruningState]:
+        return self._states.get(ledger_id)
+
+    @property
+    def ledger_ids(self) -> list[int]:
+        return list(self._ledgers)
+
+    def ledgers(self) -> Iterable[tuple[int, Ledger]]:
+        return self._ledgers.items()
+
+    # --- named stores -----------------------------------------------------
+
+    def register_store(self, label: str, store) -> None:
+        self._stores[label] = store
+
+    def get_store(self, label: str):
+        return self._stores.get(label)
+
+    @property
+    def bls_store(self):
+        return self._stores.get(BLS_STORE_LABEL)
+
+    @property
+    def ts_store(self):
+        return self._stores.get(TS_STORE_LABEL)
+
+    @property
+    def idr_cache(self):
+        return self._stores.get(IDR_CACHE_LABEL)
+
+    def close(self) -> None:
+        for ledger in self._ledgers.values():
+            ledger.close()
+        for state in self._states.values():
+            if state is not None:
+                state.close()
+        for store in self._stores.values():
+            close = getattr(store, "close", None)
+            if callable(close):
+                close()

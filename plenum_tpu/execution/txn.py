@@ -1,0 +1,100 @@
+"""Transaction structure and type registry.
+
+Reference behavior: plenum's txn envelope (txn_util.py / request_handlers) —
+a committed transaction carries the operation data, the author metadata, and
+ledger-assigned metadata (seqNo, txnTime). This build keeps the same three-part
+envelope because catchup, audit recovery, and state-proof reads all key off it,
+but the field set is our own.
+
+Txn type constants mirror the reference's wire values so a client of the
+reference finds the same operations (NYM plenum/common/constants.py, NODE,
+GET_TXN, audit, TAA family).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from plenum_tpu.common.request import Request
+
+# --- txn types (wire values match the reference protocol) -------------------
+
+NYM = "1"
+NODE = "0"
+GET_TXN = "3"
+ATTRIB = "100"
+GET_NYM = "105"
+GET_ATTR = "104"
+AUDIT = "2"                      # audit ledger entries
+TXN_AUTHOR_AGREEMENT = "4"
+TXN_AUTHOR_AGREEMENT_AML = "5"
+GET_TXN_AUTHOR_AGREEMENT = "6"
+GET_TXN_AUTHOR_AGREEMENT_AML = "7"
+TXN_AUTHOR_AGREEMENT_DISABLE = "8"
+LEDGERS_FREEZE = "9"
+GET_FROZEN_LEDGERS = "10"
+
+# --- roles ------------------------------------------------------------------
+
+TRUSTEE = "0"
+STEWARD = "2"
+ROLE_REMOVE = ""                 # explicit null-role assignment
+
+
+def new_txn(txn_type: str, data: dict, request: Optional[Request] = None,
+            protocol_version: int = 2) -> dict:
+    """Build the uncommitted txn envelope for an operation."""
+    metadata: dict[str, Any] = {}
+    if request is not None:
+        metadata = {"from": request.identifier,
+                    "reqId": request.req_id,
+                    "digest": request.digest,
+                    "payloadDigest": request.payload_digest}
+        if request.taa_acceptance is not None:
+            metadata["taaAcceptance"] = request.taa_acceptance
+        if request.endorser is not None:
+            metadata["endorser"] = request.endorser
+    return {"txn": {"type": txn_type,
+                    "protocolVersion": protocol_version,
+                    "data": data,
+                    "metadata": metadata},
+            "txnMetadata": {},
+            "ver": "1"}
+
+
+def txn_type_of(txn: dict) -> Optional[str]:
+    return txn.get("txn", {}).get("type")
+
+
+def txn_data(txn: dict) -> dict:
+    return txn.get("txn", {}).get("data", {})
+
+
+def txn_author(txn: dict) -> Optional[str]:
+    return txn.get("txn", {}).get("metadata", {}).get("from")
+
+
+def txn_seq_no(txn: dict) -> Optional[int]:
+    return txn.get("txnMetadata", {}).get("seqNo")
+
+
+def txn_time(txn: dict) -> Optional[int]:
+    return txn.get("txnMetadata", {}).get("txnTime")
+
+
+def txn_digest(txn: dict) -> Optional[str]:
+    return txn.get("txn", {}).get("metadata", {}).get("digest")
+
+
+def txn_payload_digest(txn: dict) -> Optional[str]:
+    return txn.get("txn", {}).get("metadata", {}).get("payloadDigest")
+
+
+def set_seq_no(txn: dict, seq_no: int) -> dict:
+    txn.setdefault("txnMetadata", {})["seqNo"] = seq_no
+    return txn
+
+
+def set_txn_time(txn: dict, txn_time_: int) -> dict:
+    txn.setdefault("txnMetadata", {})["txnTime"] = int(txn_time_)
+    return txn

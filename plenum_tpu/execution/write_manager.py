@@ -1,0 +1,255 @@
+"""Write-request manager: typed execution with uncommitted staging.
+
+Reference behavior: plenum/server/request_managers/write_request_manager.py:33
+— the single entry point consensus uses to run the execution layer:
+static/dynamic validation (:99), apply to uncommitted ledger+state, commit a
+batch after ordering (:178), revert on view change/rejection (:195); handler
+dispatch by txn type (:113). Batch bookkeeping (the audit snapshot per batch,
+ts-store writes, seq-no map) mirrors batch_handlers/audit_batch_handler.py:20
+and batch_handlers (ts_store, primary, node_reg rows of SURVEY.md §2).
+
+Design: one manager instance per node; per-batch undo records make
+apply→revert exact inverses, which is the property consensus relies on when
+re-ordering after a view change (SURVEY.md §7 hard part 4).
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Sequence
+
+from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
+                                             CONFIG_LEDGER_ID,
+                                             DOMAIN_LEDGER_ID, POOL_LEDGER_ID)
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.serialization import pack, unpack
+from plenum_tpu.execution import txn as txn_lib
+from plenum_tpu.execution.database_manager import (DatabaseManager,
+                                                   SEQ_NO_DB_LABEL,
+                                                   TS_STORE_LABEL)
+from plenum_tpu.execution.exceptions import (InvalidClientRequest,
+                                             UnauthorizedClientRequest)
+from plenum_tpu.execution.handlers import audit as audit_lib
+from plenum_tpu.execution.handlers.base import WriteRequestHandler
+from plenum_tpu.execution.handlers.taa import (KEY_AML_LATEST, KEY_LATEST,
+                                               _digest_key)
+
+
+class ThreePcBatch(NamedTuple):
+    """What consensus knows about one ordered batch (ref three_pc_batch.py:7)."""
+    ledger_id: int
+    view_no: int
+    pp_seq_no: int
+    pp_time: float
+    valid_digests: tuple[str, ...]
+    state_root: bytes
+    txn_root: bytes
+    audit_txn_root: bytes
+    primaries: tuple[str, ...] = ()
+    node_reg: tuple[str, ...] = ()
+
+
+class _Undo(NamedTuple):
+    ledger_id: int
+    n_txns: int
+    prev_state_roots: dict[int, bytes]     # uncommitted heads before apply
+    pp_seq_no: int
+
+
+class WriteRequestManager:
+    def __init__(self, db: DatabaseManager,
+                 primaries_provider: Optional[Callable[[], Sequence[str]]] = None,
+                 node_reg_provider: Optional[Callable[[], Sequence[str]]] = None,
+                 taa_acceptance_window: float = 2 * 24 * 3600):
+        self.db = db
+        self._handlers: dict[str, WriteRequestHandler] = {}
+        self._batches: list[_Undo] = []
+        self._primaries_provider = primaries_provider or (lambda: [])
+        self._node_reg_provider = node_reg_provider or (lambda: [])
+        self._taa_window = taa_acceptance_window
+        self.on_batch_committed: list[Callable[[ThreePcBatch, list[dict]], None]] = []
+
+    # --- registry ---------------------------------------------------------
+
+    def register_handler(self, handler: WriteRequestHandler) -> None:
+        self._handlers[handler.txn_type] = handler
+
+    def handler_for(self, txn_type: Optional[str]) -> WriteRequestHandler:
+        if txn_type not in self._handlers:
+            raise InvalidClientRequest(reason=f"unknown txn type {txn_type!r}")
+        return self._handlers[txn_type]
+
+    def is_write_type(self, txn_type: Optional[str]) -> bool:
+        return txn_type in self._handlers
+
+    def ledger_id_for(self, request: Request) -> int:
+        return self.handler_for(request.txn_type).ledger_id
+
+    # --- validation -------------------------------------------------------
+
+    def static_validation(self, request: Request) -> None:
+        self.handler_for(request.txn_type).static_validation(request)
+
+    def dynamic_validation(self, request: Request, pp_time: Optional[float]) -> None:
+        handler = self.handler_for(request.txn_type)
+        if handler.ledger_id == DOMAIN_LEDGER_ID:
+            self._validate_taa_acceptance(request, pp_time)
+        handler.dynamic_validation(request, pp_time)
+
+    def _validate_taa_acceptance(self, request: Request, pp_time) -> None:
+        """Domain writes must carry a valid acceptance while a TAA is active
+        (reference: TAA validation in dynamic path of the write manager)."""
+        config_state = self.db.get_state(CONFIG_LEDGER_ID)
+        if config_state is None:
+            return
+        latest = config_state.get(KEY_LATEST, committed=False)
+        acceptance = request.taa_acceptance
+        if latest is None:
+            if acceptance is not None:
+                raise UnauthorizedClientRequest(
+                    request.identifier, request.req_id,
+                    "taaAcceptance not allowed: no active TAA")
+            return
+        if acceptance is None:
+            raise UnauthorizedClientRequest(
+                request.identifier, request.req_id,
+                "transaction author agreement acceptance required")
+        digest = acceptance.get("taaDigest")
+        raw = config_state.get(_digest_key(digest), committed=False) \
+            if digest else None
+        rec = unpack(raw) if raw is not None else None
+        if rec is None:
+            raise UnauthorizedClientRequest(
+                request.identifier, request.req_id,
+                f"unknown TAA digest {digest!r}")
+        ret = rec.get("retirement_ts")
+        if ret is not None and pp_time is not None and ret <= pp_time:
+            raise UnauthorizedClientRequest(
+                request.identifier, request.req_id, "TAA version is retired")
+        aml_raw = config_state.get(KEY_AML_LATEST, committed=False)
+        aml = unpack(aml_raw) if aml_raw is not None else None
+        mech = acceptance.get("mechanism")
+        if aml is not None and mech not in aml.get("aml", {}):
+            raise UnauthorizedClientRequest(
+                request.identifier, request.req_id,
+                f"unknown acceptance mechanism {mech!r}")
+        at = acceptance.get("time")
+        if at is None or (pp_time is not None and
+                          abs(at - pp_time) > self._taa_window):
+            raise UnauthorizedClientRequest(
+                request.identifier, request.req_id,
+                "acceptance time outside the allowed window")
+
+    # --- apply / revert / commit -----------------------------------------
+
+    def apply_batch(self, ledger_id: int, requests: Sequence[Request],
+                    pp_time: float, view_no: int, pp_seq_no: int
+                    ) -> tuple[list[Request], list[tuple[Request, str]], dict]:
+        """Dynamic-validate and apply a batch to uncommitted ledger+state.
+
+        Returns (valid, [(request, reason) rejected], roots) where roots has
+        hex 'state_root', 'txn_root', 'pool_state_root', 'audit_txn_root'.
+        """
+        ledger = self.db.get_ledger(ledger_id)
+        state = self.db.get_state(ledger_id)
+        prev_roots: dict[int, bytes] = {}
+        for lid in self.db.ledger_ids:
+            st = self.db.get_state(lid)
+            if st is not None:
+                prev_roots[lid] = st.head_hash
+
+        valid, rejected, txns = [], [], []
+        base_seq = ledger.uncommitted_size    # total incl. staged
+        for req in requests:
+            try:
+                self.dynamic_validation(req, pp_time)
+            except (InvalidClientRequest, UnauthorizedClientRequest) as e:
+                rejected.append((req, e.reason))
+                continue
+            handler = self.handler_for(req.txn_type)
+            txn = handler.gen_txn(req)
+            txn_lib.set_seq_no(txn, base_seq + len(txns) + 1)
+            txn_lib.set_txn_time(txn, int(pp_time))
+            handler.update_state(txn, is_committed=False)
+            txns.append(txn)
+            valid.append(req)
+        ledger.append_txns_to_uncommitted(txns)
+
+        audit_ledger = self.db.get_ledger(AUDIT_LEDGER_ID)
+        if audit_ledger is not None:
+            last = self._last_uncommitted_audit(audit_ledger)
+            audit_txn = audit_lib.build_audit_txn(
+                self.db, view_no, pp_seq_no, pp_time, ledger_id,
+                self._primaries_provider(), self._node_reg_provider(), last)
+            txn_lib.set_seq_no(audit_txn, audit_ledger.uncommitted_size + 1)
+            audit_ledger.append_txns_to_uncommitted([audit_txn])
+
+        self._batches.append(_Undo(ledger_id, len(txns), prev_roots, pp_seq_no))
+        pool_state = self.db.get_state(POOL_LEDGER_ID)
+        roots = {
+            "state_root": (state.head_hash.hex() if state is not None else ""),
+            "txn_root": ledger.uncommitted_root_hash.hex(),
+            "pool_state_root": (pool_state.head_hash.hex()
+                                if pool_state is not None else ""),
+            "audit_txn_root": (audit_ledger.uncommitted_root_hash.hex()
+                               if audit_ledger is not None else ""),
+        }
+        return valid, rejected, roots
+
+    def _last_uncommitted_audit(self, audit_ledger) -> Optional[dict]:
+        staged = audit_ledger.uncommitted_txns
+        if staged:
+            return staged[-1]
+        return audit_lib.last_audit_txn(audit_ledger)
+
+    def revert_last_batch(self, ledger_id: int) -> None:
+        """Exact inverse of the most recent apply for this ledger."""
+        for i in range(len(self._batches) - 1, -1, -1):
+            if self._batches[i].ledger_id == ledger_id:
+                undo = self._batches.pop(i)
+                break
+        else:
+            raise ValueError(f"no applied batch for ledger {ledger_id}")
+        self.db.get_ledger(ledger_id).discard_txns(undo.n_txns)
+        audit_ledger = self.db.get_ledger(AUDIT_LEDGER_ID)
+        if audit_ledger is not None and audit_ledger.uncommitted_txns:
+            audit_ledger.discard_txns(1)
+        for lid, root in undo.prev_state_roots.items():
+            st = self.db.get_state(lid)
+            if st is not None:
+                st.revert_to_head(root)
+
+    def commit_batch(self, batch: ThreePcBatch) -> list[dict]:
+        """Make the oldest applied batch durable; returns committed txns
+        (ref write_request_manager.py:178 + audit/ts batch handlers)."""
+        if not self._batches or self._batches[0].pp_seq_no != batch.pp_seq_no:
+            # tolerate out-of-order callers only if the batch is the oldest
+            if not self._batches:
+                raise ValueError("commit with no applied batches")
+        undo = self._batches.pop(0)
+        ledger = self.db.get_ledger(undo.ledger_id)
+        committed, _ = ledger.commit_txns(undo.n_txns)
+        state = self.db.get_state(undo.ledger_id)
+        if state is not None:
+            state.commit(batch.state_root or None)
+        audit_ledger = self.db.get_ledger(AUDIT_LEDGER_ID)
+        if audit_ledger is not None and audit_ledger.uncommitted_txns:
+            audit_ledger.commit_txns(1)
+
+        ts_store = self.db.get_store(TS_STORE_LABEL)
+        if ts_store is not None and state is not None:
+            ts_store.put(str(int(batch.pp_time)).encode(),
+                         state.committed_head_hash)
+        seq_no_db = self.db.get_store(SEQ_NO_DB_LABEL)
+        if seq_no_db is not None:
+            for txn in committed:
+                pd = txn_lib.txn_payload_digest(txn)
+                if pd:
+                    seq_no_db.put(pd.encode(), pack(
+                        (undo.ledger_id, txn_lib.txn_seq_no(txn),
+                         txn_lib.txn_time(txn))))
+        for cb in self.on_batch_committed:
+            cb(batch, committed)
+        return committed
+
+    @property
+    def uncommitted_batch_count(self) -> int:
+        return len(self._batches)

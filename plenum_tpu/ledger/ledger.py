@@ -119,7 +119,12 @@ class Ledger:
 
     @property
     def uncommitted_size(self) -> int:
+        """TOTAL size including staged txns (committed size + staged count)."""
         return self.seq_no + len(self._uncommitted)
+
+    @property
+    def uncommitted_txns(self) -> list[dict]:
+        return list(self._uncommitted)
 
     @property
     def uncommitted_root_hash(self) -> bytes:

@@ -1,0 +1,167 @@
+"""Node bootstrap: storages, ledgers, states, handlers, managers, BLS, authN.
+
+Reference behavior: plenum/server/node_bootstrap.py:17 + ledgers_bootstrap.py —
+build the 4 base ledgers in catchup order (audit, pool, config, domain,
+node.py:142), a state trie per non-audit ledger, register request + batch
+handlers, wire BLS, and replay committed txns into state so a restarted (or
+genesis-seeded) node starts from consistent roots.
+"""
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional, Sequence
+
+from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
+                                             CONFIG_LEDGER_ID,
+                                             DOMAIN_LEDGER_ID, POOL_LEDGER_ID)
+from plenum_tpu.consensus.bls_bft_replica import (BlsBftReplica, BlsKeyRegister,
+                                                  BlsStore)
+from plenum_tpu.crypto.bls import BlsCryptoSigner, BlsCryptoVerifier
+from plenum_tpu.crypto.ed25519 import make_verifier
+from plenum_tpu.execution import (DatabaseManager, LedgerBatchExecutor,
+                                  ReadRequestManager, WriteRequestManager)
+from plenum_tpu.execution.database_manager import (BLS_STORE_LABEL,
+                                                   NODE_STATUS_DB_LABEL,
+                                                   SEQ_NO_DB_LABEL,
+                                                   TS_STORE_LABEL)
+from plenum_tpu.execution.handlers import (GetFrozenLedgersHandler,
+                                           GetNymHandler,
+                                           GetTxnAuthorAgreementAmlHandler,
+                                           GetTxnAuthorAgreementHandler,
+                                           GetTxnHandler, LedgersFreezeHandler,
+                                           NodeHandler, NymHandler,
+                                           TxnAuthorAgreementAmlHandler,
+                                           TxnAuthorAgreementDisableHandler,
+                                           TxnAuthorAgreementHandler)
+from plenum_tpu.execution.txn import NODE, NYM
+from plenum_tpu.execution import txn as txn_lib
+from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+from plenum_tpu.ledger.hash_store import HashStore
+from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.node.client_authn import CoreAuthNr, ReqAuthenticator
+from plenum_tpu.node.pool_manager import TxnPoolManager
+from plenum_tpu.state.pruning_state import PruningState
+from plenum_tpu.storage.kv_file import KvFile
+from plenum_tpu.storage.kv_memory import KvMemory
+
+
+class NodeComponents(NamedTuple):
+    db: DatabaseManager
+    write_manager: WriteRequestManager
+    read_manager: ReadRequestManager
+    executor: LedgerBatchExecutor
+    authenticator: ReqAuthenticator
+    pool_manager: TxnPoolManager
+    nym_handler: NymHandler
+    node_handler: NodeHandler
+    bls_signer: Optional[BlsCryptoSigner]
+    bls_register: BlsKeyRegister
+    bls_store: BlsStore
+
+
+class NodeBootstrap:
+    """Builds everything below the Node orchestrator."""
+
+    def __init__(self, name: str,
+                 genesis_txns: Optional[dict[int, Sequence[dict]]] = None,
+                 data_dir: Optional[str] = None,
+                 crypto_backend: str = "cpu",
+                 bls_seed: Optional[bytes] = None):
+        self.name = name
+        self.genesis = genesis_txns or {}
+        self.data_dir = data_dir
+        self.crypto_backend = crypto_backend
+        self.bls_seed = bls_seed or name.encode().ljust(32, b"\0")[:32]
+
+    # --- storage factories -------------------------------------------------
+
+    def _kv(self, label: str):
+        if self.data_dir is None:
+            return KvMemory()
+        os.makedirs(self.data_dir, exist_ok=True)
+        return KvFile(os.path.join(self.data_dir, label))
+
+    def _ledger(self, ledger_id: int, label: str) -> Ledger:
+        tree = CompactMerkleTree(hash_store=HashStore(self._kv(f"{label}_hashes")))
+        return Ledger(tree, self._kv(f"{label}_log"),
+                      genesis_txns=self.genesis.get(ledger_id, ()))
+
+    # --- build -------------------------------------------------------------
+
+    def build(self) -> NodeComponents:
+        db = DatabaseManager()
+        # catchup order: audit, pool, config, domain (ref node.py:142)
+        db.register_ledger(AUDIT_LEDGER_ID, self._ledger(AUDIT_LEDGER_ID, "audit"))
+        db.register_ledger(POOL_LEDGER_ID, self._ledger(POOL_LEDGER_ID, "pool"),
+                           PruningState(self._kv("pool_state")))
+        db.register_ledger(CONFIG_LEDGER_ID, self._ledger(CONFIG_LEDGER_ID, "config"),
+                           PruningState(self._kv("config_state")))
+        db.register_ledger(DOMAIN_LEDGER_ID, self._ledger(DOMAIN_LEDGER_ID, "domain"),
+                           PruningState(self._kv("domain_state")))
+        db.register_store(TS_STORE_LABEL, self._kv("ts_store"))
+        db.register_store(SEQ_NO_DB_LABEL, self._kv("seq_no_db"))
+        db.register_store(NODE_STATUS_DB_LABEL, self._kv("node_status_db"))
+        bls_store = BlsStore(self._kv("bls_store"))
+        db.register_store(BLS_STORE_LABEL, bls_store)
+
+        # handlers + managers
+        write_manager = WriteRequestManager(db)
+        nym = NymHandler(db)
+        bls_verifier = BlsCryptoVerifier()
+        node_handler = NodeHandler(db, nym, bls_verifier=bls_verifier)
+        write_manager.register_handler(nym)
+        write_manager.register_handler(node_handler)
+        write_manager.register_handler(TxnAuthorAgreementHandler(db, nym))
+        write_manager.register_handler(TxnAuthorAgreementAmlHandler(db, nym))
+        write_manager.register_handler(TxnAuthorAgreementDisableHandler(db, nym))
+        write_manager.register_handler(LedgersFreezeHandler(db, nym))
+        read_manager = ReadRequestManager()
+        read_manager.register_handler(GetNymHandler(db))
+        read_manager.register_handler(GetTxnHandler(db))
+        read_manager.register_handler(GetTxnAuthorAgreementHandler(db))
+        read_manager.register_handler(GetTxnAuthorAgreementAmlHandler(db))
+        read_manager.register_handler(GetFrozenLedgersHandler(db))
+
+        self._replay_genesis_state(db, nym, node_handler, write_manager)
+
+        # client authN over the Ed25519 provider seam (cpu | jax)
+        authnr = ReqAuthenticator()
+        authnr.register_authenticator(CoreAuthNr(
+            make_verifier(self.crypto_backend), get_verkey=nym.get_verkey))
+
+        # BLS: signer from seed; registry fed from pool state
+        bls_signer = BlsCryptoSigner(seed=self.bls_seed)
+        bls_register = BlsKeyRegister()
+        pool_manager = TxnPoolManager(node_handler)
+        self._sync_bls_register(bls_register, pool_manager)
+
+        executor = LedgerBatchExecutor(write_manager)
+        return NodeComponents(db, write_manager, read_manager, executor,
+                              authnr, pool_manager, nym, node_handler,
+                              bls_signer, bls_register, bls_store)
+
+    def _replay_genesis_state(self, db, nym, node_handler, wm) -> None:
+        """Replay committed ledger txns through handlers into state (restart
+        recovery / genesis seeding; ref ledgers_bootstrap init_state_from_ledger)."""
+        handlers = {NYM: nym, NODE: node_handler}
+        for h in wm._handlers.values():
+            handlers.setdefault(h.txn_type, h)
+        for lid in (POOL_LEDGER_ID, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID):
+            ledger = db.get_ledger(lid)
+            state = db.get_state(lid)
+            if state is None or ledger.size == 0:
+                continue
+            if len(state.as_dict(committed=True)) > 0:
+                continue                      # persistent state already built
+            for seq_no in range(1, ledger.size + 1):
+                txn = ledger.get_by_seq_no(seq_no)
+                handler = handlers.get(txn_lib.txn_type_of(txn))
+                if handler is not None:
+                    handler.update_state(txn, is_committed=True)
+            state.commit(state.head_hash)
+
+    @staticmethod
+    def _sync_bls_register(register: BlsKeyRegister,
+                           pool_manager: TxnPoolManager) -> None:
+        for name in pool_manager.node_names:
+            register.set_key(name, pool_manager.bls_key_of(name))

@@ -1,0 +1,151 @@
+"""Client request authentication — the primary Ed25519 hot spot, batch-first.
+
+Reference behavior: plenum/server/client_authn.py (NaclAuthNr:82 scalar verify
+per signer, CoreAuthNr:273 resolving DID→verkey from domain state) and
+req_authenticator.py:11 — every node verifies every propagated request
+(node.py:2624), which is why SURVEY.md §3.2 marks this n×-per-request path as
+the throughput ceiling.
+
+TPU-first design difference: the API is batch-shaped end to end.
+`authenticate_batch` collects every (message, signature, verkey) triple across
+a whole quota of requests and issues ONE device dispatch through the
+Ed25519Verifier seam; per-request verdicts map back to accept/reject exactly
+like the reference's per-message path (SURVEY.md §7 hard part 1).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from plenum_tpu.common.request import Request
+from plenum_tpu.crypto.ed25519 import Ed25519Verifier, make_verifier
+from plenum_tpu.utils.base58 import b58decode
+
+
+class AuthError(Exception):
+    pass
+
+
+class MissingSignature(AuthError):
+    pass
+
+
+class InvalidSignature(AuthError):
+    pass
+
+
+class UnknownIdentifier(AuthError):
+    pass
+
+
+class CoreAuthNr:
+    """Verifies request signatures against DID verkeys from domain state.
+
+    get_verkey(did) -> base58 verkey or None; abbreviated verkeys ("~xxx")
+    are completed with the DID prefix bytes, as indy DIDs do.
+    """
+
+    def __init__(self, verifier: Optional[Ed25519Verifier] = None,
+                 get_verkey=None):
+        self.verifier = verifier or make_verifier("cpu")
+        self._get_verkey = get_verkey or (lambda did: None)
+
+    def _resolve_verkey(self, idr: str) -> Optional[bytes]:
+        vk = self._get_verkey(idr)
+        if vk is None:
+            # self-certifying DID: identifier IS the verkey (or its prefix)
+            try:
+                raw = b58decode(idr)
+            except Exception:
+                return None
+            return raw if len(raw) == 32 else None
+        try:
+            if vk.startswith("~"):     # abbreviated: DID bytes || suffix
+                return b58decode(idr) + b58decode(vk[1:])
+            return b58decode(vk)
+        except Exception:
+            return None
+
+    def collect_items(self, request: Request) -> Optional[list[tuple[bytes, bytes, bytes]]]:
+        """(msg, sig, vk) per signer, or None if any signer is unresolvable.
+        Raises MissingSignature when no signature is present at all."""
+        sigs = request.all_signatures()
+        if not sigs:
+            raise MissingSignature(f"request {request.req_id} is unsigned")
+        msg = request.signing_bytes()
+        items = []
+        for idr, sig_b58 in sigs.items():
+            vk = self._resolve_verkey(idr)
+            if vk is None:
+                return None
+            try:
+                sig = b58decode(sig_b58)
+            except Exception:
+                return None
+            items.append((msg, sig, vk))
+        return items
+
+    def authenticate(self, request: Request) -> list[str]:
+        """-> list of verified identifiers; raises on failure."""
+        verdicts = self.authenticate_batch([request])
+        if not verdicts[0]:
+            raise InvalidSignature(f"request {request.req_id} failed auth")
+        return list(request.all_signatures())
+
+    def authenticate_batch(self, requests: Sequence[Request]) -> np.ndarray:
+        """ONE device dispatch for all signatures of all requests -> bool[N].
+
+        A request passes only if EVERY signer's signature verifies (multi-sig
+        endorsement semantics, ref client_authn.py authenticate_multi:84).
+        """
+        spans: list[tuple[int, int]] = []       # [start, end) into items
+        items: list[tuple[bytes, bytes, bytes]] = []
+        hard_fail = np.zeros(len(requests), dtype=bool)
+        for i, req in enumerate(requests):
+            try:
+                got = self.collect_items(req)
+            except MissingSignature:
+                got = None
+            if got is None:
+                hard_fail[i] = True
+                spans.append((len(items), len(items)))
+                continue
+            spans.append((len(items), len(items) + len(got)))
+            items.extend(got)
+        if items:
+            ok = self.verifier.verify_batch(items)
+        else:
+            ok = np.zeros(0, dtype=bool)
+        out = np.zeros(len(requests), dtype=bool)
+        for i, (start, end) in enumerate(spans):
+            out[i] = (not hard_fail[i]) and bool(ok[start:end].all()) \
+                and end > start
+        return out
+
+
+class ReqAuthenticator:
+    """Registry of authenticators; all registered must accept
+    (ref req_authenticator.py:23)."""
+
+    def __init__(self):
+        self._authnrs: list[CoreAuthNr] = []
+
+    def register_authenticator(self, authnr: CoreAuthNr) -> None:
+        self._authnrs.append(authnr)
+
+    @property
+    def core_authenticator(self) -> CoreAuthNr:
+        return self._authnrs[0]
+
+    def authenticate(self, request: Request) -> list[str]:
+        out: list[str] = []
+        for a in self._authnrs:
+            out = a.authenticate(request)
+        return out
+
+    def authenticate_batch(self, requests: Sequence[Request]) -> np.ndarray:
+        verdict = np.ones(len(requests), dtype=bool)
+        for a in self._authnrs:
+            verdict &= a.authenticate_batch(requests)
+        return verdict

@@ -1,0 +1,299 @@
+"""Node orchestrator: message pipelines, propagation, ordering, execution.
+
+Reference behavior: plenum/server/node.py (Node:129) — the prod() event loop
+(:1037) services client and node inboxes under quotas, validates + propagates
+client requests (processRequest:2000, processPropagate:2099), forwards
+finalized requests to replicas, executes ordered batches
+(processOrdered:2167, executeBatch:2661) and replies to clients
+(:2753-2788). Signature checking (verifySignature:2624) happens on every
+propagated request on every node.
+
+TPU-first design difference: the pipelines are batch-shaped. Each prod cycle
+drains its inbox quota FIRST, then authenticates every pending signature in
+ONE batched Ed25519 dispatch (the accumulate-then-flush design of SURVEY.md §7
+stage 6), then routes per-request verdicts exactly as the reference's scalar
+path would (ack/nack/reject/suspicion).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from plenum_tpu.common.event_bus import ExternalBus
+from plenum_tpu.common.internal_messages import ReqKey
+from plenum_tpu.common.node_messages import (Ordered, Propagate, Reject,
+                                             Reply, RequestAck, RequestNack)
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.timer import TimerService
+from plenum_tpu.config import Config
+from plenum_tpu.consensus.bls_bft_replica import BlsBftReplica
+from plenum_tpu.consensus.replica import Replica, Replicas
+from plenum_tpu.crypto.bls import BlsCryptoVerifier
+from plenum_tpu.execution import txn as txn_lib
+from plenum_tpu.execution.exceptions import (InvalidClientRequest,
+                                             UnauthorizedClientRequest)
+from plenum_tpu.execution.write_manager import ThreePcBatch
+from plenum_tpu.node.bootstrap import NodeComponents
+from plenum_tpu.node.propagator import Propagator
+
+
+class Node:
+    def __init__(self, name: str, timer: TimerService, node_bus: ExternalBus,
+                 components: NodeComponents,
+                 client_send: Optional[Callable[[Any, str], None]] = None,
+                 config: Optional[Config] = None,
+                 instance_count: Optional[int] = None):
+        self.name = name
+        self.timer = timer
+        self.node_bus = node_bus
+        self.config = config or Config()
+        self.c = components
+        self._client_send = client_send or (lambda msg, client: None)
+
+        self.pool_manager = components.pool_manager
+        self.pool_manager._on_changed = self._on_pool_changed
+        self.validators = self.pool_manager.node_names or [name]
+        self.quorums = self.pool_manager.quorums
+
+        self.propagator = Propagator(
+            name, self.quorums,
+            send_to_nodes=lambda msg: self.node_bus.send(msg),
+            forward_to_replicas=self._forward_to_replicas)
+
+        # RBFT: f+1 protocol instances (ref replicas.py:19)
+        n_inst = instance_count if instance_count is not None \
+            else self.quorums.f + 1
+        self.replicas = Replicas(self._make_replica)
+        self.replicas.grow_to(max(1, n_inst))
+
+        # audit txns snapshot the current primaries + node reg
+        # (ref audit_batch_handler.py:83-231)
+        components.write_manager._primaries_provider = (
+            lambda: list(self.replicas.master.data.primaries))
+        components.write_manager._node_reg_provider = (
+            lambda: list(self.validators))
+
+        # inboxes (quota-drained each prod; ref zstack quotas config.py:250)
+        self._client_inbox: list[tuple[dict, str]] = []
+        self._propagate_inbox: list[tuple[Propagate, str]] = []
+        self._ordered_queue: list[Ordered] = []
+        self._seen_propagates: set[tuple[str, str]] = set()   # (digest, frm)
+
+        self.node_bus.subscribe(Propagate, self._receive_propagate)
+        self.spylog: list[tuple[str, Any]] = []    # lightweight event trace
+
+    # --- wiring -----------------------------------------------------------
+
+    def _make_replica(self, inst_id: int) -> Replica:
+        bls = BlsBftReplica(
+            node_name=self.name, bls_signer=self.c.bls_signer,
+            bls_verifier=BlsCryptoVerifier(),
+            key_register=self.c.bls_register,
+            bls_store=self.c.bls_store if inst_id == 0 else None)
+        audit = self.c.db.get_ledger(3)
+        replica = Replica(
+            node_name=self.name, inst_id=inst_id,
+            validators=self.validators, timer=self.timer,
+            network=self.node_bus,
+            executor=self.c.executor if inst_id == 0 else None,
+            bls=bls, config=self.config,
+            get_request=self.propagator.requests.get_request,
+            checkpoint_digest_provider=(
+                lambda seq: audit.uncommitted_root_hash.hex()),
+            instance_count=max(1, self.pool_manager.quorums.f + 1))
+        replica.internal_bus.subscribe(Ordered, self._on_ordered)
+        return replica
+
+    def _forward_to_replicas(self, digest: str) -> None:
+        for replica in self.replicas:
+            replica.internal_bus.send(ReqKey(digest))
+
+    def _on_ordered(self, msg: Ordered) -> None:
+        self._ordered_queue.append(msg)
+
+    def _on_pool_changed(self) -> None:
+        """Pool-ledger commit changed membership: recompute quorums, update
+        validators and BLS keys (ref node.py:731 setPoolParams)."""
+        self.validators = self.pool_manager.node_names or [self.name]
+        self.quorums = self.pool_manager.quorums
+        self.propagator.set_quorums(self.quorums)
+        for replica in self.replicas:
+            replica.set_validators(self.validators)
+        for n in self.pool_manager.node_names:
+            self.c.bls_register.set_key(n, self.pool_manager.bls_key_of(n))
+
+    # --- ingress ----------------------------------------------------------
+
+    def handle_client_message(self, msg: dict, frm: str) -> None:
+        self._client_inbox.append((msg, frm))
+
+    def _receive_propagate(self, msg: Propagate, frm: str) -> None:
+        self._propagate_inbox.append((msg, frm))
+
+    # --- the prod loop ----------------------------------------------------
+
+    def prod(self) -> int:
+        """One event-loop cycle (ref node.py:1037). Returns work count."""
+        count = 0
+        count += self._service_client_msgs()
+        count += self._service_propagates()
+        self.replicas.service_all()
+        count += self._service_ordered()
+        return count
+
+    # --- client pipeline --------------------------------------------------
+
+    def _service_client_msgs(self) -> int:
+        quota = self.config.LISTENER_MESSAGE_QUOTA
+        batch, self._client_inbox = (self._client_inbox[:quota],
+                                     self._client_inbox[quota:])
+        to_auth: list[tuple[Request, str]] = []
+        for msg, frm in batch:
+            try:
+                request = Request.from_dict(msg)
+            except Exception:
+                self._client_send(RequestNack(
+                    identifier=str(msg.get("identifier")),
+                    req_id=msg.get("reqId") or 0,
+                    reason="malformed request"), frm)
+                continue
+            if self.c.read_manager.is_query_type(request.txn_type):
+                self._answer_query(request, frm)
+            elif self.c.write_manager.is_write_type(request.txn_type):
+                to_auth.append((request, frm))
+            else:
+                self._client_send(RequestNack(
+                    identifier=request.identifier, req_id=request.req_id,
+                    reason=f"unknown txn type {request.txn_type!r}"), frm)
+        if to_auth:
+            self._auth_and_propagate(to_auth)
+        return len(batch)
+
+    def _answer_query(self, request: Request, frm: str) -> None:
+        try:
+            self.c.read_manager.static_validation(request)
+            result = self.c.read_manager.get_result(request)
+        except InvalidClientRequest as e:
+            self._client_send(RequestNack(identifier=request.identifier,
+                                          req_id=request.req_id,
+                                          reason=e.reason), frm)
+            return
+        self._client_send(Reply(result=result), frm)
+
+    def _auth_and_propagate(self, items: list[tuple[Request, str]]) -> None:
+        """Batch-verify client signatures, then ack+propagate the valid ones
+        (ref processRequest:2000 → recordAndPropagate)."""
+        requests = [r for r, _ in items]
+        statics_ok = []
+        for req, frm in items:
+            try:
+                self.c.write_manager.static_validation(req)
+                statics_ok.append(True)
+            except InvalidClientRequest as e:
+                self._client_send(RequestNack(identifier=req.identifier,
+                                              req_id=req.req_id,
+                                              reason=e.reason), frm)
+                statics_ok.append(False)
+        verdicts = self.c.authenticator.authenticate_batch(requests)
+        for (req, frm), ok, st in zip(items, verdicts, statics_ok):
+            if not st:
+                continue
+            if not ok:
+                self._client_send(RequestNack(identifier=req.identifier,
+                                              req_id=req.req_id,
+                                              reason="signature verification failed"),
+                                  frm)
+                continue
+            # dedup: already-executed request -> resend the Reply
+            state = self.propagator.requests.get(req.digest)
+            if state is not None and state.executed:
+                continue
+            self._client_send(RequestAck(identifier=req.identifier,
+                                         req_id=req.req_id), frm)
+            self.propagator.propagate(req, frm)
+
+    # --- node pipeline ----------------------------------------------------
+
+    def _service_propagates(self) -> int:
+        quota = self.config.REMOTES_MESSAGE_QUOTA
+        batch, self._propagate_inbox = (self._propagate_inbox[:quota],
+                                        self._propagate_inbox[quota:])
+        verified: list[tuple[Propagate, str, Request]] = []
+        to_auth: list[tuple[Propagate, str, Request]] = []
+        for msg, frm in batch:
+            try:
+                request = Request.from_dict(msg.request)
+            except Exception:
+                continue
+            key = (request.digest, frm)
+            if key in self._seen_propagates:
+                continue
+            self._seen_propagates.add(key)
+            if request.digest in self.propagator.requests:
+                # signature was already verified when first seen
+                verified.append((msg, frm, request))
+            else:
+                to_auth.append((msg, frm, request))
+        if to_auth:
+            verdicts = self.c.authenticator.authenticate_batch(
+                [r for _, _, r in to_auth])
+            for (msg, frm, req), ok in zip(to_auth, verdicts):
+                if ok:
+                    verified.append((msg, frm, req))
+                else:
+                    self.spylog.append(("suspicious_propagate", frm))
+        for msg, frm, _ in verified:
+            self.propagator.process_propagate(msg, frm)
+        return len(batch)
+
+    # --- ordered batches --------------------------------------------------
+
+    def _service_ordered(self) -> int:
+        done = 0
+        while self._ordered_queue:
+            msg = self._ordered_queue.pop(0)
+            done += 1
+            if msg.inst_id != 0:
+                self.spylog.append(("backup_ordered", msg))
+                continue
+            self._execute_batch(msg)
+        return done
+
+    def _execute_batch(self, msg: Ordered) -> None:
+        """Commit the ordered batch and REPLY (ref executeBatch:2661)."""
+        batch = ThreePcBatch(
+            ledger_id=msg.ledger_id, view_no=msg.view_no,
+            pp_seq_no=msg.pp_seq_no, pp_time=msg.pp_time,
+            valid_digests=tuple(msg.req_idr),
+            state_root=bytes.fromhex(msg.state_root) if msg.state_root else b"",
+            txn_root=bytes.fromhex(msg.txn_root) if msg.txn_root else b"",
+            audit_txn_root=(bytes.fromhex(msg.audit_txn_root)
+                            if msg.audit_txn_root else b""),
+            primaries=tuple(self.replicas.master.data.primaries),
+            node_reg=tuple(self.validators))
+        committed = self.c.executor.commit_batch(batch)
+        self.spylog.append(("executed", (msg.view_no, msg.pp_seq_no)))
+        for txn in committed:
+            digest = txn_lib.txn_digest(txn)
+            state = self.propagator.requests.get(digest) if digest else None
+            self.propagator.requests.mark_executed(digest)
+            if state is not None and state.client_name is not None:
+                self._client_send(Reply(result=txn), state.client_name)
+        for digest in msg.discarded:
+            state = self.propagator.requests.get(digest)
+            if state is not None and state.client_name is not None:
+                self._client_send(Reject(identifier=state.request.identifier,
+                                         req_id=state.request.req_id,
+                                         reason="rejected by dynamic validation"),
+                                  state.client_name)
+        if msg.ledger_id == 0:
+            self.pool_manager.pool_changed()
+
+    # --- accessors --------------------------------------------------------
+
+    @property
+    def master_replica(self) -> Replica:
+        return self.replicas.master
+
+    @property
+    def f(self) -> int:
+        return self.quorums.f

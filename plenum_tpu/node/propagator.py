@@ -1,0 +1,105 @@
+"""Request dissemination + propagate quorum.
+
+Reference behavior: plenum/server/propagator.py — on first sight of a client
+REQUEST a node broadcasts PROPAGATE (:204); a request finalizes when f+1
+matching propagates are seen (req_with_acceptable_quorum:132, set_finalised
+:136) and is then forwarded to every replica's queue as a ReqKey. Matching
+means same digest from distinct senders; a node's own propagate counts.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from plenum_tpu.common.node_messages import Propagate
+from plenum_tpu.common.quorums import Quorums
+from plenum_tpu.common.request import Request
+
+
+class RequestState:
+    __slots__ = ("request", "propagates", "finalised", "forwarded",
+                 "client_name", "executed")
+
+    def __init__(self, request: Request):
+        self.request = request
+        self.propagates: dict[str, bool] = {}      # sender node -> seen
+        self.finalised = False
+        self.forwarded = False
+        self.executed = False
+        self.client_name: Optional[str] = None     # who to REPLY to
+
+
+class Requests(dict):
+    """digest -> RequestState (ref propagator.py Requests)."""
+
+    def add(self, request: Request) -> RequestState:
+        if request.digest not in self:
+            self[request.digest] = RequestState(request)
+        return self[request.digest]
+
+    def add_propagate(self, request: Request, sender: str) -> RequestState:
+        state = self.add(request)
+        state.propagates[sender] = True
+        return state
+
+    def votes(self, digest: str) -> int:
+        state = self.get(digest)
+        return len(state.propagates) if state else 0
+
+    def get_request(self, digest: str) -> Optional[Request]:
+        state = self.get(digest)
+        return state.request if state else None
+
+    def mark_executed(self, digest: str) -> None:
+        state = self.get(digest)
+        if state:
+            state.executed = True
+
+    def free(self, digest: str) -> None:
+        self.pop(digest, None)
+
+
+class Propagator:
+    def __init__(self, name: str, quorums: Quorums,
+                 send_to_nodes: Callable,
+                 forward_to_replicas: Callable[[str], None]):
+        self.name = name
+        self.quorums = quorums
+        self.requests = Requests()
+        self._send = send_to_nodes
+        self._forward = forward_to_replicas
+
+    def set_quorums(self, quorums: Quorums) -> None:
+        self.quorums = quorums
+
+    def propagate(self, request: Request, client_name: Optional[str]) -> None:
+        """First sight of a finalizable request: record own vote + broadcast."""
+        state = self.requests.add(request)
+        if client_name is not None:
+            state.client_name = client_name
+        if self.name not in state.propagates:
+            state.propagates[self.name] = True
+            self._send(Propagate(request=request.to_dict(),
+                                 sender_client=client_name))
+        self._try_finalize(request.digest)
+
+    def process_propagate(self, msg: Propagate, frm: str) -> None:
+        request = Request.from_dict(msg.request)
+        state = self.requests.add_propagate(request, frm)
+        if state.client_name is None and msg.sender_client:
+            state.client_name = msg.sender_client
+        # relay our own propagate the first time we see the request at all
+        if self.name not in state.propagates:
+            state.propagates[self.name] = True
+            self._send(Propagate(request=request.to_dict(),
+                                 sender_client=msg.sender_client))
+        self._try_finalize(request.digest)
+
+    def _try_finalize(self, digest: str) -> None:
+        state = self.requests.get(digest)
+        if state is None or state.finalised:
+            return
+        if self.quorums.propagate.is_reached(len(state.propagates)):
+            state.finalised = True
+            if not state.forwarded:
+                state.forwarded = True
+                self._forward(digest)

@@ -25,6 +25,8 @@ Design notes (TPU-first):
 """
 from __future__ import annotations
 
+from typing import Sequence
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -126,8 +128,46 @@ def f_mul(f, g):
     return _carry(jnp.stack(c[:NLIMB], axis=-1))
 
 
+# p-2 bits MSB-first; the exponent is fixed so the bit table is a constant
+_P2_BITS = np.array([(P - 2) >> i & 1 for i in range(254, -1, -1)],
+                    dtype=np.int64)
+
+
+def f_inv(z):
+    """z^(p-2) (Fermat inversion) as ONE square-and-multiply fori_loop.
+
+    Needed to compress the recomputed R' on device (affine y = Y/Z), which is
+    what lets verification compare raw signature bytes instead of paying a
+    pure-Python modular sqrt per signature on host to decompress R.
+
+    Deliberately a single 254-iteration loop with an arithmetic blend rather
+    than the classic unrolled addition chain: the chain's ~265 inline f_mul
+    calls made XLA:TPU compilation take minutes, while this shape (same as the
+    main double-scalar loop) compiles fast and costs only ~25% more multiplies.
+    """
+    bits = jnp.asarray(_P2_BITS)
+
+    def body(i, acc):
+        sq = f_mul(acc, acc)
+        mul = f_mul(sq, z)
+        b = bits[i]
+        return b * mul + (1 - b) * sq
+
+    return jax.lax.fori_loop(1, 255, body, z)   # MSB handled by acc=z
+
+
 def f_canon(f):
-    """Canonical form in [0, p): subtract p up to two times."""
+    """Canonical form in [0, p).
+
+    Carried limb form encodes values up to 2^260 ≈ 32p, so conditional
+    subtraction alone is NOT enough: first fold the bits at and above 2^255
+    (limb 9 bits >= 21) down with weight 19, bringing the value below
+    2^255 + 19*32 < 2p; then subtract p up to two times.
+    """
+    f = _carry(f)
+    top = f[..., 9] >> jnp.int64(255 - 9 * RADIX)
+    f = f.at[..., 9].set(f[..., 9] & jnp.int64((1 << (255 - 9 * RADIX)) - 1))
+    f = f.at[..., 0].add(top * 19)
     f = _carry(f)
     p_limbs = jnp.asarray(int_to_limbs(P))
     for _ in range(2):
@@ -183,12 +223,19 @@ def _blend(bit, p_true, p_false):
 
 
 @jax.jit
-def verify_kernel(s_bits, h_bits, ax, ay, az, at, rx, ry):
-    """Batched check [S]B + [h]A' == R (A' = -A precomputed on host).
+def verify_kernel(s_bits, h_bits, ax, ay, az, at, ry, r_sign):
+    """Batched check compress([S]B + [h]A') == R-bytes (A' = -A, host-prepped).
+
+    This is the ref10/OpenSSL verification shape: recompute R' = [S]B - [h]A,
+    compress it, and compare against the first 32 signature bytes — so the
+    host never decompresses R (no per-signature modular sqrt; non-canonical
+    or off-curve R encodings simply fail the compare, same verdict OpenSSL
+    gives).
 
     s_bits/h_bits: int64[NBITS, N] little-endian scalar bits.
     ax..at: int64[N, 10] extended coords of A' (Z=1 from host, so T=X*Y).
-    rx, ry: int64[N, 10] affine coords of R.
+    ry: int64[N, 10] limbs of the low 255 bits of the R encoding.
+    r_sign: int64[N] top bit of the R encoding (x parity).
     Returns bool[N].
     """
     if s_bits.dtype != jnp.int64:
@@ -216,14 +263,14 @@ def verify_kernel(s_bits, h_bits, ax, ay, az, at, rx, ry):
 
     acc = jax.lax.fori_loop(0, NBITS, body, o_pt)
     px, py, pz, _ = acc
-    # affine compare: X/Z == rx, Y/Z == ry  <=>  X == rx*Z, Y == ry*Z
-    lhs_x = f_canon(px)
-    rhs_x = f_canon(f_mul(rx, pz))
-    lhs_y = f_canon(py)
-    rhs_y = f_canon(f_mul(ry, pz))
-    ok_x = jnp.all(lhs_x == rhs_x, axis=-1)
-    ok_y = jnp.all(lhs_y == rhs_y, axis=-1)
-    return ok_x & ok_y
+    # compress on device: affine (x, y) via one shared inversion of Z
+    # (complete Edwards formulas keep Z != 0 for all valid inputs)
+    zinv = f_inv(pz)
+    x_aff = f_canon(f_mul(px, zinv))
+    y_aff = f_canon(f_mul(py, zinv))
+    ok_y = jnp.all(y_aff == ry, axis=-1)
+    ok_sign = (x_aff[..., 0] & jnp.int64(1)) == r_sign
+    return ok_y & ok_sign
 
 
 # --- host-side helpers ----------------------------------------------------
@@ -259,6 +306,22 @@ def scalar_bits(values: list[int]) -> np.ndarray:
     arr = np.frombuffer(raw, dtype=np.uint8).reshape(len(values), 32)
     bits = np.unpackbits(arr, axis=1, bitorder="little")
     return bits[:, :NBITS].T.astype(np.int64)
+
+
+def r_bytes_to_limbs(r_encodings: Sequence[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """[N] 32-byte R encodings -> (ry int64[N, 10], sign int64[N]).
+
+    Pure bit repacking (vectorized numpy) — no field math, no sqrt.
+    """
+    n = len(r_encodings)
+    arr = np.frombuffer(b"".join(r_encodings), dtype=np.uint8).reshape(n, 32)
+    bits = np.unpackbits(arr, axis=1, bitorder="little")        # [N, 256]
+    sign = bits[:, 255].astype(np.int64)
+    padded = np.concatenate(
+        [bits[:, :255], np.zeros((n, NLIMB * RADIX - 255), np.uint8)], axis=1)
+    weights = (1 << np.arange(RADIX, dtype=np.int64))
+    ry = padded.reshape(n, NLIMB, RADIX).astype(np.int64) @ weights
+    return ry, sign
 
 
 def points_to_limbs(points: list[tuple[int, int]]) -> tuple[np.ndarray, ...]:

@@ -1,0 +1,4 @@
+from .mesh import make_mesh, mesh_shape_for
+from .crypto_plane import ShardedCryptoPlane
+
+__all__ = ["make_mesh", "mesh_shape_for", "ShardedCryptoPlane"]

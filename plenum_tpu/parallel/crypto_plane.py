@@ -1,0 +1,102 @@
+"""Sharded crypto batch plane: the multi-chip "training step" of the framework.
+
+Reference behavior being replaced (SURVEY.md §3.2 hot spots): per-message
+scalar Ed25519 verification on every node (client_authn.py:273 via
+nacl_wrappers.py:62) and scalar SHA-256 Merkle appends (ledger/tree_hasher.py).
+Here one SPMD program verifies an [inst, n_sigs] grid of signatures and
+reduces a Merkle root over [n_leaves] leaf digests, sharded over a 2-D
+("inst", "sig") mesh (plenum_tpu/parallel/mesh.py).
+
+Sharding layout (scaling-book recipe: pick mesh, annotate, let XLA insert
+collectives — here the cross-shard reduce is explicit via shard_map):
+  - signature tensors: batch axes sharded over ("inst", "sig"); the 254-round
+    double-scalar-mult advances all lanes in lockstep, zero communication.
+  - Merkle leaves: sharded over the flattened mesh; each shard reduces its
+    local complete subtree, then all_gathers the per-shard roots (one small
+    [n_shards, 8]-word collective on ICI) and finishes the top of the tree
+    redundantly on every device.
+  - verdict count: a psum — the protocol only needs "how many bad" to decide
+    whether to walk the verdict vector on host.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from plenum_tpu.ops import ed25519 as ed_ops
+from plenum_tpu.ops import sha256 as sha_ops
+
+try:  # moved to jax.shard_map in newer releases
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _reduce_roots(roots: jax.Array) -> jax.Array:
+    """Top of the Merkle tree over per-shard roots; pads a non-power-of-two
+    shard count by repeating the last root (shapes are static so this is
+    resolved at trace time)."""
+    s = roots.shape[0]
+    p = 1
+    while p < s:
+        p *= 2
+    if p != s:
+        roots = jnp.concatenate(
+            [roots, jnp.broadcast_to(roots[-1:], (p - s, 8))], axis=0)
+    return sha_ops.merkle_reduce_pow2(roots)
+
+
+def _local_step(s_bits, h_bits, ax, ay, az, at, ry, r_sign, leaves):
+    """Per-shard body. Signature grid arrives as [I_loc, N_loc, ...]; the
+    local grid flattens into one kernel batch. leaves: uint32[L_loc, 8]."""
+    i_loc, n_loc = ax.shape[0], ax.shape[1]
+    m = i_loc * n_loc
+    ok = ed_ops.verify_kernel(
+        s_bits.reshape(ed_ops.NBITS, m), h_bits.reshape(ed_ops.NBITS, m),
+        ax.reshape(m, -1), ay.reshape(m, -1), az.reshape(m, -1),
+        at.reshape(m, -1), ry.reshape(m, -1), r_sign.reshape(m))
+    ok = ok.reshape(i_loc, n_loc)
+
+    local_root = sha_ops.merkle_reduce_pow2(leaves)               # [8]
+    roots = jax.lax.all_gather(local_root, ("inst", "sig"))       # [S, 8]
+    root = _reduce_roots(roots)                                   # [8]
+
+    n_ok = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), ("inst", "sig"))
+    return ok, root, n_ok
+
+
+class ShardedCryptoPlane:
+    """One-dispatch-per-prod-cycle crypto plane over a device mesh.
+
+    verify+merkle+count in a single compiled SPMD program; the host-side
+    consensus engine stages batches in, reads verdict vectors out
+    (SURVEY.md §7 stage 6 "accumulate-then-flush batch queues").
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        spec_sig = P(None, "inst", "sig")          # s_bits/h_bits [NBITS, I, N]
+        spec_pt = P("inst", "sig", None)           # limb tensors  [I, N, 10]
+        spec_scalar = P("inst", "sig")             # r_sign        [I, N]
+        spec_leaf = P(("inst", "sig"), None)       # leaves        [L, 8]
+        # check_vma off: verify_kernel seeds its fori_loop carry with
+        # device-invariant constants (the identity point), which the varying-
+        # manual-axes checker flags even though the computation is replicated-
+        # safe.
+        self._step = jax.jit(_shard_map(
+            _local_step, mesh=mesh,
+            in_specs=(spec_sig, spec_sig, spec_pt, spec_pt, spec_pt,
+                      spec_pt, spec_pt, spec_scalar, spec_leaf),
+            out_specs=(P("inst", "sig"), P(), P()),
+            check_vma=False))
+
+    def step(self, s_bits, h_bits, ax, ay, az, at, ry, r_sign, leaves):
+        """-> (ok[I, N] bool, root uint32[8], n_ok int32).
+
+        Shape contract: I divides mesh 'inst' size exactly; N divides 'sig';
+        the leaf count divides the full mesh and the per-shard leaf count is a
+        power of two (host pads; padding is duplicate leaves whose root the
+        host discards if it padded).
+        """
+        return self._step(s_bits, h_bits, ax, ay, az, at, ry, r_sign, leaves)

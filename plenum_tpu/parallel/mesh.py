@@ -1,0 +1,44 @@
+"""Device mesh construction for the crypto batch plane.
+
+The reference scales by running N independent node processes over CurveZMQ
+(stp_zmq/zstack.py:52, SURVEY.md §2.3). The TPU-native design instead keeps
+consensus logic on host and ships the crypto hot path — signature batches and
+Merkle leaf blocks — onto a device mesh. The two mesh axes mirror the two
+protocol batch axes (SURVEY.md §2.3 table):
+
+  - "inst":  RBFT protocol instances (master + backups, replicas.py:19) —
+             each instance independently validates the same traffic, so the
+             instance axis is embarrassingly parallel.
+  - "sig":   requests within a 3PC batch (Max3PCBatchSize, config.py:256) —
+             the inner axis of the vmapped Ed25519/SHA-256 kernels.
+
+Collectives (all_gather of subtree roots, psum of verdict counts) ride ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_shape_for(n_devices: int) -> tuple[int, int]:
+    """Factor n_devices into (inst, sig) — sig axis gets the larger factor,
+    since request batches are far wider than the instance count (f+1)."""
+    inst = 1
+    for cand in (2, 3):
+        if n_devices % cand == 0 and n_devices > cand:
+            inst = cand
+            break
+    return inst, n_devices // inst
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    inst, sig = mesh_shape_for(len(devs))
+    arr = np.array(devs).reshape(inst, sig)
+    return Mesh(arr, axis_names=("inst", "sig"))

@@ -60,11 +60,15 @@ class PruningState:
         return self._committed_root
 
     def commit(self, root_hash: Optional[bytes] = None) -> None:
-        """Promote the uncommitted head (or an explicit earlier root)."""
+        """Promote the committed pointer to the given root (default: head).
+
+        Deliberately does NOT touch the uncommitted head: with pipelined 3PC
+        batches, later batches are already applied on top of the one being
+        committed (ref pruning_state.py:87 — committing an earlier root while
+        the head advances is the normal case, rewinding here would silently
+        drop the in-flight batches' writes).
+        """
         target = root_hash if root_hash is not None else self._trie.root_hash
-        if target != self._trie.root_hash:
-            # committing a root other than the current head: rewind to it
-            self._trie.root_hash = target
         self._committed_root = target
         self._db.put(b"__committed_head__", target)
 

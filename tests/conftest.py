@@ -1,18 +1,27 @@
 """Test configuration.
 
 Per the build contract: tests run JAX on CPU with 8 virtual devices so
-multi-chip sharding is exercised without TPU hardware. Env must be set before
-jax is imported anywhere.
+multi-chip sharding is exercised without TPU hardware.
+
+NOTE: the env-var route (JAX_PLATFORMS=cpu) does NOT work in this image — the
+axon TPU plugin overrides it at registration time and jax.devices() still
+returns the tunneled TPU. jax.config.update is the only knob that sticks, and
+it must run before the first backend query.
 """
 import os
 
-# Force-overwrite: the environment presets JAX_PLATFORMS=axon (the TPU tunnel);
-# tests must run on the 8-device virtual CPU mesh regardless.
+# Keep the env vars too for subprocesses that re-exec python.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

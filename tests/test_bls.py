@@ -96,3 +96,32 @@ def test_multi_signature_value_roundtrip():
     ms = MultiSignature("sig58", ("Alpha", "Beta"), value)
     assert MultiSignature.from_list(ms.to_list()) == ms
     assert b"state_root_hash" in value.as_single_value()
+
+
+def test_duplicate_participant_multisig_rejected():
+    """A single colluding node's signature aggregated with itself must NOT
+    pass as a quorum multi-sig (rogue self-aggregation)."""
+    from plenum_tpu.common.node_messages import PrePrepare
+    from plenum_tpu.common.quorums import Quorums
+    from plenum_tpu.consensus.bls_bft_replica import (BlsBftReplica,
+                                                      BlsKeyRegister)
+    from plenum_tpu.crypto.bls import (BlsCryptoSigner, BlsCryptoVerifier,
+                                       aggregate_sigs)
+    from plenum_tpu.crypto.multi_signature import (MultiSignature,
+                                                   MultiSignatureValue)
+
+    signer = BlsCryptoSigner(seed=b"X".ljust(32, b"\0"))
+    register = BlsKeyRegister({"X": signer.pk, "Y": "no", "Z": "no", "W": "no"})
+    replica = BlsBftReplica(node_name="Y", bls_signer=None,
+                            bls_verifier=BlsCryptoVerifier(),
+                            key_register=register, quorums=Quorums(4))
+    value = MultiSignatureValue(1, "aa", "bb", "cc", 1.0)
+    sig = signer.sign(value.as_single_value())
+    forged = MultiSignature(signature=aggregate_sigs([sig, sig, sig]),
+                            participants=("X", "X", "X"), value=value)
+    pp = PrePrepare(inst_id=0, view_no=0, pp_seq_no=2, pp_time=1.0,
+                    req_idr=(), discarded=(), digest="d", ledger_id=1,
+                    state_root="aa", txn_root="cc", pool_state_root="bb",
+                    audit_txn_root="", bls_multi_sig=tuple(forged.to_list()))
+    assert replica.validate_pre_prepare(pp, "X") == \
+        BlsBftReplica.PPR_BLS_MULTISIG_WRONG

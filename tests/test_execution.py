@@ -1,0 +1,283 @@
+"""Execution-layer tests: handlers, write manager lifecycle, audit ledger.
+
+Mirrors the reference's handler/batch-handler unit tests
+(plenum/test/req_handler tests, audit_ledger/) at the same seams.
+"""
+import pytest
+
+from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
+                                             CONFIG_LEDGER_ID,
+                                             DOMAIN_LEDGER_ID, POOL_LEDGER_ID)
+from plenum_tpu.common.request import Request
+from plenum_tpu.execution import (DatabaseManager, LedgerBatchExecutor,
+                                  ReadRequestManager, ThreePcBatch,
+                                  WriteRequestManager)
+from plenum_tpu.execution.database_manager import SEQ_NO_DB_LABEL, TS_STORE_LABEL
+from plenum_tpu.execution.exceptions import (InvalidClientRequest,
+                                             UnauthorizedClientRequest)
+from plenum_tpu.execution.handlers import (GetNymHandler,
+                                           GetTxnAuthorAgreementHandler,
+                                           GetTxnHandler, NodeHandler,
+                                           NymHandler,
+                                           TxnAuthorAgreementAmlHandler,
+                                           TxnAuthorAgreementHandler)
+from plenum_tpu.execution.handlers import audit as audit_lib
+from plenum_tpu.execution.handlers.taa import taa_digest
+from plenum_tpu.execution.txn import (NYM, STEWARD, TRUSTEE,
+                                      TXN_AUTHOR_AGREEMENT,
+                                      TXN_AUTHOR_AGREEMENT_AML)
+from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.state.pruning_state import PruningState
+from plenum_tpu.storage.kv_memory import KvMemory
+
+
+TRUSTEE_DID = "trusteeTrusteeTrustee1"
+STEWARD_DID = "stewardStewardSteward1"
+USER_DID = "userUserUserUserUser11"
+
+
+def make_db():
+    db = DatabaseManager()
+    for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID,
+                AUDIT_LEDGER_ID):
+        state = None if lid == AUDIT_LEDGER_ID else PruningState()
+        db.register_ledger(lid, Ledger(), state)
+    db.register_store(TS_STORE_LABEL, KvMemory())
+    db.register_store(SEQ_NO_DB_LABEL, KvMemory())
+    return db
+
+
+@pytest.fixture
+def db():
+    return make_db()
+
+
+def make_managers(db):
+    wm = WriteRequestManager(db)
+    nym = NymHandler(db)
+    wm.register_handler(nym)
+    wm.register_handler(NodeHandler(db, nym))
+    wm.register_handler(TxnAuthorAgreementHandler(db, nym))
+    wm.register_handler(TxnAuthorAgreementAmlHandler(db, nym))
+    rm = ReadRequestManager()
+    rm.register_handler(GetNymHandler(db))
+    rm.register_handler(GetTxnHandler(db))
+    rm.register_handler(GetTxnAuthorAgreementHandler(db))
+    return wm, rm
+
+
+def nym_req(author, dest, role=None, verkey="vk", req_id=1, taa=None):
+    op = {"type": NYM, "dest": dest, "verkey": verkey}
+    if role is not None:
+        op["role"] = role
+    return Request(author, req_id, op, signature="sig", taa_acceptance=taa)
+
+
+def bootstrap_trustee(wm, pp=1):
+    """First NYM into empty state is allowed (pool bootstrap)."""
+    req = nym_req(TRUSTEE_DID, TRUSTEE_DID, role=TRUSTEE)
+    valid, rejected, roots = wm.apply_batch(DOMAIN_LEDGER_ID, [req],
+                                            pp_time=1000.0, view_no=0,
+                                            pp_seq_no=pp)
+    assert len(valid) == 1 and not rejected
+    return roots
+
+
+class TestNymHandler:
+    def test_bootstrap_then_permissioned(self, db):
+        wm, _ = make_managers(db)
+        bootstrap_trustee(wm)
+        # trustee can create
+        ok, rej, _ = wm.apply_batch(DOMAIN_LEDGER_ID,
+                                    [nym_req(TRUSTEE_DID, USER_DID, req_id=2)],
+                                    1001.0, 0, 2)
+        assert len(ok) == 1 and not rej
+        # a plain user cannot create another DID
+        ok, rej, _ = wm.apply_batch(DOMAIN_LEDGER_ID,
+                                    [nym_req(USER_DID, "otherDid111", req_id=3)],
+                                    1002.0, 0, 3)
+        assert not ok and len(rej) == 1
+
+    def test_owner_can_rotate_key_but_not_role(self, db):
+        wm, _ = make_managers(db)
+        bootstrap_trustee(wm)
+        wm.apply_batch(DOMAIN_LEDGER_ID,
+                       [nym_req(TRUSTEE_DID, USER_DID, req_id=2)], 1001.0, 0, 2)
+        ok, rej, _ = wm.apply_batch(
+            DOMAIN_LEDGER_ID,
+            [nym_req(USER_DID, USER_DID, verkey="newvk", req_id=3)],
+            1002.0, 0, 3)
+        assert len(ok) == 1
+        ok, rej, _ = wm.apply_batch(
+            DOMAIN_LEDGER_ID,
+            [nym_req(USER_DID, USER_DID, role=TRUSTEE, req_id=4)],
+            1003.0, 0, 4)
+        assert len(rej) == 1
+
+    def test_static_validation(self, db):
+        wm, _ = make_managers(db)
+        with pytest.raises(InvalidClientRequest):
+            wm.static_validation(Request("a", 1, {"type": NYM}))
+        with pytest.raises(InvalidClientRequest):
+            wm.static_validation(
+                Request("a", 1, {"type": NYM, "dest": "d", "role": "99"}))
+
+
+class TestWriteLifecycle:
+    def test_apply_commit_updates_seq_no_and_ts(self, db):
+        wm, _ = make_managers(db)
+        roots = bootstrap_trustee(wm)
+        batch = ThreePcBatch(DOMAIN_LEDGER_ID, 0, 1, 1000.0, ("x",),
+                             bytes.fromhex(roots["state_root"]),
+                             bytes.fromhex(roots["txn_root"]),
+                             bytes.fromhex(roots["audit_txn_root"]))
+        committed = wm.commit_batch(batch)
+        assert len(committed) == 1
+        ledger = db.get_ledger(DOMAIN_LEDGER_ID)
+        assert ledger.size == 1
+        assert db.get_ledger(AUDIT_LEDGER_ID).size == 1
+        assert db.get_store(TS_STORE_LABEL).get(b"1000") is not None
+
+    def test_revert_is_exact_inverse(self, db):
+        wm, _ = make_managers(db)
+        state = db.get_state(DOMAIN_LEDGER_ID)
+        root0 = state.head_hash
+        ledger = db.get_ledger(DOMAIN_LEDGER_ID)
+        bootstrap_trustee(wm)
+        assert state.head_hash != root0
+        wm.revert_last_batch(DOMAIN_LEDGER_ID)
+        assert state.head_hash == root0
+        assert ledger.uncommitted_size == 0
+        assert db.get_ledger(AUDIT_LEDGER_ID).uncommitted_txns == []
+
+    def test_multi_batch_revert_interleaved(self, db):
+        wm, _ = make_managers(db)
+        bootstrap_trustee(wm, pp=1)
+        state = db.get_state(DOMAIN_LEDGER_ID)
+        mid_root = state.head_hash
+        wm.apply_batch(DOMAIN_LEDGER_ID,
+                       [nym_req(TRUSTEE_DID, USER_DID, req_id=2)], 1001.0, 0, 2)
+        assert state.head_hash != mid_root
+        wm.revert_last_batch(DOMAIN_LEDGER_ID)
+        assert state.head_hash == mid_root
+        assert wm.uncommitted_batch_count == 1
+
+
+class TestAuditLedger:
+    def test_audit_snapshot_and_backrefs(self, db):
+        wm, _ = make_managers(db)
+        for i in range(3):
+            r = bootstrap_trustee(wm, pp=i + 1) if i == 0 else \
+                wm.apply_batch(DOMAIN_LEDGER_ID,
+                               [nym_req(TRUSTEE_DID, f"did{i}xxxxxxxxxxxxxxxx",
+                                        req_id=10 + i)],
+                               1000.0 + i, 0, i + 1)[2]
+            wm.commit_batch(ThreePcBatch(
+                DOMAIN_LEDGER_ID, 0, i + 1, 1000.0 + i, (),
+                bytes.fromhex(r["state_root"]), b"", b""))
+        audit = db.get_ledger(AUDIT_LEDGER_ID)
+        assert audit.size == 3
+        last = audit_lib.last_audit_txn(audit)
+        view_no, pp_seq_no, _ = audit_lib.last_audited_view(audit)
+        assert (view_no, pp_seq_no) == (0, 3)
+        # domain root is stored literally; pool root is a back-reference
+        domain_root = audit_lib.resolve_ledger_root(audit, last, DOMAIN_LEDGER_ID)
+        assert domain_root == db.get_ledger(DOMAIN_LEDGER_ID).root_hash.hex()
+        pool_root = audit_lib.resolve_ledger_root(audit, last, POOL_LEDGER_ID)
+        assert pool_root == db.get_ledger(POOL_LEDGER_ID).root_hash.hex()
+
+
+class TestTaa:
+    def _setup_taa(self, wm):
+        roots1 = bootstrap_trustee(wm)
+        taa = Request(TRUSTEE_DID, 5,
+                      {"type": TXN_AUTHOR_AGREEMENT, "version": "1",
+                       "text": "agree", "ratification_ts": 900},
+                      signature="s")
+        aml = Request(TRUSTEE_DID, 6,
+                      {"type": TXN_AUTHOR_AGREEMENT_AML, "version": "1",
+                       "aml": {"click": "desc"}}, signature="s")
+        ok, rej, roots2 = wm.apply_batch(CONFIG_LEDGER_ID, [aml, taa],
+                                         1001.0, 0, 2)
+        assert len(ok) == 2, rej
+        wm.commit_batch(ThreePcBatch(
+            DOMAIN_LEDGER_ID, 0, 1, 1000.0, (),
+            bytes.fromhex(roots1["state_root"]), b"", b""))
+        wm.commit_batch(ThreePcBatch(
+            CONFIG_LEDGER_ID, 0, 2, 1001.0, (),
+            bytes.fromhex(roots2["state_root"]), b"", b""))
+
+    def test_domain_write_requires_acceptance(self, db):
+        wm, rm = make_managers(db)
+        self._setup_taa(wm)
+        ok, rej, _ = wm.apply_batch(
+            DOMAIN_LEDGER_ID, [nym_req(TRUSTEE_DID, USER_DID, req_id=7)],
+            1002.0, 0, 3)
+        assert len(rej) == 1 and "agreement" in rej[0][1]
+        acceptance = {"taaDigest": taa_digest("agree", "1"),
+                      "mechanism": "click", "time": 1002}
+        ok, rej, _ = wm.apply_batch(
+            DOMAIN_LEDGER_ID,
+            [nym_req(TRUSTEE_DID, USER_DID, req_id=8, taa=acceptance)],
+            1003.0, 0, 4)
+        assert len(ok) == 1, rej
+        # read it back
+        res = rm.get_result(Request("x", 9, {"type": "6"}))
+        assert res["data"]["version"] == "1"
+
+    def test_bad_mechanism_rejected(self, db):
+        wm, _ = make_managers(db)
+        self._setup_taa(wm)
+        acceptance = {"taaDigest": taa_digest("agree", "1"),
+                      "mechanism": "wave", "time": 1002}
+        ok, rej, _ = wm.apply_batch(
+            DOMAIN_LEDGER_ID,
+            [nym_req(TRUSTEE_DID, USER_DID, req_id=8, taa=acceptance)],
+            1003.0, 0, 3)
+        assert len(rej) == 1 and "mechanism" in rej[0][1]
+
+
+class TestReads:
+    def test_get_nym_with_state_proof(self, db):
+        wm, rm = make_managers(db)
+        roots = bootstrap_trustee(wm)
+        wm.commit_batch(ThreePcBatch(
+            DOMAIN_LEDGER_ID, 0, 1, 1000.0, (),
+            bytes.fromhex(roots["state_root"]), b"", b""))
+        res = rm.get_result(Request("x", 1, {"type": "105",
+                                             "dest": TRUSTEE_DID}))
+        assert res["data"]["verkey"] == "vk"
+        sp = res["state_proof"]
+        state = db.get_state(DOMAIN_LEDGER_ID)
+        from plenum_tpu.state.pruning_state import PruningState as PS
+        from plenum_tpu.common.serialization import pack
+        value = state.get(TRUSTEE_DID.encode(), committed=True)
+        assert PS.verify_state_proof(bytes.fromhex(sp["root_hash"]),
+                                     TRUSTEE_DID.encode(), value,
+                                     bytes.fromhex(sp["proof_nodes"]))
+
+    def test_get_txn_merkle_proof(self, db):
+        wm, rm = make_managers(db)
+        roots = bootstrap_trustee(wm)
+        wm.commit_batch(ThreePcBatch(
+            DOMAIN_LEDGER_ID, 0, 1, 1000.0, (),
+            bytes.fromhex(roots["state_root"]), b"", b""))
+        res = rm.get_result(Request("x", 1, {"type": "3", "data": 1,
+                                             "ledgerId": DOMAIN_LEDGER_ID}))
+        assert res["data"] is not None
+        assert res["merkle_proof"] is not None
+
+
+class TestExecutorSeam:
+    def test_applied_batch_roots(self, db):
+        wm, _ = make_managers(db)
+        ex = LedgerBatchExecutor(wm)
+        req = nym_req(TRUSTEE_DID, TRUSTEE_DID, role=TRUSTEE)
+        applied = ex.apply_batch(DOMAIN_LEDGER_ID, [req], 1000.0, 0, 1)
+        assert applied.valid_digests == (req.digest,)
+        assert applied.state_root
+        assert applied.txn_root
+        assert applied.audit_txn_root
+        assert ex.ledger_id_for(req) == DOMAIN_LEDGER_ID
+        ex.revert_last_batch(DOMAIN_LEDGER_ID)
+        assert wm.uncommitted_batch_count == 0

@@ -193,7 +193,11 @@ def test_state_commit_revert_cycle():
 
 
 def test_state_commit_explicit_root():
-    """Commit an intermediate root (batch-by-batch commit of staged writes)."""
+    """Commit an intermediate root (batch-by-batch commit of staged writes).
+
+    With pipelined 3PC batches, later batches are applied on top of the one
+    being committed — committing an earlier root must NOT rewind the
+    uncommitted head (that would drop the in-flight writes)."""
     s = PruningState()
     s.set(b"x", b"1")
     r1 = s.head_hash
@@ -202,8 +206,12 @@ def test_state_commit_explicit_root():
     s.commit(r1)
     assert s.committed_head_hash == r1
     assert s.get(b"y", committed=True) is None
-    # head was rewound to r1 as well
-    assert s.head_hash == r1
+    # head keeps the in-flight batch applied on top
+    assert s.head_hash == r2
+    assert s.get(b"y", committed=False) == b"2"
+    # committing the head root later promotes it
+    s.commit(r2)
+    assert s.get(b"y", committed=True) == b"2"
 
 
 def test_state_durable_reopen(tdir):
