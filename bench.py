@@ -27,45 +27,13 @@ def make_items(n: int):
         return items
     except Exception:
         # no `cryptography` package: pure-Python signing (slow, host-only)
-        from plenum_tpu.ops import ed25519 as ops
-        P, L, D = ops.P, ops.L, ops.D
-
-        def add(p1, p2):
-            x1, y1 = p1
-            x2, y2 = p2
-            dd = D * x1 * x2 * y1 * y2 % P
-            return ((x1 * y2 + x2 * y1) * pow(1 + dd, P - 2, P) % P,
-                    (y1 * y2 + x1 * x2) * pow(1 - dd + P, P - 2, P) % P)
-
-        def mul(k, pt):
-            acc = (0, 1)
-            while k:
-                if k & 1:
-                    acc = add(acc, pt)
-                pt = add(pt, pt)
-                k >>= 1
-            return acc
-
-        def comp(pt):
-            return (pt[1] | ((pt[0] & 1) << 255)).to_bytes(32, "little")
-
-        B = (ops.BX, ops.BY)
-        keys = {}
+        from plenum_tpu.ops.ed25519 import pure_python_sign
         items = []
         for i in range(n):
-            ki = i % 16
-            if ki not in keys:
-                hd = hashlib.sha512(hashlib.sha256(b"bench%d" % ki).digest()).digest()
-                a = int.from_bytes(hd[:32], "little")
-                a = (a & ((1 << 254) - 8)) | (1 << 254)
-                keys[ki] = (a, hd[32:], comp(mul(a, B)))
-            a, prefix, vk = keys[ki]
+            seed = hashlib.sha256(b"bench%d" % (i % 16)).digest()
             msg = b"bench message %d" % i
-            r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
-            r_c = comp(mul(r, B))
-            h = int.from_bytes(hashlib.sha512(r_c + vk + msg).digest(), "little") % L
-            s = (r + h * a) % L
-            items.append((msg, r_c + s.to_bytes(32, "little"), vk))
+            sig, vk = pure_python_sign(seed, msg)
+            items.append((msg, sig, vk))
         return items
 
 

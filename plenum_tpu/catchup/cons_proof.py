@@ -1,0 +1,75 @@
+"""Consistency-proof gathering: agree on the catchup target.
+
+Reference behavior: plenum/server/catchup/cons_proof_service.py:24 — broadcast
+our LedgerStatus; if n-f-1 peers answer with an equal status we are already
+up to date; otherwise f+1 ConsistencyProofs naming the same (size, root)
+fix the catchup target. The f+1 quorum suffices because at least one of the
+proofs comes from an honest node, and the Merkle verification of the catchup
+replies is what actually protects integrity.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from plenum_tpu.common.node_messages import ConsistencyProof, LedgerStatus
+from plenum_tpu.common.quorums import Quorums
+from plenum_tpu.execution.database_manager import DatabaseManager
+
+
+class ConsProofService:
+    def __init__(self, ledger_id: int, db: DatabaseManager,
+                 quorums_provider: Callable[[], Quorums],
+                 send: Callable,
+                 on_target: Callable[[int, Optional[tuple[int, str, tuple[int, int]]]], None]):
+        """on_target(ledger_id, None) = already up to date;
+        on_target(ledger_id, (size, root_hex, (view_no, pp_seq_no)))."""
+        self.ledger_id = ledger_id
+        self._db = db
+        self._quorums = quorums_provider
+        self._send = send
+        self._on_target = on_target
+        self._running = False
+        self._same_status: set[str] = set()
+        self._proofs: dict[tuple[int, str], set[str]] = {}
+        self._last_3pc_votes: dict[tuple[int, str], tuple[int, int]] = {}
+
+    def start(self) -> None:
+        self._running = True
+        self._same_status.clear()
+        self._proofs.clear()
+        ledger = self._db.get_ledger(self.ledger_id)
+        self._send(LedgerStatus(ledger_id=self.ledger_id,
+                                txn_seq_no=ledger.size,
+                                merkle_root=ledger.root_hash.hex(),
+                                view_no=None, pp_seq_no=None), None)
+
+    def stop(self) -> None:
+        self._running = False
+
+    def process_ledger_status(self, msg: LedgerStatus, frm: str) -> None:
+        """A peer telling us ITS status in response to ours."""
+        if not self._running or msg.ledger_id != self.ledger_id:
+            return
+        ledger = self._db.get_ledger(self.ledger_id)
+        if msg.txn_seq_no <= ledger.size and \
+                (msg.txn_seq_no < ledger.size or
+                 msg.merkle_root == ledger.root_hash.hex()):
+            self._same_status.add(frm)
+            if self._quorums().checkpoint.is_reached(len(self._same_status)):
+                self._finish(None)       # n-f-1 peers agree we are current
+
+    def process_consistency_proof(self, msg: ConsistencyProof, frm: str) -> None:
+        if not self._running or msg.ledger_id != self.ledger_id:
+            return
+        ledger = self._db.get_ledger(self.ledger_id)
+        if msg.seq_no_end <= ledger.size:
+            return
+        key = (msg.seq_no_end, msg.new_merkle_root)
+        self._proofs.setdefault(key, set()).add(frm)
+        self._last_3pc_votes[key] = (msg.view_no, msg.pp_seq_no)
+        if self._quorums().consistency_proof.is_reached(len(self._proofs[key])):
+            self._finish((key[0], key[1], self._last_3pc_votes[key]))
+
+    def _finish(self, target) -> None:
+        self._running = False
+        self._on_target(self.ledger_id, target)

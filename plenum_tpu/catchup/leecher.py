@@ -1,0 +1,140 @@
+"""Leecher state machines: per-ledger sync + whole-node catchup ordering.
+
+Reference behavior: plenum/server/catchup/ledger_leecher_service.py:15 (one
+ledger: cons-proof phase then catchup-rep phase) and
+node_leecher_service.py:20-34 (the node-level state machine syncing ledgers
+strictly in the order audit → pool → config → domain, node.py:142 — the audit
+ledger first because it tells us how far the others should go, pool next
+because it can change the validator set mid-catchup).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID, CatchupRep,
+                                             ConsistencyProof, CONFIG_LEDGER_ID,
+                                             DOMAIN_LEDGER_ID, LedgerStatus,
+                                             POOL_LEDGER_ID)
+from plenum_tpu.common.quorums import Quorums
+from plenum_tpu.common.timer import TimerService
+from plenum_tpu.execution.database_manager import DatabaseManager
+
+from .cons_proof import ConsProofService
+from .rep import CatchupRepService
+
+CATCHUP_ORDER = (AUDIT_LEDGER_ID, POOL_LEDGER_ID, CONFIG_LEDGER_ID,
+                 DOMAIN_LEDGER_ID)
+
+
+class LedgerLeecherService:
+    """Sync one ledger: agree on a target, then fetch+verify+apply."""
+
+    def __init__(self, ledger_id: int, db: DatabaseManager, send: Callable,
+                 timer: TimerService,
+                 quorums_provider: Callable[[], Quorums],
+                 peers_provider: Callable[[], list[str]],
+                 on_txn_added: Callable[[int, dict], None],
+                 on_complete: Callable[[int, Optional[tuple[int, int]]], None]):
+        self.ledger_id = ledger_id
+        self._on_complete = on_complete
+        self._last_3pc: Optional[tuple[int, int]] = None
+        self.cons_proof = ConsProofService(
+            ledger_id, db, quorums_provider, send, self._on_target)
+        self.rep = CatchupRepService(
+            ledger_id, db, send, timer, peers_provider, on_txn_added,
+            self._on_rep_complete)
+        self.is_active = False
+
+    def start(self) -> None:
+        self.is_active = True
+        self._last_3pc = None
+        self.cons_proof.start()
+
+    def stop(self) -> None:
+        self.is_active = False
+        self.cons_proof.stop()
+        self.rep.stop()
+
+    def _on_target(self, ledger_id: int, target) -> None:
+        if target is None:           # already up to date
+            self.is_active = False
+            self._on_complete(ledger_id, None)
+            return
+        size, root_hex, last_3pc = target
+        self._last_3pc = last_3pc
+        self.rep.start(size, root_hex)
+
+    def _on_rep_complete(self, ledger_id: int) -> None:
+        self.is_active = False
+        self._on_complete(ledger_id, self._last_3pc)
+
+
+class NodeLeecherService:
+    """Whole-node catchup: run ledger leechers in the canonical order."""
+
+    def __init__(self, db: DatabaseManager, send: Callable,
+                 timer: TimerService,
+                 quorums_provider: Callable[[], Quorums],
+                 peers_provider: Callable[[], list[str]],
+                 on_txn_added: Callable[[int, dict], None],
+                 on_catchup_complete: Callable[[Optional[tuple[int, int]]], None]):
+        self._on_catchup_complete = on_catchup_complete
+        self.leechers: dict[int, LedgerLeecherService] = {
+            lid: LedgerLeecherService(lid, db, send, timer, quorums_provider,
+                                      peers_provider, on_txn_added,
+                                      self._ledger_done)
+            for lid in CATCHUP_ORDER if db.get_ledger(lid) is not None}
+        self.is_running = False
+        self._order: list[int] = [lid for lid in CATCHUP_ORDER
+                                  if lid in self.leechers]
+        self._idx = 0
+        self._last_3pc: Optional[tuple[int, int]] = None
+
+    # --- control -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self.is_running:
+            return
+        self.is_running = True
+        self._idx = 0
+        self._last_3pc = None
+        self._start_current()
+
+    def stop(self) -> None:
+        self.is_running = False
+        for leecher in self.leechers.values():
+            leecher.stop()
+
+    def _start_current(self) -> None:
+        if self._idx >= len(self._order):
+            self.is_running = False
+            self._on_catchup_complete(self._last_3pc)
+            return
+        self.leechers[self._order[self._idx]].start()
+
+    def _ledger_done(self, ledger_id: int,
+                     last_3pc: Optional[tuple[int, int]]) -> None:
+        if not self.is_running:
+            return
+        if last_3pc is not None and (self._last_3pc is None or
+                                     last_3pc > self._last_3pc):
+            self._last_3pc = last_3pc
+        self._idx += 1
+        self._start_current()
+
+    # --- message routing ----------------------------------------------------
+
+    def process_ledger_status(self, msg: LedgerStatus, frm: str) -> None:
+        leecher = self.leechers.get(msg.ledger_id)
+        if leecher is not None:
+            leecher.cons_proof.process_ledger_status(msg, frm)
+
+    def process_consistency_proof(self, msg: ConsistencyProof, frm: str) -> None:
+        leecher = self.leechers.get(msg.ledger_id)
+        if leecher is not None:
+            leecher.cons_proof.process_consistency_proof(msg, frm)
+
+    def process_catchup_rep(self, msg: CatchupRep, frm: str) -> None:
+        leecher = self.leechers.get(msg.ledger_id)
+        if leecher is not None:
+            leecher.rep.process_catchup_rep(msg, frm)

@@ -1,0 +1,178 @@
+"""Catchup replies: ranged requests split across peers, Merkle-verified apply.
+
+Reference behavior: plenum/server/catchup/catchup_rep_service.py:18 +
+node_leecher_service.py:186-244 — the missing txn range is split evenly across
+available peers, each chunk arrives as a CatchupRep, chunks are applied
+strictly in order, and every applied prefix is verified against the agreed
+target root via the shipped consistency proof; a chunk that fails verification
+is discarded and re-requested from a different peer.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from plenum_tpu.common.node_messages import CatchupRep, CatchupReq
+from plenum_tpu.common.timer import TimerService
+from plenum_tpu.execution.database_manager import DatabaseManager
+from plenum_tpu.ledger.merkle_verifier import MerkleVerifier
+
+
+class CatchupRepService:
+    def __init__(self, ledger_id: int, db: DatabaseManager,
+                 send: Callable, timer: TimerService,
+                 peers_provider: Callable[[], list[str]],
+                 on_txn_added: Callable[[int, dict], None],
+                 on_complete: Callable[[int], None],
+                 retry_timeout: float = 5.0):
+        self.ledger_id = ledger_id
+        self._db = db
+        self._send = send
+        self._timer = timer
+        self._peers = peers_provider
+        self._on_txn_added = on_txn_added
+        self._on_complete = on_complete
+        self._retry_timeout = retry_timeout
+        self._verifier = MerkleVerifier()
+        self._running = False
+        self._target_size = 0
+        self._target_root = ""
+        # pending reps: start_seq -> (end_seq, [txns], proof, frm)
+        self._reps: dict[int, tuple[int, list[dict], tuple, str]] = {}
+        self._blacklisted_peers: set[str] = set()
+        self._retry_scheduled = False
+
+    @property
+    def is_running(self) -> bool:
+        return self._running
+
+    def start(self, target_size: int, target_root_hex: str) -> None:
+        ledger = self._db.get_ledger(self.ledger_id)
+        self._running = True
+        self._target_size = target_size
+        self._target_root = target_root_hex
+        self._reps.clear()
+        if ledger.size >= target_size:
+            self._finish()
+            return
+        self._request_missing()
+
+    def stop(self) -> None:
+        self._running = False
+        self._cancel_retry()
+
+    # --- requesting -------------------------------------------------------
+
+    def _covered_seqs(self) -> set[int]:
+        out = set()
+        for start, (end, _, _, _) in self._reps.items():
+            out.update(range(start, end + 1))
+        return out
+
+    def _request_missing(self) -> None:
+        """Split [ledger.size+1, target] across usable peers (ref :186-244)."""
+        ledger = self._db.get_ledger(self.ledger_id)
+        start, end = ledger.size + 1, self._target_size
+        covered = self._covered_seqs()
+        missing = [s for s in range(start, end + 1) if s not in covered]
+        if not missing:
+            return
+        peers = [p for p in self._peers() if p not in self._blacklisted_peers] \
+            or list(self._peers())
+        if not peers:
+            return
+        # contiguous runs of missing seq_nos, round-robined over peers
+        runs: list[tuple[int, int]] = []
+        run_start = prev = missing[0]
+        for s in missing[1:]:
+            if s != prev + 1:
+                runs.append((run_start, prev))
+                run_start = s
+            prev = s
+        runs.append((run_start, prev))
+        split: list[tuple[int, int]] = []
+        for lo, hi in runs:
+            n = len(peers)
+            size = max(1, (hi - lo + 1 + n - 1) // n)
+            while lo <= hi:
+                split.append((lo, min(lo + size - 1, hi)))
+                lo += size
+        for i, (lo, hi) in enumerate(split):
+            self._send(CatchupReq(ledger_id=self.ledger_id,
+                                  seq_no_start=lo, seq_no_end=hi,
+                                  catchup_till=self._target_size),
+                       [peers[i % len(peers)]])
+        self._schedule_retry()
+
+    def _schedule_retry(self) -> None:
+        self._cancel_retry()
+        self._timer.schedule(self._retry_timeout, self._on_retry_timeout)
+        self._retry_scheduled = True
+
+    def _cancel_retry(self) -> None:
+        if getattr(self, "_retry_scheduled", False):
+            self._timer.cancel(self._on_retry_timeout)
+            self._retry_scheduled = False
+
+    def _on_retry_timeout(self) -> None:
+        self._retry_scheduled = False
+        if self._running:
+            self._request_missing()
+
+    # --- receiving --------------------------------------------------------
+
+    def process_catchup_rep(self, msg: CatchupRep, frm: str) -> None:
+        if not self._running or msg.ledger_id != self.ledger_id:
+            return
+        seqs = sorted(int(s) for s in msg.txns if s.isdigit())
+        if not seqs:
+            return
+        # keep only contiguous, in-range reps (a seeder never sends gaps)
+        if seqs != list(range(seqs[0], seqs[-1] + 1)) or \
+                seqs[-1] > self._target_size:
+            return
+        if seqs[0] not in self._reps:
+            self._reps[seqs[0]] = (seqs[-1],
+                                   [msg.txns[str(s)] for s in seqs],
+                                   tuple(msg.cons_proof), frm)
+        self._try_apply()
+
+    def _try_apply(self) -> None:
+        """Apply reps strictly in order. Each rep is verified against the
+        agreed target root BEFORE commit: stage the chunk, then check that
+        the staged root at the chunk's end is consistent with the target via
+        the rep's consistency proof (or equals it when the range closes).
+        A bad chunk is dropped, its sender sidelined, and the range
+        re-requested elsewhere — nothing unverified ever commits."""
+        ledger = self._db.get_ledger(self.ledger_id)
+        while self._running:
+            next_seq = ledger.size + 1
+            if next_seq > self._target_size or next_seq not in self._reps:
+                break
+            end, txns, proof, frm = self._reps.pop(next_seq)
+            ledger.append_txns_to_uncommitted(txns)
+            root_at_end = ledger.uncommitted_root_hash
+            if end == self._target_size:
+                ok = root_at_end.hex() == self._target_root
+            else:
+                try:
+                    ok = self._verifier.verify_consistency(
+                        end, self._target_size, root_at_end,
+                        bytes.fromhex(self._target_root),
+                        [bytes.fromhex(h) for h in proof])
+                except (ValueError, TypeError):
+                    ok = False
+            if not ok:
+                ledger.discard_txns(len(txns))
+                self._blacklisted_peers.add(frm)
+                self._request_missing()
+                return
+            committed, _ = ledger.commit_txns(len(txns))
+            for txn in committed:
+                self._on_txn_added(self.ledger_id, txn)
+        if ledger.size >= self._target_size:
+            self._finish()
+
+    def _finish(self) -> None:
+        self._running = False
+        self._cancel_retry()
+        self._on_complete(self.ledger_id)

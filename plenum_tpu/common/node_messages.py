@@ -157,6 +157,9 @@ class LedgerStatus(MessageBase):
     merkle_root: str
     view_no: Optional[int] = None
     pp_seq_no: Optional[int] = None
+    # True on a seeder's acknowledgment so the peer's seeder does not answer
+    # an answer (status ping-pong between two up-to-date nodes)
+    is_reply: bool = False
 
     def validate(self) -> None:
         self._require_non_negative("ledger_id", "txn_seq_no", "view_no", "pp_seq_no")

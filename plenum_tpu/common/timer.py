@@ -35,7 +35,11 @@ class QueueTimer(TimerService):
         self._heap: list[tuple[float, int, Callable[[], None]]] = []
         self._seq = 0  # tie-break so equal deadlines fire FIFO
         self._cancelled: set[int] = set()
-        self._ids: dict[int, list[int]] = {}  # id(callback) -> seq numbers
+        # Keyed by the callback itself, NOT id(): `self.method` builds a fresh
+        # bound-method object on every attribute access, so id()-keying would
+        # make cancel(self.method) a silent no-op (bound methods of the same
+        # object+function compare and hash equal).
+        self._ids: dict[Callable, list[int]] = {}  # callback -> seq numbers
 
     def get_current_time(self) -> float:
         return self._get_current_time()
@@ -43,10 +47,10 @@ class QueueTimer(TimerService):
     def schedule(self, delay: float, callback: Callable[[], None]) -> None:
         self._seq += 1
         heappush(self._heap, (self.get_current_time() + delay, self._seq, callback))
-        self._ids.setdefault(id(callback), []).append(self._seq)
+        self._ids.setdefault(callback, []).append(self._seq)
 
     def cancel(self, callback: Callable[[], None]) -> None:
-        for seq in self._ids.pop(id(callback), []):
+        for seq in self._ids.pop(callback, []):
             self._cancelled.add(seq)
 
     def service(self) -> int:
@@ -58,11 +62,11 @@ class QueueTimer(TimerService):
             if seq in self._cancelled:
                 self._cancelled.discard(seq)
                 continue
-            seqs = self._ids.get(id(cb))
+            seqs = self._ids.get(cb)
             if seqs and seq in seqs:
                 seqs.remove(seq)
                 if not seqs:
-                    del self._ids[id(cb)]
+                    del self._ids[cb]
             cb()
             fired += 1
         return fired

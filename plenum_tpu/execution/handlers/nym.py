@@ -107,6 +107,13 @@ class GetNymHandler(ReadRequestHandler):
     def __init__(self, db):
         super().__init__(db, GET_NYM, DOMAIN_LEDGER_ID)
 
+    def static_validation(self, request: Request) -> None:
+        from plenum_tpu.execution.exceptions import InvalidClientRequest
+        dest = request.operation.get("dest")
+        if not isinstance(dest, str) or not dest:
+            raise InvalidClientRequest(request.identifier, request.req_id,
+                                       "GET_NYM needs a string dest")
+
     def get_result(self, request: Request) -> dict:
         did = request.operation.get("dest")
         key = nym_state_key(did)

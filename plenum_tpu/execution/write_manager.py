@@ -220,10 +220,13 @@ class WriteRequestManager:
     def commit_batch(self, batch: ThreePcBatch) -> list[dict]:
         """Make the oldest applied batch durable; returns committed txns
         (ref write_request_manager.py:178 + audit/ts batch handlers)."""
-        if not self._batches or self._batches[0].pp_seq_no != batch.pp_seq_no:
-            # tolerate out-of-order callers only if the batch is the oldest
-            if not self._batches:
-                raise ValueError("commit with no applied batches")
+        if not self._batches:
+            raise ValueError("commit with no applied batches")
+        if self._batches[0].pp_seq_no != batch.pp_seq_no:
+            raise ValueError(
+                f"commit out of order: oldest applied batch is "
+                f"pp_seq_no={self._batches[0].pp_seq_no}, "
+                f"got {batch.pp_seq_no}")
         undo = self._batches.pop(0)
         ledger = self.db.get_ledger(undo.ledger_id)
         committed, _ = ledger.commit_txns(undo.n_txns)

@@ -18,10 +18,17 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
+from plenum_tpu.catchup import NodeLeecherService, SeederService
 from plenum_tpu.common.event_bus import ExternalBus
-from plenum_tpu.common.internal_messages import ReqKey
-from plenum_tpu.common.node_messages import (Ordered, Propagate, Reject,
-                                             Reply, RequestAck, RequestNack)
+from plenum_tpu.common.internal_messages import (NeedMasterCatchup, ReqKey)
+from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID, CatchupRep,
+                                             CatchupReq, ConsistencyProof,
+                                             LedgerStatus, Ordered,
+                                             POOL_LEDGER_ID, Propagate,
+                                             Reject, Reply, RequestAck,
+                                             RequestNack)
+from plenum_tpu.common.serialization import unpack
+from plenum_tpu.execution.database_manager import SEQ_NO_DB_LABEL
 from plenum_tpu.common.request import Request
 from plenum_tpu.common.timer import TimerService
 from plenum_tpu.config import Config
@@ -76,10 +83,32 @@ class Node:
         self._client_inbox: list[tuple[dict, str]] = []
         self._propagate_inbox: list[tuple[Propagate, str]] = []
         self._ordered_queue: list[Ordered] = []
-        self._seen_propagates: set[tuple[str, str]] = set()   # (digest, frm)
+        # digest -> senders whose propagate we already counted; the whole
+        # entry is freed when the request executes (durable dedup then lives
+        # in the seq-no DB keyed by payload digest)
+        self._seen_propagates: dict[str, set[str]] = {}
+
+        # catchup: seeder answers peers; leecher drives our own sync
+        # (ref ledger_manager.py:21 + server/catchup/*)
+        self.seeder = SeederService(
+            components.db, send=self.node_bus.send,
+            last_3pc=lambda: self.master_replica.last_ordered_3pc)
+        self.leecher = NodeLeecherService(
+            components.db, send=self.node_bus.send, timer=timer,
+            quorums_provider=lambda: self.quorums,
+            peers_provider=lambda: [n for n in self.validators
+                                    if n != self.name],
+            on_txn_added=self._on_catchup_txn,
+            on_catchup_complete=self._on_catchup_complete)
+        self.node_bus.subscribe(LedgerStatus, self._receive_ledger_status)
+        self.node_bus.subscribe(ConsistencyProof,
+                                self.leecher.process_consistency_proof)
+        self.node_bus.subscribe(CatchupReq, self.seeder.process_catchup_req)
+        self.node_bus.subscribe(CatchupRep, self.leecher.process_catchup_rep)
 
         self.node_bus.subscribe(Propagate, self._receive_propagate)
-        self.spylog: list[tuple[str, Any]] = []    # lightweight event trace
+        from collections import deque
+        self.spylog: Any = deque(maxlen=1000)      # bounded event trace
 
     # --- wiring -----------------------------------------------------------
 
@@ -89,7 +118,7 @@ class Node:
             bls_verifier=BlsCryptoVerifier(),
             key_register=self.c.bls_register,
             bls_store=self.c.bls_store if inst_id == 0 else None)
-        audit = self.c.db.get_ledger(3)
+        audit = self.c.db.get_ledger(AUDIT_LEDGER_ID)
         replica = Replica(
             node_name=self.name, inst_id=inst_id,
             validators=self.validators, timer=self.timer,
@@ -101,7 +130,59 @@ class Node:
                 lambda seq: audit.uncommitted_root_hash.hex()),
             instance_count=max(1, self.pool_manager.quorums.f + 1))
         replica.internal_bus.subscribe(Ordered, self._on_ordered)
+        if inst_id == 0:
+            replica.internal_bus.subscribe(
+                NeedMasterCatchup, lambda _msg: self.start_catchup())
         return replica
+
+    # --- catchup ----------------------------------------------------------
+
+    def start_catchup(self) -> None:
+        """Pause ordering, revert uncommitted work, sync all ledgers
+        (ref node.py:2610 start_catchup → NodeLeecherService.start)."""
+        if self.leecher.is_running:
+            return
+        self.spylog.append(("catchup_started", None))
+        for replica in self.replicas:
+            replica.ordering.catchup_started()
+        self.leecher.start()
+
+    def _receive_ledger_status(self, msg: LedgerStatus, frm: str) -> None:
+        # queries go to the seeder; acknowledgments feed our cons-proof quorum
+        self.seeder.process_ledger_status(msg, frm)
+        self.leecher.process_ledger_status(msg, frm)
+
+    def _on_catchup_txn(self, ledger_id: int, txn: dict) -> None:
+        """A catchup txn was committed to the ledger: replay it into state
+        and bookkeeping (ref node.py:1748 postTxnFromCatchupAddedToLedger)."""
+        handler = self.c.write_manager._handlers.get(txn_lib.txn_type_of(txn))
+        state = self.c.db.get_state(ledger_id)
+        if handler is not None and state is not None:
+            handler.update_state(txn, is_committed=True)
+            state.commit(state.head_hash)
+        digest = txn_lib.txn_digest(txn)
+        if digest:
+            self.propagator.requests.mark_executed(digest)
+
+    def _on_catchup_complete(self, last_3pc) -> None:
+        """All ledgers synced: adopt the audit ledger's 3PC position and
+        primaries, rejoin consensus (ref allLedgersCaughtUp node.py:1790,
+        select_primaries_on_catchup_complete :1830)."""
+        from plenum_tpu.execution.handlers import audit as audit_lib
+        audit = self.c.db.get_ledger(AUDIT_LEDGER_ID)
+        view_no, pp_seq_no, primaries = audit_lib.last_audited_view(audit)
+        if last_3pc is not None and last_3pc > (view_no, pp_seq_no):
+            view_no, pp_seq_no = last_3pc
+        self.pool_manager.pool_changed()
+        for replica in self.replicas:
+            if view_no > replica.data.view_no:
+                replica.data.view_no = view_no
+                if primaries:
+                    replica.data.primaries = list(primaries)
+            replica.ordering.caught_up_till_3pc(
+                (view_no, pp_seq_no) if replica.is_master
+                else replica.last_ordered_3pc)
+        self.spylog.append(("catchup_complete", (view_no, pp_seq_no)))
 
     def _forward_to_replicas(self, digest: str) -> None:
         for replica in self.replicas:
@@ -177,6 +258,12 @@ class Node:
                                           req_id=request.req_id,
                                           reason=e.reason), frm)
             return
+        except Exception:
+            # a malformed query must never take the prod loop down
+            self._client_send(RequestNack(identifier=request.identifier,
+                                          req_id=request.req_id,
+                                          reason="malformed query"), frm)
+            return
         self._client_send(Reply(result=result), frm)
 
     def _auth_and_propagate(self, items: list[tuple[Request, str]]) -> None:
@@ -203,13 +290,29 @@ class Node:
                                               reason="signature verification failed"),
                                   frm)
                 continue
-            # dedup: already-executed request -> resend the Reply
-            state = self.propagator.requests.get(req.digest)
-            if state is not None and state.executed:
+            # dedup: an already-executed request gets its Reply resent
+            # (durable lookup via the seq-no DB, ref node.py:2000 seqNoMap)
+            executed = self._executed_txn(req)
+            if executed is not None:
+                self._client_send(Reply(result=executed), frm)
                 continue
             self._client_send(RequestAck(identifier=req.identifier,
                                          req_id=req.req_id), frm)
             self.propagator.propagate(req, frm)
+
+    def _executed_txn(self, req: Request) -> Optional[dict]:
+        """Committed txn for a request that already executed, else None."""
+        seq_no_db = self.c.db.get_store(SEQ_NO_DB_LABEL)
+        if seq_no_db is None:
+            return None
+        raw = seq_no_db.get(req.payload_digest.encode())
+        if raw is None:
+            return None
+        try:
+            ledger_id, seq_no, _ = unpack(raw)
+            return self.c.db.get_ledger(ledger_id).get_by_seq_no(seq_no)
+        except Exception:
+            return None
 
     # --- node pipeline ----------------------------------------------------
 
@@ -224,13 +327,15 @@ class Node:
                 request = Request.from_dict(msg.request)
             except Exception:
                 continue
-            key = (request.digest, frm)
-            if key in self._seen_propagates:
+            seen = self._seen_propagates.setdefault(request.digest, set())
+            if frm in seen:
                 continue
-            self._seen_propagates.add(key)
+            seen.add(frm)
             if request.digest in self.propagator.requests:
                 # signature was already verified when first seen
                 verified.append((msg, frm, request))
+            elif self._executed_txn(request) is not None:
+                continue     # late propagate of an already-executed request
             else:
                 to_auth.append((msg, frm, request))
         if to_auth:
@@ -275,9 +380,13 @@ class Node:
         for txn in committed:
             digest = txn_lib.txn_digest(txn)
             state = self.propagator.requests.get(digest) if digest else None
-            self.propagator.requests.mark_executed(digest)
             if state is not None and state.client_name is not None:
                 self._client_send(Reply(result=txn), state.client_name)
+            # free per-request tracking: durable dedup now lives in the
+            # seq-no DB (ref propagator free after execution)
+            if digest:
+                self.propagator.requests.free(digest)
+                self._seen_propagates.pop(digest, None)
         for digest in msg.discarded:
             state = self.propagator.requests.get(digest)
             if state is not None and state.client_name is not None:
@@ -285,7 +394,9 @@ class Node:
                                          req_id=state.request.req_id,
                                          reason="rejected by dynamic validation"),
                                   state.client_name)
-        if msg.ledger_id == 0:
+            self.propagator.requests.free(digest)
+            self._seen_propagates.pop(digest, None)
+        if msg.ledger_id == POOL_LEDGER_ID:
             self.pool_manager.pool_changed()
 
     # --- accessors --------------------------------------------------------

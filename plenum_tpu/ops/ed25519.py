@@ -275,6 +275,50 @@ def verify_kernel(s_bits, h_bits, ax, ay, az, at, ry, r_sign):
 
 # --- host-side helpers ----------------------------------------------------
 
+def edwards_add(p1: tuple[int, int], p2: tuple[int, int]) -> tuple[int, int]:
+    """Affine Edwards addition over Python ints (host-side, no deps)."""
+    x1, y1 = p1
+    x2, y2 = p2
+    dd = D * x1 * x2 * y1 * y2 % P
+    x3 = (x1 * y2 + x2 * y1) * pow(1 + dd, P - 2, P) % P
+    y3 = (y1 * y2 + x1 * x2) * pow(1 - dd + P, P - 2, P) % P
+    return (x3, y3)
+
+
+def edwards_mul(k: int, pt: tuple[int, int]) -> tuple[int, int]:
+    acc = (0, 1)
+    while k:
+        if k & 1:
+            acc = edwards_add(acc, pt)
+        pt = edwards_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def compress(pt: tuple[int, int]) -> bytes:
+    x, y = pt
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def pure_python_sign(seed: bytes, msg: bytes) -> tuple[bytes, bytes]:
+    """RFC 8032 signing with no external deps -> (sig64, verkey32).
+
+    Slow (pure-int scalar mults); for benches/examples where the
+    `cryptography` package may be absent, NOT for production signing.
+    """
+    import hashlib as _hl
+    hd = _hl.sha512(seed).digest()
+    a = int.from_bytes(hd[:32], "little")
+    a = (a & ((1 << 254) - 8)) | (1 << 254)
+    B = (BX, BY)
+    vk = compress(edwards_mul(a, B))
+    r = int.from_bytes(_hl.sha512(hd[32:] + msg).digest(), "little") % L
+    r_c = compress(edwards_mul(r, B))
+    h = int.from_bytes(_hl.sha512(r_c + vk + msg).digest(), "little") % L
+    s = (r + h * a) % L
+    return r_c + s.to_bytes(32, "little"), vk
+
+
 def decompress(comp: bytes):
     """32-byte compressed Edwards point -> (x, y) ints, or None if invalid."""
     if len(comp) != 32:
