@@ -52,7 +52,7 @@ class BlsStore:
                      json_dumps(multi_sig.to_list()).encode())
 
     def get(self, state_root_hash: str) -> Optional[MultiSignature]:
-        data = self._kv.get(state_root_hash.encode())
+        data = self._kv.try_get(state_root_hash.encode())
         if data is None:
             return None
         return MultiSignature.from_list(json_loads(data))

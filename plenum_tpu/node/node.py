@@ -305,7 +305,7 @@ class Node:
         seq_no_db = self.c.db.get_store(SEQ_NO_DB_LABEL)
         if seq_no_db is None:
             return None
-        raw = seq_no_db.get(req.payload_digest.encode())
+        raw = seq_no_db.try_get(req.payload_digest.encode())
         if raw is None:
             return None
         try:
