@@ -31,12 +31,18 @@ class ConsProofService:
         self._running = False
         self._same_status: set[str] = set()
         self._proofs: dict[tuple[int, str], set[str]] = {}
-        self._last_3pc_votes: dict[tuple[int, str], tuple[int, int]] = {}
+        # (size, root) -> {(view_no, pp_seq_no) -> voters}: the 3PC position
+        # needs its own f+1 quorum — a single Byzantine peer echoing the
+        # honest size/root must not get to pick the pool's 3PC key
+        # (ref cons_proof_service.py _get_last_txn_3PC_key)
+        self._last_3pc_votes: dict[tuple[int, str],
+                                   dict[tuple[int, int], set[str]]] = {}
 
     def start(self) -> None:
         self._running = True
         self._same_status.clear()
         self._proofs.clear()
+        self._last_3pc_votes.clear()
         ledger = self._db.get_ledger(self.ledger_id)
         self._send(LedgerStatus(ledger_id=self.ledger_id,
                                 txn_seq_no=ledger.size,
@@ -66,9 +72,19 @@ class ConsProofService:
             return
         key = (msg.seq_no_end, msg.new_merkle_root)
         self._proofs.setdefault(key, set()).add(frm)
-        self._last_3pc_votes[key] = (msg.view_no, msg.pp_seq_no)
+        if msg.view_no is not None and msg.pp_seq_no is not None:
+            self._last_3pc_votes.setdefault(key, {}).setdefault(
+                (msg.view_no, msg.pp_seq_no), set()).add(frm)
         if self._quorums().consistency_proof.is_reached(len(self._proofs[key])):
-            self._finish((key[0], key[1], self._last_3pc_votes[key]))
+            self._finish((key[0], key[1], self._quorumed_3pc(key)))
+
+    def _quorumed_3pc(self, key) -> Optional[tuple[int, int]]:
+        """Minimum 3PC key with f+1 matching non-None votes, else None
+        (then catchup proceeds without adopting a 3PC position)."""
+        quorum = self._quorums().weak
+        quorumed = [pos for pos, voters in self._last_3pc_votes.get(key, {}).items()
+                    if quorum.is_reached(len(voters))]
+        return min(quorumed) if quorumed else None
 
     def _finish(self, target) -> None:
         self._running = False

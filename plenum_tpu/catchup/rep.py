@@ -40,6 +40,7 @@ class CatchupRepService:
         self._reps: dict[int, tuple[int, list[dict], tuple, str]] = {}
         self._blacklisted_peers: set[str] = set()
         self._retry_scheduled = False
+        self._attempt = 0        # rotates peer assignment across retries
 
     @property
     def is_running(self) -> bool:
@@ -69,7 +70,15 @@ class CatchupRepService:
         return out
 
     def _request_missing(self) -> None:
-        """Split [ledger.size+1, target] across usable peers (ref :186-244)."""
+        """Split [ledger.size+1, target] across usable peers (ref :186-244).
+
+        The retry timer is re-armed on EVERY pass while the service runs —
+        even when nothing looks missing right now — because a pending rep
+        that covers a range may still fail verification at apply time, and
+        without a live timer the service would stall permanently."""
+        if not self._running:
+            return
+        self._schedule_retry()
         ledger = self._db.get_ledger(self.ledger_id)
         start, end = ledger.size + 1, self._target_size
         covered = self._covered_seqs()
@@ -96,12 +105,15 @@ class CatchupRepService:
             while lo <= hi:
                 split.append((lo, min(lo + size - 1, hi)))
                 lo += size
+        # Rotate assignment each pass: a peer that silently declines (it is
+        # itself behind the target) or times out must not be re-asked for the
+        # same chunk forever — only verification failures blacklist.
+        self._attempt += 1
         for i, (lo, hi) in enumerate(split):
             self._send(CatchupReq(ledger_id=self.ledger_id,
                                   seq_no_start=lo, seq_no_end=hi,
                                   catchup_till=self._target_size),
-                       [peers[i % len(peers)]])
-        self._schedule_retry()
+                       [peers[(i + self._attempt - 1) % len(peers)]])
 
     def _schedule_retry(self) -> None:
         self._cancel_retry()
@@ -146,9 +158,28 @@ class CatchupRepService:
         ledger = self._db.get_ledger(self.ledger_id)
         while self._running:
             next_seq = ledger.size + 1
-            if next_seq > self._target_size or next_seq not in self._reps:
+            if next_seq > self._target_size:
                 break
-            end, txns, proof, frm = self._reps.pop(next_seq)
+            # Find a pending rep covering next_seq. Reps may OVERLAP already-
+            # applied txns (honest timeout re-splits use different chunk
+            # boundaries): trim the applied prefix instead of demanding an
+            # exact start match, and drop fully-stale reps — the reference
+            # applies any txn with seqNo > ledger size from any rep
+            # (catchup_rep_service.py).
+            chosen = None
+            for start in sorted(self._reps):
+                end, txns, proof, frm = self._reps[start]
+                if end < next_seq:
+                    del self._reps[start]        # entirely applied: stale
+                    continue
+                if start <= next_seq:
+                    chosen = (start, end, txns, proof, frm)
+                break    # earliest usable rep found, or a gap before it
+            if chosen is None:
+                break
+            start, end, txns, proof, frm = chosen
+            del self._reps[start]
+            txns = txns[next_seq - start:]       # trim applied prefix
             ledger.append_txns_to_uncommitted(txns)
             root_at_end = ledger.uncommitted_root_hash
             if end == self._target_size:

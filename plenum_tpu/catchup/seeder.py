@@ -54,6 +54,12 @@ class SeederService:
         ledger = self._db.get_ledger(msg.ledger_id)
         if ledger is None:
             return
+        if ledger.size < msg.catchup_till:
+            # We cannot anchor a consistency proof at the leecher's agreed
+            # target root (we don't have those txns yet), so any rep we send
+            # would fail verification and get this honest node blacklisted.
+            # Decline; the leecher's retry timer re-splits across other peers.
+            return
         end = min(msg.seq_no_end, ledger.size, msg.seq_no_start + self._max_batch - 1)
         if end < msg.seq_no_start:
             return
@@ -63,7 +69,7 @@ class SeederService:
         # target size: after appending the chunk, the leecher's root at size
         # `end` plus this proof must reproduce the target root, which verifies
         # EVERY txn of the prefix (not just the last one).
-        till = min(msg.catchup_till, ledger.size)
-        proof = ledger.consistency_proof(end, till) if till > end else []
+        proof = ledger.consistency_proof(end, msg.catchup_till) \
+            if msg.catchup_till > end else []
         self._send(CatchupRep(ledger_id=msg.ledger_id, txns=txns,
                               cons_proof=tuple(proof)), frm)

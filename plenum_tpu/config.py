@@ -47,6 +47,11 @@ class Config:
     # --- freshness (ref config.py:263) ---
     STATE_FRESHNESS_UPDATE_INTERVAL: float = 300.0
 
+    # --- primary health watchdog (ref primary_connection_monitor_service +
+    #     unordered-request checks, monitor.py:425) ---
+    PRIMARY_HEALTH_CHECK_FREQ: float = 5.0
+    ORDERING_PROGRESS_TIMEOUT: float = 30.0
+
     # --- catchup (ref config.py:297) ---
     CATCHUP_BATCH_SIZE: int = 5
     CatchupTransactionsTimeout: float = 6.0
@@ -54,6 +59,9 @@ class Config:
 
     # --- propagation ---
     PROPAGATE_REQUEST_DELAY: float = 0.0
+    # requests that never reach the propagate quorum are freed after this
+    # (ref config.py PROPAGATES_PHASE_REQ_TIMEOUT)
+    PROPAGATES_PHASE_REQ_TIMEOUT: float = 3600.0
 
     # --- crypto backend seam: 'cpu' or 'jax' (the north star switch) ---
     crypto_backend: str = "cpu"

@@ -30,7 +30,7 @@ from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID, CatchupRep,
 from plenum_tpu.common.serialization import unpack
 from plenum_tpu.execution.database_manager import SEQ_NO_DB_LABEL
 from plenum_tpu.common.request import Request
-from plenum_tpu.common.timer import TimerService
+from plenum_tpu.common.timer import RepeatingTimer, TimerService
 from plenum_tpu.config import Config
 from plenum_tpu.consensus.bls_bft_replica import BlsBftReplica
 from plenum_tpu.consensus.replica import Replica, Replicas
@@ -64,7 +64,8 @@ class Node:
         self.propagator = Propagator(
             name, self.quorums,
             send_to_nodes=lambda msg: self.node_bus.send(msg),
-            forward_to_replicas=self._forward_to_replicas)
+            forward_to_replicas=self._forward_to_replicas,
+            now=timer.get_current_time)
 
         # RBFT: f+1 protocol instances (ref replicas.py:19)
         n_inst = instance_count if instance_count is not None \
@@ -109,6 +110,28 @@ class Node:
         self.node_bus.subscribe(Propagate, self._receive_propagate)
         from collections import deque
         self.spylog: Any = deque(maxlen=1000)      # bounded event trace
+
+        # periodic GC of request state that never reached the propagate
+        # quorum — without it spam propagates leak memory forever
+        # (ref node.py _clean_req cleanup on OUTDATED_REQS_CHECK_INTERVAL)
+        self._outdated_reqs_timer = RepeatingTimer(
+            timer, self.config.OUTDATED_REQS_CHECK_INTERVAL,
+            self._clean_outdated_reqs)
+
+    def _clean_outdated_reqs(self) -> None:
+        now = self.timer.get_current_time()
+        ttl = self.config.PROPAGATES_PHASE_REQ_TIMEOUT
+        for digest, state in list(self.propagator.requests.items()):
+            if not state.finalised and now - state.added_at > ttl:
+                self.propagator.requests.free(digest)
+                self._seen_propagates.pop(digest, None)
+        # _seen_propagates entries whose request never made it into the
+        # propagator (failed signature, late propagate of an executed txn)
+        # have no RequestState carrying a timestamp — they are orphans the
+        # moment they exist, and the cheapest spam vector if kept
+        for digest in list(self._seen_propagates):
+            if digest not in self.propagator.requests:
+                del self._seen_propagates[digest]
 
     # --- wiring -----------------------------------------------------------
 

@@ -17,23 +17,28 @@ from plenum_tpu.common.request import Request
 
 class RequestState:
     __slots__ = ("request", "propagates", "finalised", "forwarded",
-                 "client_name", "executed")
+                 "client_name", "executed", "added_at")
 
-    def __init__(self, request: Request):
+    def __init__(self, request: Request, added_at: float = 0.0):
         self.request = request
         self.propagates: dict[str, bool] = {}      # sender node -> seen
         self.finalised = False
         self.forwarded = False
         self.executed = False
         self.client_name: Optional[str] = None     # who to REPLY to
+        self.added_at = added_at                   # for unfinalized-state TTL
 
 
 class Requests(dict):
     """digest -> RequestState (ref propagator.py Requests)."""
 
+    def __init__(self, now: Callable[[], float]):
+        super().__init__()
+        self._now = now
+
     def add(self, request: Request) -> RequestState:
         if request.digest not in self:
-            self[request.digest] = RequestState(request)
+            self[request.digest] = RequestState(request, added_at=self._now())
         return self[request.digest]
 
     def add_propagate(self, request: Request, sender: str) -> RequestState:
@@ -61,10 +66,11 @@ class Requests(dict):
 class Propagator:
     def __init__(self, name: str, quorums: Quorums,
                  send_to_nodes: Callable,
-                 forward_to_replicas: Callable[[str], None]):
+                 forward_to_replicas: Callable[[str], None],
+                 now: Callable[[], float]):
         self.name = name
         self.quorums = quorums
-        self.requests = Requests()
+        self.requests = Requests(now)
         self._send = send_to_nodes
         self._forward = forward_to_replicas
 
