@@ -58,13 +58,22 @@ class ExternalBus(Router):
         # send_handler(msg, dst): dst is None (broadcast) or list of names
         self._send_handler = send_handler
         self.connecteds: set[str] = set()
+        # admission predicate over the sender; installed by the node to drop
+        # traffic from blacklisted peers before ANY service sees it
+        # (ref server/blacklister.py enforcement in the node msg pipelines)
+        self._incoming_filter: Callable[[str], bool] = lambda frm: True
 
     def send(self, message: Any, dst=None) -> None:
         if isinstance(dst, str):
             dst = [dst]
         self._send_handler(message, dst)
 
+    def set_incoming_filter(self, accept_frm: Callable[[str], bool]) -> None:
+        self._incoming_filter = accept_frm
+
     def process_incoming(self, message: Any, frm: str) -> None:
+        if not self._incoming_filter(frm):
+            return
         for handler in self.handlers_for(message):
             handler(message, frm)
 

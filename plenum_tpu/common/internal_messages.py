@@ -101,6 +101,7 @@ class RaisedSuspicion(NamedTuple):
     inst_id: int
     code: int
     reason: str
+    sender: str = ""       # peer whose message raised the suspicion
 
 
 class MissingMessage(NamedTuple):

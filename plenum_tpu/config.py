@@ -62,6 +62,11 @@ class Config:
     # requests that never reach the propagate quorum are freed after this
     # (ref config.py PROPAGATES_PHASE_REQ_TIMEOUT)
     PROPAGATES_PHASE_REQ_TIMEOUT: float = 3600.0
+    # executed request state is RETAINED this long so peers can still serve
+    # MessageReq(PROPAGATE) for a request that already ordered — freeing at
+    # execution would wedge any node that missed both the PROPAGATE and the
+    # PRE-PREPARE until a checkpoint-lag catchup 100 batches later
+    EXECUTED_REQ_RETENTION: float = 120.0
 
     # --- crypto backend seam: 'cpu' or 'jax' (the north star switch) ---
     crypto_backend: str = "cpu"

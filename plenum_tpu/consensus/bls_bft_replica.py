@@ -80,6 +80,17 @@ class BlsBftReplica:
         self._sigs: dict[tuple[int, int], dict[str, str]] = {}
         # state_root -> MultiSignature for recently ordered batches
         self._recent_multi_sigs: dict[str, MultiSignature] = {}
+        # set by the node: called with the sender of a bad COMMIT signature
+        # caught by the order-time bisection
+        self.report_bad_signature: Optional[Callable[[str], None]] = None
+        # multi-sigs we aggregated (and therefore verified) ourselves: in
+        # steady state the primary embeds exactly this into the next
+        # PRE-PREPARE, so validate_pre_prepare can skip the pairing
+        self._verified_ms_keys: dict[tuple, None] = {}
+        # ordered batches whose multi-sig fell short of quorum, retried as
+        # late COMMITs arrive; and senders whose sig already failed for a key
+        self._pending_order: dict[tuple[int, int], PrePrepare] = {}
+        self._known_bad: dict[tuple[int, int], set[str]] = {}
 
     def set_quorums(self, quorums: Quorums) -> None:
         self._quorums = quorums
@@ -122,10 +133,13 @@ class BlsBftReplica:
             return self.PPR_BLS_MULTISIG_WRONG
         if not self._quorums.bls_signatures.is_reached(len(ms.participants)):
             return self.PPR_BLS_MULTISIG_WRONG
+        if self._ms_key(ms) in self._verified_ms_keys:
+            return None          # we aggregated this exact multi-sig ourselves
         if not self._verifier.verify_multi_sig(ms.signature,
                                                ms.value.as_single_value(),
                                                verkeys):
             return self.PPR_BLS_MULTISIG_WRONG
+        self._remember_verified(ms)
         return None
 
     # --- COMMIT -----------------------------------------------------------
@@ -138,14 +152,14 @@ class BlsBftReplica:
 
     def validate_commit(self, commit: Commit, sender_node: str,
                         pre_prepare: PrePrepare) -> Optional[int]:
+        """DEFERRED verification: only the cheap structural check happens per
+        COMMIT. The ~74x more expensive pairing runs ONCE per batch at order
+        time over the aggregate, with bisection to evict liars
+        (process_order) — per-commit pairings were the dominant term in pool
+        TPS (one pairing per peer COMMIT per batch per node)."""
         if commit.bls_sig is None:
             return None
-        verkey = self._register.get_key_by_name(sender_node)
-        if verkey is None:
-            return None           # node has no registered BLS key: sig ignored
-        value = self._signed_value(pre_prepare)
-        if not self._verifier.verify_sig(commit.bls_sig,
-                                         value.as_single_value(), verkey):
+        if not self._verifier.is_wellformed_sig(commit.bls_sig):
             return self.CM_BLS_SIG_WRONG
         return None
 
@@ -154,18 +168,39 @@ class BlsBftReplica:
             return
         key = (commit.view_no, commit.pp_seq_no)
         self._sigs.setdefault(key, {})[sender_node] = commit.bls_sig
+        # A batch can order before every honest COMMIT arrives; if its
+        # multi-sig aggregation fell short of quorum (e.g. one bad signature
+        # evicted by the bisection), late honest sigs must retry it — or a
+        # single Byzantine racer could suppress multi-sigs forever.
+        pending = self._pending_order.get(key)
+        if pending is not None:
+            self.process_order(key, pending)
 
     # --- order ------------------------------------------------------------
 
     def process_order(self, key: tuple[int, int],
                       pre_prepare: PrePrepare) -> Optional[MultiSignature]:
-        sigs = self._sigs.get(key, {})
+        sigs = {n: s for n, s in self._sigs.get(key, {}).items()
+                if self._register.get_key_by_name(n) is not None
+                and n not in self._known_bad.get(key, set())}
         if not self._quorums.bls_signatures.is_reached(len(sigs)):
+            self._pending_order[key] = pre_prepare      # retry on late sigs
             return None
-        participants = tuple(sorted(sigs))
-        agg = self._verifier.create_multi_sig([sigs[n] for n in participants])
+        value = self._signed_value(pre_prepare).as_single_value()
+        good, bad = self._verify_with_bisection(sigs, value)
+        for sender in bad:
+            self._known_bad.setdefault(key, set()).add(sender)
+            if self.report_bad_signature is not None:
+                self.report_bad_signature(sender)
+        if not self._quorums.bls_signatures.is_reached(len(good)):
+            self._pending_order[key] = pre_prepare      # retry on late sigs
+            return None
+        self._pending_order.pop(key, None)
+        participants = tuple(sorted(good))
+        agg = self._verifier.create_multi_sig([good[n] for n in participants])
         ms = MultiSignature(signature=agg, participants=participants,
                             value=self._signed_value(pre_prepare))
+        self._remember_verified(ms)
         self._recent_multi_sigs[pre_prepare.state_root] = ms
         if len(self._recent_multi_sigs) > 10:
             oldest = next(iter(self._recent_multi_sigs))
@@ -174,5 +209,51 @@ class BlsBftReplica:
             self._store.put(ms)
         return ms
 
+    def _verify_with_bisection(self, sigs: dict[str, str],
+                               value: bytes) -> tuple[dict[str, str], list[str]]:
+        """One aggregate pairing check for the whole COMMIT set; on failure,
+        recursively bisect to isolate the bad signer(s). The happy path —
+        every signer honest — costs exactly one pairing check per batch
+        instead of one per COMMIT (ref VERDICT: aggregate-verify-on-order
+        with fallback bisection)."""
+        def check(names: list[str]) -> bool:
+            agg = self._verifier.create_multi_sig([sigs[n] for n in names])
+            verkeys = [self._register.get_key_by_name(n) for n in names]
+            return self._verifier.verify_multi_sig(agg, value, verkeys)
+
+        good: dict[str, str] = {}
+        bad: list[str] = []
+
+        def bisect(names: list[str]) -> None:
+            if not names:
+                return
+            if check(names):
+                for n in names:
+                    good[n] = sigs[n]
+                return
+            if len(names) == 1:
+                bad.append(names[0])
+                return
+            mid = len(names) // 2
+            bisect(names[:mid])
+            bisect(names[mid:])
+
+        bisect(sorted(sigs))
+        return good, bad
+
+    @staticmethod
+    def _ms_key(ms: MultiSignature) -> tuple:
+        return (ms.signature, tuple(ms.participants),
+                ms.value.as_single_value())
+
+    def _remember_verified(self, ms: MultiSignature) -> None:
+        self._verified_ms_keys[self._ms_key(ms)] = None
+        while len(self._verified_ms_keys) > 50:
+            del self._verified_ms_keys[next(iter(self._verified_ms_keys))]
+
     def gc(self, stable_3pc: tuple[int, int]) -> None:
         self._sigs = {k: v for k, v in self._sigs.items() if k > stable_3pc}
+        self._pending_order = {k: v for k, v in self._pending_order.items()
+                               if k > stable_3pc}
+        self._known_bad = {k: v for k, v in self._known_bad.items()
+                           if k > stable_3pc}

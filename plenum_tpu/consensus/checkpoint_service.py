@@ -138,11 +138,18 @@ class CheckpointService:
 
     def process_new_view_accepted(self, checkpoint: tuple) -> None:
         """Reset to the checkpoint selected by NewView (ref :304)."""
-        _view, _start, end, digest = checkpoint
+        view, start, end, digest = checkpoint
         if end > self._data.stable_checkpoint:
             self._data.stable_checkpoint = end
             self._data.low_watermark = end
         self._own = {k: v for k, v in self._own.items() if k > end}
         self._received = {k: v for k, v in self._received.items() if k[0] > end}
-        self._data.checkpoints = [c for c in self._data.checkpoints
-                                  if c.seq_no_end > end]
+        # The adopted checkpoint STAYS in the list: the next view change must
+        # have a selectable candidate every node holds, or NewViewBuilder can
+        # never reach its strong quorum again and every later view change
+        # deadlocks (the same reason every node starts with the virtual
+        # checkpoint at seq 0).
+        self._data.checkpoints = \
+            [Checkpoint(inst_id=self._data.inst_id, view_no=view,
+                        seq_no_start=start, seq_no_end=end, digest=digest)] + \
+            [c for c in self._data.checkpoints if c.seq_no_end > end]

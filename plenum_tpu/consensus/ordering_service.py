@@ -19,11 +19,13 @@ from collections import OrderedDict
 from typing import Callable, Optional
 
 from plenum_tpu.common.event_bus import ExternalBus, InternalBus
-from plenum_tpu.common.internal_messages import (NewViewCheckpointsApplied,
+from plenum_tpu.common.internal_messages import (MissingMessage,
+                                                 NewViewCheckpointsApplied,
                                                  RaisedSuspicion, ReqKey,
                                                  RequestPropagates,
                                                  ViewChangeStarted)
-from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID, VALID_LEDGER_IDS,
+from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID, DOMAIN_LEDGER_ID,
+                                             VALID_LEDGER_IDS,
                                              Commit, Ordered, PrePrepare,
                                              Prepare)
 from plenum_tpu.common.request import Request
@@ -94,6 +96,17 @@ class OrderingService:
                       self.process_new_view_checkpoints_applied)
 
         self._batch_wait_scheduled = False
+        # ledger_id -> absolute deadline for the next freshness batch
+        self._freshness_deadline: dict[int, float] = {}
+        # (orig_view, pp_seq_no) -> cited digest: NewView batches we lack
+        # locally and have re-requested from peers
+        self._awaited_old_view: dict[tuple[int, int], str] = {}
+        # the last accepted NewView payload, re-run when an awaited old-view
+        # pre-prepare arrives
+        self._last_new_view_msg: Optional[NewViewCheckpointsApplied] = None
+        # backup instances joining a new view adopt the first pre-prepare
+        # they see as their position (ref _setup_last_ordered_for_non_master)
+        self._needs_last_ordered_setup = False
 
     # ------------------------------------------------------------------ #
     # request intake                                                     #
@@ -120,10 +133,33 @@ class OrderingService:
     def service(self) -> None:
         """Called each prod cycle: primaries turn queued requests into batches."""
         if not self.is_primary or self._data.waiting_for_new_view:
+            self._freshness_deadline.clear()
             return
         if not self._data.is_participating:
             return
         self.send_3pc_batch()
+        self._send_freshness_batches()
+
+    def _send_freshness_batches(self) -> None:
+        """The master primary orders an EMPTY batch on any ledger that has
+        gone STATE_FRESHNESS_UPDATE_INTERVAL without an update, so BLS
+        state signatures stay fresh and non-primaries can tell a quiet
+        primary from a dead one (ref ordering_service.py:1991
+        _send_3pc_freshness_batch + FreshnessChecker)."""
+        if not self._data.is_master:
+            return
+        interval = self._config.STATE_FRESHNESS_UPDATE_INTERVAL
+        if interval <= 0:
+            return
+        now = self._timer.get_current_time()
+        for lid in list(self.request_queues):
+            if lid == AUDIT_LEDGER_ID:
+                continue      # the audit ledger only moves with real batches
+            due = self._freshness_deadline.get(lid)
+            if due is None:
+                self._freshness_deadline[lid] = now + interval
+            elif now >= due:
+                self.send_3pc_batch(lid, force_empty=True)
 
     def send_3pc_batch(self, ledger_id: Optional[int] = None,
                        force_empty: bool = False) -> int:
@@ -165,16 +201,18 @@ class OrderingService:
             pp_time=pp_time,
             req_idr=all_digests,
             discarded=tuple(applied.discarded),
-            digest=self._batch_digest(all_digests, view_no, pp_seq_no),
             ledger_id=ledger_id,
             state_root=applied.state_root,
             txn_root=applied.txn_root,
             pool_state_root=applied.pool_state_root,
             audit_txn_root=applied.audit_txn_root,
         )
+        params["digest"] = self._batch_digest(params)
         if self._bls is not None:
             params = self._bls.update_pre_prepare(params, self._last_state_root(ledger_id))
         pre_prepare = PrePrepare(**params)
+        self._freshness_deadline[ledger_id] = \
+            pp_time + self._config.STATE_FRESHNESS_UPDATE_INTERVAL
         self._data.pp_seq_no = pp_seq_no
         self._data.last_batch_timestamp = pp_time
         key = (view_no, pp_seq_no)
@@ -204,12 +242,27 @@ class OrderingService:
         return ""
 
     @staticmethod
-    def _batch_digest(digests, view_no: int, pp_seq_no: int) -> str:
+    def _batch_digest(pp) -> str:
+        """Digest binding the FULL batch content — req set, rejection set,
+        roots, time, ledger — under its ORIGINAL view. Anything not bound
+        here could be mutated by a lying MessageRep responder and still
+        pass the f+1-prepare certification, framing the primary (or, on
+        executor-less backups, forking the instance)."""
         import hashlib
+        get = pp.get if isinstance(pp, dict) else \
+            lambda k, d=None: getattr(pp, k, d)
+        orig_view = get("original_view_no")
+        view = orig_view if orig_view is not None else get("view_no")
         h = hashlib.sha256()
-        h.update(f"{view_no}:{pp_seq_no}:".encode())
-        for d in digests:
-            h.update(d.encode())
+        h.update(f"{view}:{get('pp_seq_no')}:{get('ledger_id')}:"
+                 f"{get('pp_time')!r}:".encode())
+        for d in get("req_idr"):
+            h.update(b"\x00" + d.encode())
+        for d in get("discarded"):
+            h.update(b"\x01" + d.encode())
+        for root in (get("state_root"), get("txn_root"),
+                     get("audit_txn_root"), get("pool_state_root")):
+            h.update(b"\x02" + (root or "").encode())
         return h.hexdigest()
 
     # ------------------------------------------------------------------ #
@@ -239,7 +292,8 @@ class OrderingService:
     def _suspect(self, suspicion, sender: str) -> None:
         self._bus.send(RaisedSuspicion(inst_id=self._data.inst_id,
                                        code=suspicion.code,
-                                       reason=f"{suspicion.reason} (from {sender})"))
+                                       reason=f"{suspicion.reason} (from {sender})",
+                                       sender=sender))
 
     # ------------------------------------------------------------------ #
     # PRE-PREPARE                                                        #
@@ -256,6 +310,12 @@ class OrderingService:
         if key in self.prePrepares and self.prePrepares[key].digest != msg.digest:
             self._suspect(Suspicions.DUPLICATE_PPR_SENT, sender)
             return DISCARD
+        # The digest must actually bind the batch content — everything
+        # downstream (prepares, commits, message-req recovery) anchors on it.
+        # Re-ordered batches keep the digest minted in their original view.
+        if msg.digest != self._batch_digest(msg):
+            self._suspect(Suspicions.PPR_DIGEST_WRONG, sender)
+            return DISCARD
         if key in self.sent_preprepares:
             return PROCESS                         # our own broadcast echoed
         # Re-ordered batches legitimately carry their original timestamp; only
@@ -267,6 +327,17 @@ class OrderingService:
                 abs(msg.pp_time - now) > self._config.ACCEPTABLE_DEVIATION_PREPREPARE_SECS):
             self._suspect(Suspicions.PPR_TIME_WRONG, sender)
             return DISCARD
+        # A backup instance entering a new view adopts the first pre-prepare
+        # it sees as its position — backup sequences have no cross-view
+        # continuity guarantee, and without this a backup that lagged at
+        # view-change time stalls forever (silently disabling the monitor's
+        # master-vs-backup comparison). Ref _setup_last_ordered_for_non_master.
+        if self._needs_last_ordered_setup and not self._data.is_master:
+            if msg.pp_seq_no - 1 > self._data.last_ordered_3pc[1]:
+                self._data.last_ordered_3pc = (msg.view_no, msg.pp_seq_no - 1)
+                self._data.pp_seq_no = max(self._data.pp_seq_no,
+                                           msg.pp_seq_no - 1)
+            self._needs_last_ordered_setup = False
         # Expect strictly consecutive batches from one primary.
         expected = self._last_preprepared_seq() + 1
         if msg.pp_seq_no > expected:
@@ -366,6 +437,8 @@ class OrderingService:
             self._suspect(Suspicions.PR_DIGEST_WRONG, sender)
             return DISCARD
         votes[sender] = msg
+        if pp is None:
+            self._maybe_request_preprepare(key)
         self._try_prepare_quorum(key)
         return PROCESS
 
@@ -418,8 +491,56 @@ class OrderingService:
         # only validated sigs ever reach aggregation.
         if pp is not None and self._bls is not None:
             self._bls.process_commit(msg, sender)
+        if pp is None:
+            self._maybe_request_preprepare(key)
         self._try_order(key)
         return PROCESS
+
+    # ------------------------------------------------------------------ #
+    # missing-message recovery (ref message_req_processor.py)            #
+    # ------------------------------------------------------------------ #
+
+    def _maybe_request_preprepare(self, key: tuple[int, int]) -> None:
+        """PREPARE votes certify a pre-prepare we never received (lost on the
+        wire): ask peers for it instead of waiting for a full catchup."""
+        votes = self.prepares.get(key, {})
+        if not votes:
+            return
+        from collections import Counter
+        digest, count = Counter(
+            p.digest for p in votes.values()).most_common(1)[0]
+        if not self._data.quorums.weak.is_reached(count):
+            return
+        self._bus.send(MissingMessage(
+            msg_type="PREPREPARE",
+            key={"inst_id": self._data.inst_id,
+                 "view_no": key[0], "pp_seq_no": key[1]},
+            inst_id=self._data.inst_id, dst=None, stash_data=(digest,)))
+
+    def process_requested_preprepare(self, msg: PrePrepare) -> None:
+        """A peer-served pre-prepare. NEVER taken on trust: it is only
+        admitted if f+1 PREPARE votes we independently received certify its
+        exact digest — a lying responder cannot inject state, because f+1
+        matching prepares contain at least one honest vote for the real
+        message."""
+        key = (msg.view_no, msg.pp_seq_no)
+        if key in self.ordered or key in self.prePrepares:
+            return
+        # The digest certified by the prepares must really hash THIS content —
+        # otherwise a lying responder could attach the certified digest to a
+        # mutated batch (different req_idr, roots, or time) and either frame
+        # the primary or fork an executor-less backup.
+        if msg.digest != self._batch_digest(msg):
+            return
+        votes = self.prepares.get(key, {})
+        matching = sum(1 for p in votes.values() if p.digest == msg.digest)
+        if not self._data.quorums.weak.is_reached(matching):
+            return
+        # Certified: run it through the NORMAL admission path (as if the
+        # primary's original broadcast had just arrived) so every stash
+        # reason — missing requests, catching up, watermarks — keeps its
+        # usual replay semantics instead of silently dropping the recovery.
+        self._stasher.dispatch(msg, self._data.primary_name)
 
     # ------------------------------------------------------------------ #
     # ordering                                                           #
@@ -545,20 +666,58 @@ class OrderingService:
         self.commits.clear()
         self._commits_sent.clear()
         self._stashed_ooo_commits.clear()
+        self._awaited_old_view.clear()
+        self._last_new_view_msg = None
+        if not self._data.is_master:
+            self._needs_last_ordered_setup = True
+
+    def process_requested_old_view_preprepare(self, pp: PrePrepare) -> None:
+        """A peer served an old-view pre-prepare the NewView cited but we
+        lacked. Admitted ONLY if its digest matches the NewView citation
+        (which a view-change quorum stands behind) and it binds its content."""
+        orig = _orig_view(pp)
+        key = (orig, pp.pp_seq_no)
+        expected = self._awaited_old_view.get(key)
+        if expected is None or pp.digest != expected:
+            return
+        if pp.digest != self._batch_digest(pp):
+            return
+        del self._awaited_old_view[key]
+        self.old_view_preprepares[key] = pp
+        if self._last_new_view_msg is not None:
+            self.process_new_view_checkpoints_applied(self._last_new_view_msg)
 
     def process_new_view_checkpoints_applied(self, msg: NewViewCheckpointsApplied) -> None:
         """Re-order the prepared batches carried into the new view
         (ref process_new_view_checkpoints_applied :2380)."""
-        # A new primary must continue the sequence, never reuse ordered seqnos.
-        self._data.pp_seq_no = max(self._data.pp_seq_no,
-                                   self._data.last_ordered_3pc[1],
-                                   msg.checkpoint[2])
+        self._last_new_view_msg = msg
+        # Continue the sequence from what actually survives into the new view:
+        # ordered prefix, selected checkpoint, re-ordered batches — and EVERY
+        # seq_no the NewView cites, held locally or not. Minting a fresh batch
+        # at a cited-but-locally-missing seq_no would be a consensus fork
+        # (nodes that ordered the certified batch in the old view hold a
+        # different txn at that seq). Only null-certified gaps may be reused.
+        cited_seqs = [b[2] for b in msg.batches]
+        self._data.pp_seq_no = max([self._data.last_ordered_3pc[1],
+                                    msg.checkpoint[2]] + cited_seqs)
         for (_view, orig_view, pp_seq_no, digest) in msg.batches:
             if pp_seq_no <= self._data.last_ordered_3pc[1]:
                 continue
+            if (self._data.view_no, pp_seq_no) in self.prePrepares:
+                continue      # already re-ordered (idempotent re-entry)
             old_pp = self.old_view_preprepares.get((orig_view, pp_seq_no))
             if old_pp is None or old_pp.digest != digest:
-                continue                     # will be recovered via catchup
+                # ask peers for the certified old-view pre-prepare instead of
+                # silently leaving the gap (ref OldViewPrePrepareRequest
+                # ordering_service.py:2409); the rep is validated against the
+                # NewView-cited digest before use
+                self._awaited_old_view[(orig_view, pp_seq_no)] = digest
+                self._bus.send(MissingMessage(
+                    msg_type="OLD_VIEW_PREPREPARE",
+                    key={"inst_id": self._data.inst_id,
+                         "view_no": orig_view, "pp_seq_no": pp_seq_no},
+                    inst_id=self._data.inst_id, dst=None))
+                continue
             # These requests ride the re-ordered batch; don't re-batch them.
             for queue in self.request_queues.values():
                 for d in old_pp.req_idr:

@@ -1,0 +1,91 @@
+"""Primary-health watchdog: detect a dead or stalling primary and vote.
+
+Reference behavior: the reference detects a bad master primary three ways —
+primary disconnect (plenum/server/consensus/monitoring/
+primary_connection_monitor_service.py, node.py:511), ordering stalls on
+finalized requests (unordered-request checks via the monitor,
+monitor.py:425), and state-freshness stalls (ordering_service.py:1991 +
+suspicion STATE_SIGS_ARE_NOT_UPDATED). This service folds the
+ordering-progress and freshness checks into one watchdog on the master
+instance of every non-primary node: if there is work to order and the
+3PC position does not advance within ORDERING_PROGRESS_TIMEOUT, or nothing
+at all has been ordered for longer than the freshness interval allows,
+emit VoteForViewChange. The vote rides the normal InstanceChange f+1
+quorum, so a single slow node cannot force a view change alone.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from plenum_tpu.common.event_bus import InternalBus
+from plenum_tpu.common.internal_messages import VoteForViewChange
+from plenum_tpu.common.suspicion_codes import Suspicions
+from plenum_tpu.common.timer import RepeatingTimer, TimerService
+from plenum_tpu.config import Config
+
+from .consensus_shared_data import ConsensusSharedData
+
+
+class PrimaryHealthService:
+    def __init__(self,
+                 data: ConsensusSharedData,
+                 timer: TimerService,
+                 bus: InternalBus,
+                 has_pending_work: Callable[[], bool],
+                 config: Optional[Config] = None):
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._has_pending_work = has_pending_work
+        self._config = config or Config()
+
+        self._progress_marker = data.last_ordered_3pc
+        self._stall_since: Optional[float] = None
+        now = timer.get_current_time()
+        self._last_order_time = now
+        self._ticker = RepeatingTimer(
+            timer, self._config.PRIMARY_HEALTH_CHECK_FREQ, self.check)
+
+    def stop(self) -> None:
+        self._ticker.stop()
+
+    # ------------------------------------------------------------------ #
+
+    def check(self) -> None:
+        now = self._timer.get_current_time()
+        if self._data.last_ordered_3pc != self._progress_marker:
+            self._progress_marker = self._data.last_ordered_3pc
+            self._last_order_time = now
+            self._stall_since = None
+        if (not self._data.is_participating
+                or self._data.waiting_for_new_view
+                or self._data.is_primary):
+            self._stall_since = None
+            self._last_order_time = now
+            return
+        self._check_ordering_progress(now)
+        self._check_freshness(now)
+
+    def _check_ordering_progress(self, now: float) -> None:
+        """Finalized-but-unordered work + no 3PC progress = stalled primary."""
+        if not self._has_pending_work():
+            self._stall_since = None
+            return
+        if self._stall_since is None:
+            self._stall_since = now
+            return
+        if now - self._stall_since >= self._config.ORDERING_PROGRESS_TIMEOUT:
+            self._vote(Suspicions.PRIMARY_STALLED)
+            self._stall_since = now          # re-vote each timeout period
+
+    def _check_freshness(self, now: float) -> None:
+        """A live primary orders SOMETHING (a freshness batch at minimum)
+        every STATE_FRESHNESS_UPDATE_INTERVAL; silence far beyond that means
+        the primary is gone even if no client traffic is pending."""
+        limit = self._config.STATE_FRESHNESS_UPDATE_INTERVAL * 1.5
+        if now - self._last_order_time >= limit:
+            self._vote(Suspicions.STATE_SIGS_ARE_NOT_UPDATED)
+            self._last_order_time = now      # re-vote cadence, not a reset
+
+    def _vote(self, suspicion) -> None:
+        self._bus.send(VoteForViewChange(suspicion_code=suspicion.code))

@@ -14,7 +14,8 @@ from typing import Callable, Optional
 from plenum_tpu.common.event_bus import ExternalBus, InternalBus
 from plenum_tpu.common.internal_messages import (CheckpointStabilized,
                                                  NewViewAccepted,
-                                                 NewViewCheckpointsApplied)
+                                                 NewViewCheckpointsApplied,
+                                                 ViewChangeStarted)
 from plenum_tpu.common.request import Request
 from plenum_tpu.common.timer import TimerService
 from plenum_tpu.config import Config
@@ -24,6 +25,7 @@ from .bls_bft_replica import BlsBftReplica
 from .checkpoint_service import CheckpointService
 from .consensus_shared_data import ConsensusSharedData, replica_name
 from .ordering_service import OrderingService
+from .primary_health_service import PrimaryHealthService
 from .primary_selector import RoundRobinPrimariesSelector
 from .view_change_service import ViewChangeService
 from .view_change_trigger_service import ViewChangeTriggerService
@@ -67,16 +69,54 @@ class Replica:
             data=self._data, bus=self.internal_bus, network=network,
             config=self.config,
             checkpoint_digest_provider=checkpoint_digest_provider)
-        self.view_changer = ViewChangeService(
-            data=self._data, timer=timer, bus=self.internal_bus,
-            network=network, config=self.config, selector=selector,
-            instance_count=instance_count)
-        self.vc_trigger = ViewChangeTriggerService(
-            data=self._data, timer=timer, bus=self.internal_bus,
-            network=network, config=self.config)
+        # View change is a NODE-level event driven by the MASTER instance only:
+        # ViewChange/ViewChangeAck/NewView/InstanceChange carry no inst_id on
+        # the wire (matching the reference), so giving every backup its own
+        # view-change machinery on the shared bus makes instances impersonate
+        # each other's votes. Backups follow the master's completed view change
+        # via Replica.adopt_new_view (driven by the node).
+        self.view_changer: Optional[ViewChangeService] = None
+        self.vc_trigger: Optional[ViewChangeTriggerService] = None
+        self.primary_health: Optional[PrimaryHealthService] = None
+        if self._data.is_master:
+            self.view_changer = ViewChangeService(
+                data=self._data, timer=timer, bus=self.internal_bus,
+                network=network, config=self.config, selector=selector,
+                instance_count=instance_count)
+            self.vc_trigger = ViewChangeTriggerService(
+                data=self._data, timer=timer, bus=self.internal_bus,
+                network=network, config=self.config)
+            self.primary_health = PrimaryHealthService(
+                data=self._data, timer=timer, bus=self.internal_bus,
+                has_pending_work=self._has_unordered_work, config=self.config)
 
         self.internal_bus.subscribe(NewViewAccepted, self._on_new_view_accepted)
         self.internal_bus.subscribe(CheckpointStabilized, self._on_checkpoint_stable)
+
+    def _has_unordered_work(self) -> bool:
+        """Finalized requests queued, or batches pre-prepared but unordered."""
+        return (any(self.ordering.request_queues.values())
+                or bool(self._data.preprepared))
+
+    def adopt_new_view(self, view_no: int, primaries: list[str]) -> None:
+        """Backup instance follows a master-completed view change: take the
+        new view and primaries, drop in-flight 3PC work, and realign the
+        batch counter so the instance's new primary continues the sequence
+        (ref: node-level primary re-selection on view change; backups restart
+        from their own last ordered position)."""
+        if self._data.is_master or view_no <= self._data.view_no:
+            return
+        self._data.view_no = view_no
+        self._data.primaries = list(primaries)
+        self._data.waiting_for_new_view = False
+        self.ordering.process_view_change_started(
+            ViewChangeStarted(view_no=view_no))
+        # Continue numbering from this instance's own ordered prefix.
+        floor = self._data.last_ordered_3pc[1]
+        self.ordering.process_new_view_checkpoints_applied(
+            NewViewCheckpointsApplied(view_no=view_no,
+                                      checkpoint=(0, 0, floor, ""),
+                                      batches=()))
 
     # --- event glue -------------------------------------------------------
 

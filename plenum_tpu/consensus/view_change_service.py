@@ -20,7 +20,8 @@ import hashlib
 from typing import Optional
 
 from plenum_tpu.common.event_bus import ExternalBus, InternalBus
-from plenum_tpu.common.internal_messages import (NeedViewChange,
+from plenum_tpu.common.internal_messages import (MissingMessage,
+                                                 NeedViewChange,
                                                  NewViewAccepted,
                                                  NewViewCheckpointsApplied,
                                                  PrimarySelected,
@@ -221,6 +222,17 @@ class ViewChangeService:
                 self._bus.send(NeedViewChange(view_no=view_no + 1))
         self._timer.schedule(self._config.NEW_VIEW_TIMEOUT, on_timeout)
 
+        def request_new_view():
+            # Half-time probe: maybe only the NEW_VIEW itself was lost —
+            # cheaper to re-request it than to escalate views.
+            if (self._data.waiting_for_new_view
+                    and self._data.view_no == view_no
+                    and self._new_view is None):
+                self._bus.send(MissingMessage(
+                    msg_type="NEW_VIEW", key={"view_no": view_no},
+                    inst_id=self._data.inst_id, dst=None))
+        self._timer.schedule(self._config.NEW_VIEW_TIMEOUT / 2, request_new_view)
+
     # --- collecting votes -------------------------------------------------
 
     def process_view_change(self, msg: ViewChange, sender: str):
@@ -332,8 +344,13 @@ class ViewChangeService:
                 return self._reject_new_view(f"NEW_VIEW cites unknown node {author}")
             vc = own.get(author)
             if vc is None:
-                # Wait for the missing vote to arrive, then re-validate.
+                # Wait for the missing vote — and actively re-request it from
+                # peers (any holder can serve it; the cited digest vouches).
                 self._pending_new_view = (msg, sender)
+                self._bus.send(MissingMessage(
+                    msg_type="VIEW_CHANGE",
+                    key={"view_no": msg.view_no, "author": author},
+                    inst_id=self._data.inst_id, dst=None))
                 return PROCESS
             if view_change_digest(vc) != digest:
                 return self._reject_new_view(
@@ -352,6 +369,28 @@ class ViewChangeService:
         self._pending_new_view = None
         self._finish(msg)
         return PROCESS
+
+    def process_requested_view_change(self, vc: ViewChange, author: str) -> None:
+        """A peer-served ViewChange vote. Safe to record under the claimed
+        author without proof: it is only ever USED where its digest is checked
+        against a NewView's citation (process_new_view) or against an ack
+        quorum (_acked) — a forged vote fails both."""
+        if not author or vc.view_no < self._data.view_no:
+            return
+        # NEVER overwrite a vote we already hold: an unsolicited forged rep
+        # could otherwise evict the genuine vote and wedge every view change.
+        if author in self._view_changes.get(vc.view_no, {}):
+            return
+        self._record_view_change(vc, author)
+        self._try_build_or_finish()
+
+    def process_requested_new_view(self, nv: NewView) -> None:
+        """A peer-served NewView: identical full validation, minus the
+        sender-is-primary check (the responder is a relay, and the content is
+        re-derived from our own collected votes anyway)."""
+        if nv.view_no != self._data.view_no or not self._data.waiting_for_new_view:
+            return
+        self.process_new_view(nv, self._data.primary_name or "")
 
     def _reject_new_view(self, why: str):
         self._bus.send(RaisedSuspicion(inst_id=self._data.inst_id,

@@ -184,6 +184,15 @@ class BlsCryptoVerifier:
             self._vk_cache[verkey] = pt
         return pt
 
+    def is_wellformed_sig(self, signature: str) -> bool:
+        """Structural check only (b58 + on-curve): the cheap gate used by
+        deferred COMMIT validation; the pairing runs later in aggregate."""
+        try:
+            _decode_sig(signature)
+            return True
+        except (ValueError, KeyError):
+            return False
+
     def verify_sig(self, signature: str, message: bytes, verkey: str) -> bool:
         try:
             sig = _decode_sig(signature)

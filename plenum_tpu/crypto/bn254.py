@@ -13,7 +13,13 @@ D-type, untwist (x,y) → (x·w², y·w³) with w² = v, v³ = ξ.
 """
 from __future__ import annotations
 
+import ctypes
 from typing import Optional, Tuple
+
+try:
+    from plenum_tpu.native import bn254_lib as _NATIVE
+except Exception:                      # toolchain missing: pure Python only
+    _NATIVE = None
 
 # --- base field --------------------------------------------------------------
 
@@ -37,6 +43,43 @@ Fq2 = Tuple[int, int]
 
 def _inv(a: int) -> int:
     return pow(a, -1, P)
+
+
+# --- native bridge (encodings match plenum_tpu/native/bn254.cpp) -------------
+
+def _enc_g1(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 64
+    return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+def _dec_g1(data: bytes):
+    if data == b"\x00" * 64:
+        return None
+    return (int.from_bytes(data[:32], "big"), int.from_bytes(data[32:], "big"))
+
+
+def _enc_g2(pt) -> bytes:
+    if pt is None:
+        return b"\x00" * 128
+    (x0, x1), (y0, y1) = pt
+    return b"".join(v.to_bytes(32, "big") for v in (x0, x1, y0, y1))
+
+
+def _dec_g2(data: bytes):
+    if data == b"\x00" * 128:
+        return None
+    vals = [int.from_bytes(data[i:i + 32], "big") for i in range(0, 128, 32)]
+    return ((vals[0], vals[1]), (vals[2], vals[3]))
+
+
+def _native_call(fn, *args_then_outsize) -> Optional[bytes]:
+    """Call fn(*byte_args, out_buffer); None on native failure (falls back)."""
+    *args, out_size = args_then_outsize
+    buf = ctypes.create_string_buffer(out_size)
+    if fn(*args, buf) != 0:
+        return None
+    return buf.raw
 
 
 # --- Fq2 = Fq[i]/(i²+1) ------------------------------------------------------
@@ -261,6 +304,11 @@ def g1_neg(a: G1Point) -> G1Point:
 
 def g1_mul(a: G1Point, k: int) -> G1Point:
     k %= R
+    if _NATIVE is not None and a is not None and k:
+        out = _native_call(_NATIVE.pc_g1_mul, _enc_g1(a),
+                           k.to_bytes(32, "big"), 64)
+        if out is not None:
+            return _dec_g1(out)
     out: G1Point = None
     while k:
         if k & 1:
@@ -306,6 +354,11 @@ def g2_neg(a: G2Point) -> G2Point:
 
 def g2_mul(a: G2Point, k: int) -> G2Point:
     k %= R
+    if _NATIVE is not None and a is not None and k:
+        out = _native_call(_NATIVE.pc_g2_mul, _enc_g2(a),
+                           k.to_bytes(32, "big"), 128)
+        if out is not None:
+            return _dec_g2(out)
     out: G2Point = None
     while k:
         if k & 1:
@@ -316,7 +369,11 @@ def g2_mul(a: G2Point, k: int) -> G2Point:
 
 
 def g2_in_subgroup(pt: G2Point) -> bool:
-    return g2_is_on_curve(pt) and g2_mul(pt, R) is None
+    if not g2_is_on_curve(pt):
+        return False
+    if _NATIVE is not None and pt is not None:
+        return bool(_NATIVE.pc_g2_in_subgroup(_enc_g2(pt)))
+    return g2_mul(pt, R) is None
 
 
 def g2_frobenius(pt: G2Point) -> G2Point:
@@ -395,7 +452,19 @@ def multi_pairing(pairs) -> Fq12:
 
 
 def pairing_check(pairs) -> bool:
-    """True iff ∏ e(Qᵢ, Pᵢ) == 1 — the shape every BLS verification reduces to."""
+    """True iff ∏ e(Qᵢ, Pᵢ) == 1 — the shape every BLS verification reduces to.
+
+    Dispatches to the in-tree C++ library (plenum_tpu/native/bn254.cpp) when
+    it built: the aggregate COMMIT check sits on the 3PC hot path, and the
+    native multi-pairing is ~20× the pure-Python one. Falls back to the
+    Python twin (the differential-testing reference) otherwise."""
+    pairs = list(pairs)
+    if _NATIVE is not None:
+        g2_bytes = b"".join(_enc_g2(q) for q, _ in pairs)
+        g1_bytes = b"".join(_enc_g1(p) for _, p in pairs)
+        res = _NATIVE.pc_pairing_check(g2_bytes, g1_bytes, len(pairs))
+        if res >= 0:          # -1 = malformed input: let Python decide
+            return bool(res)
     return multi_pairing(pairs) == F12_ONE
 
 

@@ -1,0 +1,34 @@
+"""Peer blacklisting: convert protocol suspicions into ignored peers.
+
+Reference behavior: plenum/server/blacklister.py (SimpleBlacklister) +
+node.py:2854-2944 (reportSuspiciousNode) — suspicions that implicate the
+PRIMARY become view-change votes; suspicions that implicate an ordinary peer
+get that peer blacklisted (its traffic dropped at ingress). Tests whitelist
+intentionally-faulty nodes so scenarios don't cascade (test_node.py:88-98).
+"""
+from __future__ import annotations
+
+
+class Blacklister:
+    def __init__(self, whitelist: tuple[str, ...] = ()):
+        self._blacklisted: dict[str, list[int]] = {}   # peer -> suspicion codes
+        self._whitelist: set[str] = set(whitelist)
+
+    def blacklist(self, peer: str, code: int = 0) -> bool:
+        """Returns True if the peer is now (or already was) blacklisted."""
+        if peer in self._whitelist:
+            return False
+        self._blacklisted.setdefault(peer, []).append(code)
+        return True
+
+    def is_blacklisted(self, peer: str) -> bool:
+        return peer in self._blacklisted
+
+    def whitelist(self, peer: str) -> None:
+        """Forgive + exempt a peer (test fault-injection needs this)."""
+        self._whitelist.add(peer)
+        self._blacklisted.pop(peer, None)
+
+    @property
+    def blacklisted(self) -> dict[str, list[int]]:
+        return dict(self._blacklisted)

@@ -38,6 +38,7 @@ from plenum_tpu.execution import txn as txn_lib
 from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
 from plenum_tpu.ledger.hash_store import HashStore
 from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.ledger.tree_hasher import make_tree_hasher
 from plenum_tpu.node.client_authn import CoreAuthNr, ReqAuthenticator
 from plenum_tpu.node.pool_manager import TxnPoolManager
 from plenum_tpu.state.pruning_state import PruningState
@@ -82,7 +83,12 @@ class NodeBootstrap:
         return KvFile(os.path.join(self.data_dir, label))
 
     def _ledger(self, ledger_id: int, label: str) -> Ledger:
-        tree = CompactMerkleTree(hash_store=HashStore(self._kv(f"{label}_hashes")))
+        # crypto_backend routes to EVERY ledger's tree hasher — with "jax"
+        # the batch appends/proof paths run on device (the north-star seam;
+        # ref tree_hasher.py:4 + SURVEY.md §7 stage 2/3)
+        tree = CompactMerkleTree(
+            make_tree_hasher(self.crypto_backend),
+            hash_store=HashStore(self._kv(f"{label}_hashes")))
         return Ledger(tree, self._kv(f"{label}_log"),
                       genesis_txns=self.genesis.get(ledger_id, ()))
 

@@ -20,7 +20,13 @@ from typing import Any, Callable, Optional
 
 from plenum_tpu.catchup import NodeLeecherService, SeederService
 from plenum_tpu.common.event_bus import ExternalBus
-from plenum_tpu.common.internal_messages import (NeedMasterCatchup, ReqKey)
+from plenum_tpu.common.internal_messages import (MissingMessage,
+                                                 NeedMasterCatchup,
+                                                 NewViewAccepted,
+                                                 RaisedSuspicion, ReqKey,
+                                                 RequestPropagates,
+                                                 VoteForViewChange)
+from plenum_tpu.common.suspicion_codes import Suspicions
 from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID, CatchupRep,
                                              CatchupReq, ConsistencyProof,
                                              LedgerStatus, Ordered,
@@ -39,8 +45,27 @@ from plenum_tpu.execution import txn as txn_lib
 from plenum_tpu.execution.exceptions import (InvalidClientRequest,
                                              UnauthorizedClientRequest)
 from plenum_tpu.execution.write_manager import ThreePcBatch
+from plenum_tpu.node.blacklister import Blacklister
 from plenum_tpu.node.bootstrap import NodeComponents
+from plenum_tpu.node.message_req_processor import MessageReqProcessor
+from plenum_tpu.node.monitor import Monitor
 from plenum_tpu.node.propagator import Propagator
+
+# Suspicions whose message only the primary can have authored: these implicate
+# the primary and become view-change votes rather than blacklistings
+# (ref node.py:2854-2944 reportSuspiciousNode).
+PRIMARY_FAULT_CODES = frozenset(s.code for s in (
+    Suspicions.DUPLICATE_PPR_SENT, Suspicions.PPR_DIGEST_WRONG,
+    Suspicions.PPR_REJECT_WRONG, Suspicions.PPR_STATE_WRONG,
+    Suspicions.PPR_TXN_WRONG, Suspicions.PPR_TIME_WRONG,
+    Suspicions.PPR_BLS_MULTISIG_WRONG, Suspicions.PPR_AUDIT_TXN_ROOT_WRONG))
+
+# Unambiguous peer misbehavior that blacklists the sender. Deliberately tiny:
+# digest/BLS mismatches against OUR pre-prepare (PR_DIGEST_WRONG, CM_BLS_WRONG)
+# are NOT here — an equivocating primary makes honest peers produce exactly
+# those, and blacklisting them would let the primary partition its validators.
+BLACKLIST_CODES = frozenset(s.code for s in (
+    Suspicions.PPR_FRM_NON_PRIMARY, Suspicions.INVALID_REQ_SIGNATURE))
 
 
 class Node:
@@ -60,6 +85,12 @@ class Node:
         self.pool_manager._on_changed = self._on_pool_changed
         self.validators = self.pool_manager.node_names or [name]
         self.quorums = self.pool_manager.quorums
+
+        # suspicions → blacklist; enforced at bus ingress so no service ever
+        # sees traffic from a blacklisted peer (ref server/blacklister.py)
+        self.blacklister = Blacklister()
+        self.node_bus.set_incoming_filter(
+            lambda frm: not self.blacklister.is_blacklisted(frm))
 
         self.propagator = Propagator(
             name, self.quorums,
@@ -108,6 +139,8 @@ class Node:
         self.node_bus.subscribe(CatchupRep, self.leecher.process_catchup_rep)
 
         self.node_bus.subscribe(Propagate, self._receive_propagate)
+        # "ask peers for a missing message" (ref message_req_processor.py:13)
+        self.message_req = MessageReqProcessor(self)
         from collections import deque
         self.spylog: Any = deque(maxlen=1000)      # bounded event trace
 
@@ -118,11 +151,33 @@ class Node:
             timer, self.config.OUTDATED_REQS_CHECK_INTERVAL,
             self._clean_outdated_reqs)
 
+        # RBFT monitor: compare master vs backup instances, vote out a
+        # degraded master (ref monitor.py:136, node.checkPerformance:2501)
+        self.monitor = Monitor(self.config, now=timer.get_current_time)
+        self._perf_check_timer = RepeatingTimer(
+            timer, self.config.PerfCheckFreq, self.check_performance)
+
+    def check_performance(self) -> None:
+        if self.leecher.is_running:
+            return
+        if self.monitor.is_master_degraded():
+            self.spylog.append(("master_degraded", self.monitor.stats()))
+            self.replicas.master.internal_bus.send(
+                VoteForViewChange(
+                    suspicion_code=Suspicions.PRIMARY_DEGRADED.code))
+            # history is void once we've called for a new master
+            self.monitor.reset()
+
     def _clean_outdated_reqs(self) -> None:
         now = self.timer.get_current_time()
         ttl = self.config.PROPAGATES_PHASE_REQ_TIMEOUT
+        retention = self.config.EXECUTED_REQ_RETENTION
         for digest, state in list(self.propagator.requests.items()):
-            if not state.finalised and now - state.added_at > ttl:
+            expired = (
+                (state.executed and state.executed_at is not None
+                 and now - state.executed_at > retention)
+                or (not state.finalised and now - state.added_at > ttl))
+            if expired:
                 self.propagator.requests.free(digest)
                 self._seen_propagates.pop(digest, None)
         # _seen_propagates entries whose request never made it into the
@@ -132,6 +187,7 @@ class Node:
         for digest in list(self._seen_propagates):
             if digest not in self.propagator.requests:
                 del self._seen_propagates[digest]
+        self.monitor.req_tracker.cleanup(now, ttl)
 
     # --- wiring -----------------------------------------------------------
 
@@ -152,11 +208,59 @@ class Node:
             checkpoint_digest_provider=(
                 lambda seq: audit.uncommitted_root_hash.hex()),
             instance_count=max(1, self.pool_manager.quorums.f + 1))
+        bls.report_bad_signature = lambda sender, r=replica: \
+            r.internal_bus.send(RaisedSuspicion(
+                inst_id=inst_id, code=Suspicions.CM_BLS_WRONG.code,
+                reason="bad COMMIT BLS signature (order-time bisection)",
+                sender=sender))
         replica.internal_bus.subscribe(Ordered, self._on_ordered)
+        replica.internal_bus.subscribe(RaisedSuspicion, self._on_suspicion)
+        # lambdas: message_req is constructed after the replicas
+        replica.internal_bus.subscribe(
+            MissingMessage, lambda m: self.message_req.process_missing(m))
+        replica.internal_bus.subscribe(
+            RequestPropagates, self._on_request_propagates)
         if inst_id == 0:
             replica.internal_bus.subscribe(
                 NeedMasterCatchup, lambda _msg: self.start_catchup())
+            replica.internal_bus.subscribe(NewViewAccepted,
+                                           self._on_master_new_view)
         return replica
+
+    def _on_request_propagates(self, msg: RequestPropagates) -> None:
+        """Ordering stashed a pre-prepare on MISSING_REQUESTS: fetch the
+        requests from peers (previously this event had no subscriber and a
+        dropped PROPAGATE could wedge a replica until catchup)."""
+        for digest in msg.bad_requests:
+            self.message_req.request("PROPAGATE", {"digest": digest})
+
+    def _on_master_new_view(self, msg: NewViewAccepted) -> None:
+        """The master completed a view change: every backup instance follows
+        (view change is node-level; backups have no VC machinery of their own)."""
+        primaries = list(self.replicas.master.data.primaries)
+        for replica in self.replicas:
+            replica.adopt_new_view(msg.view_no, primaries)
+        self.monitor.reset()
+        self.spylog.append(("view_change_complete", msg.view_no))
+
+    def _on_suspicion(self, msg: RaisedSuspicion) -> None:
+        """Route a protocol suspicion: primary-authored faults become
+        view-change votes; unambiguous peer misbehavior blacklists the
+        sender (ref node.py:2854-2944)."""
+        self.spylog.append(("suspicion", (msg.code, msg.sender)))
+        if msg.inst_id >= len(self.replicas):
+            return
+        replica = self.replicas[msg.inst_id]
+        if msg.code in PRIMARY_FAULT_CODES and \
+                msg.sender == replica.data.primary_name:
+            if msg.inst_id == 0:
+                replica.internal_bus.send(
+                    VoteForViewChange(suspicion_code=msg.code))
+            return
+        if (msg.code in BLACKLIST_CODES and msg.sender
+                and msg.sender != self.name):
+            if self.blacklister.blacklist(msg.sender, msg.code):
+                self.spylog.append(("blacklisted", msg.sender))
 
     # --- catchup ----------------------------------------------------------
 
@@ -208,6 +312,7 @@ class Node:
         self.spylog.append(("catchup_complete", (view_no, pp_seq_no)))
 
     def _forward_to_replicas(self, digest: str) -> None:
+        self.monitor.request_finalized(digest)
         for replica in self.replicas:
             replica.internal_bus.send(ReqKey(digest))
 
@@ -380,6 +485,10 @@ class Node:
         while self._ordered_queue:
             msg = self._ordered_queue.pop(0)
             done += 1
+            self.monitor.request_ordered(msg.inst_id, msg.req_idr)
+            if msg.inst_id == 0:
+                for digest in msg.discarded:
+                    self.monitor.req_tracker.drop(digest)
             if msg.inst_id != 0:
                 self.spylog.append(("backup_ordered", msg))
                 continue
@@ -405,10 +514,11 @@ class Node:
             state = self.propagator.requests.get(digest) if digest else None
             if state is not None and state.client_name is not None:
                 self._client_send(Reply(result=txn), state.client_name)
-            # free per-request tracking: durable dedup now lives in the
-            # seq-no DB (ref propagator free after execution)
+            # Executed state is RETAINED (freed later by the TTL sweep):
+            # peers may still MessageReq this PROPAGATE. Durable client-resend
+            # dedup lives in the seq-no DB regardless.
             if digest:
-                self.propagator.requests.free(digest)
+                self.propagator.requests.mark_executed(digest)
                 self._seen_propagates.pop(digest, None)
         for digest in msg.discarded:
             state = self.propagator.requests.get(digest)
@@ -417,7 +527,10 @@ class Node:
                                          req_id=state.request.req_id,
                                          reason="rejected by dynamic validation"),
                                   state.client_name)
-            self.propagator.requests.free(digest)
+            # discarded digests are still part of req_idr: lagging validators
+            # must be able to fetch them to re-apply the batch, so they get
+            # the same retention as executed ones
+            self.propagator.requests.mark_executed(digest)
             self._seen_propagates.pop(digest, None)
         if msg.ledger_id == POOL_LEDGER_ID:
             self.pool_manager.pool_changed()

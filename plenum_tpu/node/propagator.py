@@ -17,7 +17,7 @@ from plenum_tpu.common.request import Request
 
 class RequestState:
     __slots__ = ("request", "propagates", "finalised", "forwarded",
-                 "client_name", "executed", "added_at")
+                 "client_name", "executed", "added_at", "executed_at")
 
     def __init__(self, request: Request, added_at: float = 0.0):
         self.request = request
@@ -27,6 +27,7 @@ class RequestState:
         self.executed = False
         self.client_name: Optional[str] = None     # who to REPLY to
         self.added_at = added_at                   # for unfinalized-state TTL
+        self.executed_at: Optional[float] = None   # for executed-state TTL
 
 
 class Requests(dict):
@@ -58,6 +59,7 @@ class Requests(dict):
         state = self.get(digest)
         if state:
             state.executed = True
+            state.executed_at = self._now()
 
     def free(self, digest: str) -> None:
         self.pop(digest, None)

@@ -1,0 +1,170 @@
+"""Run a real-time N-node pool in one process and measure write throughput.
+
+This is the framework's equivalent of standing up the reference's 4-node local
+pool under NYM load and reading the Monitor (BASELINE.md's prescription for
+producing the north-star numbers). Nodes are real Node instances over
+SimNetwork with microsecond latencies; time is REAL (QueueTimer over
+perf_counter), so the printed TPS/latency are wall-clock measurements of the
+full pipeline: client authN -> propagate quorum -> 3PC (with BLS signing and
+order-time aggregate verification) -> execute -> REPLY.
+
+Usage:  python -m plenum_tpu.tools.local_pool --nodes 4 --txns 200 \
+            --backend cpu|jax [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+
+def build_pool(n_nodes: int, backend: str, seed: int = 1):
+    from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID,
+                                                 POOL_LEDGER_ID, Reply)
+    from plenum_tpu.common.timer import QueueTimer
+    from plenum_tpu.config import Config
+    from plenum_tpu.crypto.bls import BlsCryptoSigner
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution import txn as txn_lib
+    from plenum_tpu.execution.txn import NODE, NYM, TRUSTEE
+    from plenum_tpu.network import SimNetwork, SimRandom
+    from plenum_tpu.node import Node, NodeBootstrap
+
+    names = [f"Node{i + 1}" for i in range(n_nodes)]
+    trustee = Ed25519Signer(seed=b"local-pool-trustee".ljust(32, b"\0"))
+    pool_txns = []
+    for i, name in enumerate(names):
+        bls_pk = BlsCryptoSigner(seed=name.encode().ljust(32, b"\0")[:32]).pk
+        txn = txn_lib.new_txn(NODE, {
+            "dest": f"{name}Dest",
+            "data": {"alias": name, "services": ["VALIDATOR"],
+                     "blskey": bls_pk}})
+        txn_lib.set_seq_no(txn, i + 1)
+        pool_txns.append(txn)
+    nym = txn_lib.new_txn(NYM, {"dest": trustee.identifier,
+                                "verkey": trustee.verkey_b58,
+                                "role": TRUSTEE})
+    txn_lib.set_seq_no(nym, 1)
+    genesis = {POOL_LEDGER_ID: pool_txns, DOMAIN_LEDGER_ID: [nym]}
+
+    timer = QueueTimer(time.perf_counter)
+    net = SimNetwork(timer, SimRandom(seed))
+    net.set_latency(0.00005, 0.0002)       # LAN-ish, not the sim default 0.5s
+    config = Config(Max3PCBatchWait=0.005, crypto_backend=backend,
+                    STATE_FRESHNESS_UPDATE_INTERVAL=600.0)
+    replies: dict[str, list] = {n: [] for n in names}
+    nodes = {}
+    for name in names:
+        bus = net.create_peer(name)
+        components = NodeBootstrap(name, genesis_txns=genesis,
+                                   crypto_backend=backend).build()
+        nodes[name] = Node(
+            name, timer, bus, components,
+            client_send=lambda msg, client, n=name: replies[n].append(
+                (time.perf_counter(), msg, client)),
+            config=config)
+    net.connect_all()
+    return names, nodes, timer, trustee, replies, Reply, DOMAIN_LEDGER_ID
+
+
+def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
+             timeout: float = 120.0) -> dict:
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import NYM
+
+    (names, nodes, timer, trustee,
+     replies, Reply, DOMAIN_LEDGER_ID) = build_pool(n_nodes, backend)
+
+    # pre-sign the whole workload so client-side signing isn't measured
+    requests = []
+    for i in range(n_txns):
+        user = Ed25519Signer(seed=(b"lpu%d" % i).ljust(32, b"\0")[:32])
+        req = Request(trustee.identifier, i + 1,
+                      {"type": NYM, "dest": user.identifier,
+                       "verkey": user.verkey_b58})
+        req.signature = trustee.sign_b58(req.signing_bytes())
+        requests.append(req)
+
+    def prod_all():
+        timer.service()
+        for node in nodes.values():
+            node.prod()
+
+    # warmup: one txn end-to-end (compiles jax kernels, fills caches)
+    warm = requests.pop()
+    submit_times = {}
+    for n in names:
+        nodes[n].handle_client_message(warm.to_dict(), "warmup")
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        prod_all()
+        if any(isinstance(m, Reply) for _, m, _ in replies[names[0]]):
+            break
+    for n in names:
+        replies[n].clear()
+
+    n_txns = len(requests)
+    t_start = time.perf_counter()
+    next_submit = 0
+    done = 0
+    first_reply: dict[str, float] = {}
+    deadline = time.perf_counter() + timeout
+    while done < n_txns and time.perf_counter() < deadline:
+        # feed in chunks so the propagate pipeline stays busy but inboxes
+        # don't balloon
+        while next_submit < n_txns and next_submit - done < 50:
+            req = requests[next_submit]
+            submit_times[req.digest] = time.perf_counter()
+            for n in names:
+                nodes[n].handle_client_message(req.to_dict(), "bench")
+            next_submit += 1
+        prod_all()
+        for ts, msg, _client in replies[names[0]]:
+            if isinstance(msg, Reply):
+                digest = msg.result.get("txn", {}).get("metadata", {}) \
+                    .get("digest")
+                if digest and digest not in first_reply:
+                    first_reply[digest] = ts
+        done = len(first_reply)
+    t_total = time.perf_counter() - t_start
+
+    latencies = sorted(first_reply[d] - submit_times[d]
+                       for d in first_reply if d in submit_times)
+    sizes = {nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size for n in names}
+    return {
+        "backend": backend,
+        "nodes": n_nodes,
+        "txns_ordered": done,
+        "txns_requested": n_txns,
+        "seconds": round(t_total, 3),
+        "tps": round(done / t_total, 1) if t_total > 0 else 0.0,
+        "p50_latency_ms": round(
+            statistics.median(latencies) * 1000, 1) if latencies else None,
+        "p99_latency_ms": round(
+            latencies[int(len(latencies) * 0.99)] * 1000, 1)
+        if latencies else None,
+        "ledger_sizes_agree": len(sizes) == 1,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--txns", type=int, default=200)
+    ap.add_argument("--backend", default="cpu", choices=["cpu", "jax"])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    stats = run_load(args.nodes, args.txns, args.backend)
+    if args.json:
+        print(json.dumps(stats))
+    else:
+        print(f"{stats['txns_ordered']}/{stats['txns_requested']} txns in "
+              f"{stats['seconds']}s -> {stats['tps']} TPS "
+              f"(p50 {stats['p50_latency_ms']} ms, "
+              f"p99 {stats['p99_latency_ms']} ms, backend={stats['backend']})")
+
+
+if __name__ == "__main__":
+    main()

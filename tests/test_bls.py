@@ -125,3 +125,70 @@ def test_duplicate_participant_multisig_rejected():
                     audit_txn_root="", bls_multi_sig=tuple(forged.to_list()))
     assert replica.validate_pre_prepare(pp, "X") == \
         BlsBftReplica.PPR_BLS_MULTISIG_WRONG
+
+
+def test_order_time_bisection_evicts_bad_signer():
+    """Deferred COMMIT verification: one aggregate pairing on the happy path;
+    on failure, bisection isolates the liar, reports it, and still produces a
+    quorum multi-sig from the honest remainder."""
+    from plenum_tpu.common.node_messages import Commit, PrePrepare
+    from plenum_tpu.common.quorums import Quorums
+    from plenum_tpu.consensus.bls_bft_replica import (BlsBftReplica,
+                                                      BlsKeyRegister)
+
+    signers = {n: BlsCryptoSigner(seed=n.encode().ljust(32, b"\0"))
+               for n in "ABCD"}
+    register = BlsKeyRegister({n: s.pk for n, s in signers.items()})
+    replica = BlsBftReplica(node_name="A", bls_signer=signers["A"],
+                            bls_verifier=BlsCryptoVerifier(),
+                            key_register=register, quorums=Quorums(4))
+    reported = []
+    replica.report_bad_signature = reported.append
+
+    pp = PrePrepare(inst_id=0, view_no=0, pp_seq_no=1, pp_time=1.0,
+                    req_idr=(), discarded=(), digest="d", ledger_id=1,
+                    state_root="aa", txn_root="cc", pool_state_root="bb")
+    value = replica._signed_value(pp).as_single_value()
+    # D signs the WRONG value (equivocating or buggy)
+    sigs = {n: signers[n].sign(value) for n in "ABC"}
+    sigs["D"] = signers["D"].sign(b"something else entirely")
+    for n, s in sigs.items():
+        replica.process_commit(
+            Commit(inst_id=0, view_no=0, pp_seq_no=1, bls_sig=s), n)
+
+    ms = replica.process_order((0, 1), pp)
+    assert ms is not None, "honest quorum should still yield a multi-sig"
+    assert set(ms.participants) == {"A", "B", "C"}
+    assert reported == ["D"]
+    assert verify_multi_sig(ms.signature, value,
+                            [signers[n].pk for n in "ABC"])
+
+
+def test_order_time_all_honest_single_check():
+    """Happy path: no bisection recursion beyond the first aggregate check."""
+    from plenum_tpu.common.node_messages import Commit, PrePrepare
+    from plenum_tpu.common.quorums import Quorums
+    from plenum_tpu.consensus.bls_bft_replica import (BlsBftReplica,
+                                                      BlsKeyRegister)
+
+    signers = {n: BlsCryptoSigner(seed=n.encode().ljust(32, b"\0"))
+               for n in "ABCD"}
+    register = BlsKeyRegister({n: s.pk for n, s in signers.items()})
+    verifier = BlsCryptoVerifier()
+    replica = BlsBftReplica(node_name="A", bls_signer=signers["A"],
+                            bls_verifier=verifier,
+                            key_register=register, quorums=Quorums(4))
+    pp = PrePrepare(inst_id=0, view_no=0, pp_seq_no=1, pp_time=1.0,
+                    req_idr=(), discarded=(), digest="d", ledger_id=1,
+                    state_root="aa", txn_root="cc", pool_state_root="bb")
+    value = replica._signed_value(pp).as_single_value()
+    calls = []
+    orig = verifier.verify_multi_sig
+    verifier.verify_multi_sig = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
+    for n in "ABCD":
+        replica.process_commit(
+            Commit(inst_id=0, view_no=0, pp_seq_no=1,
+                   bls_sig=signers[n].sign(value)), n)
+    ms = replica.process_order((0, 1), pp)
+    assert ms is not None and len(ms.participants) == 4
+    assert len(calls) == 1, f"expected ONE aggregate check, got {len(calls)}"

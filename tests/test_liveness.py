@@ -1,0 +1,211 @@
+"""Liveness tests: the pool recovers from dead, stalled, and malicious
+primaries WITHOUT any manual vote injection.
+
+Mirrors the reference's primary-disconnect / freshness / suspicion scenarios
+(plenum/server/consensus/monitoring/, ordering_service.py:1991,
+node.py:2854-2944) over SimNetwork fault injection.
+"""
+import pytest
+
+from plenum_tpu.common.internal_messages import RaisedSuspicion
+from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID, Propagate
+from plenum_tpu.common.suspicion_codes import Suspicions
+from plenum_tpu.config import Config
+from plenum_tpu.crypto.ed25519 import Ed25519Signer
+from plenum_tpu.network import Discard, match_dst, match_frm
+
+from test_pool import Pool, signed_nym
+
+FAST = dict(Max3PCBatchWait=0.05,
+            PRIMARY_HEALTH_CHECK_FREQ=0.5,
+            ORDERING_PROGRESS_TIMEOUT=2.0,
+            STATE_FRESHNESS_UPDATE_INTERVAL=3.0)
+
+
+def fast_pool(seed=13, **overrides):
+    return Pool(seed=seed, config=Config(**{**FAST, **overrides}))
+
+
+def cut_off(pool, name):
+    return [pool.net.add_rule(Discard(), match_dst(name)),
+            pool.net.add_rule(Discard(), match_frm(name))]
+
+
+def healthy(pool, victim):
+    return [n for n in pool.names if n != victim]
+
+
+def test_dead_primary_triggers_view_change():
+    """Cut off the view-0 primary with client traffic pending: the ordering-
+    progress watchdog votes, f+1 InstanceChanges start a view change, and the
+    pool orders under the new primary — no manual vote injection."""
+    pool = fast_pool(seed=13)
+    primary = pool.nodes["Alpha"].master_replica.data.primary_name
+    assert primary == "Alpha"
+    cut_off(pool, primary)
+
+    user = Ed25519Signer(seed=b"dead-primary-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, req_id=1),
+                to=healthy(pool, primary))
+    pool.run(20.0)
+
+    for n in healthy(pool, primary):
+        node = pool.nodes[n]
+        assert node.master_replica.view_no >= 1, \
+            f"{n} never left view 0"
+        assert node.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2, \
+            f"{n} did not order the pending request after the view change"
+    roots = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+             for n in healthy(pool, primary)}
+    assert len(roots) == 1
+
+
+def test_quiescent_dead_primary_detected_via_freshness():
+    """No client traffic at all: freshness silence alone must out the dead
+    primary (ref STATE_SIGS_ARE_NOT_UPDATED / freshness batches)."""
+    pool = fast_pool(seed=17)
+    cut_off(pool, "Alpha")
+    pool.run(15.0)
+    for n in healthy(pool, "Alpha"):
+        assert pool.nodes[n].master_replica.view_no >= 1, \
+            f"{n} never detected the silent dead primary"
+
+    # and the pool still works
+    user = Ed25519Signer(seed=b"quiescent-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, req_id=1),
+                to=healthy(pool, "Alpha"))
+    pool.run(8.0)
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in healthy(pool, "Alpha")}
+    assert sizes == {2}
+
+
+def test_malicious_primary_wrong_state_root():
+    """The primary lies about the state root: validators' re-apply catches it
+    (PPR_STATE_WRONG), the suspicion becomes a view-change vote, and the pool
+    re-orders the batch honestly under the next primary."""
+    pool = fast_pool(seed=19)
+    alpha = pool.nodes["Alpha"]
+    orig_apply = alpha.master_replica.ordering._apply
+
+    def corrupt(ledger_id, reqs, pp_time, view_no, pp_seq_no):
+        applied = orig_apply(ledger_id, reqs, pp_time, view_no, pp_seq_no)
+        return applied._replace(state_root="00" * 32)
+
+    alpha.master_replica.ordering._apply = corrupt
+
+    user = Ed25519Signer(seed=b"malicious-primary".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, req_id=1))
+    pool.run(20.0)
+
+    suspicions = [e for n in pool.names for e in pool.nodes[n].spylog
+                  if e[0] == "suspicion"
+                  and e[1][0] == Suspicions.PPR_STATE_WRONG.code]
+    assert suspicions, "no validator caught the wrong state root"
+    for n in pool.names:
+        node = pool.nodes[n]
+        assert node.master_replica.view_no >= 1, f"{n} never left view 0"
+        assert node.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2, \
+            f"{n} did not order the request after the view change"
+    roots = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+             for n in pool.names}
+    assert len(roots) == 1, "pool diverged after malicious primary"
+
+
+def test_freshness_batches_keep_signatures_fresh():
+    """An idle pool still orders empty freshness batches on state-bearing
+    ledgers so BLS state signatures stay fresh (ref :1991)."""
+    pool = fast_pool(seed=23)
+    pool.run(10.0)
+    for n in pool.names:
+        node = pool.nodes[n]
+        assert node.master_replica.last_ordered_3pc[1] >= 2, \
+            f"{n} ordered no freshness batches while idle"
+        # freshness batches are empty: no ledger growth
+        assert node.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 1
+    # the pool is still perfectly writable afterwards
+    user = Ed25519Signer(seed=b"fresh-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, req_id=1))
+    pool.run(6.0)
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.names}
+    assert sizes == {2}
+
+
+def test_suspicion_routing_blacklist_and_primary_fault():
+    """Unit probe of the suspicion router: peer misbehavior blacklists (and
+    ingress drops the peer's traffic); primary-authored faults become votes."""
+    pool = fast_pool(seed=29)
+    beta = pool.nodes["Beta"]
+
+    # unambiguous peer misbehavior -> blacklist + ingress drop
+    beta._on_suspicion(RaisedSuspicion(
+        inst_id=0, code=Suspicions.PPR_FRM_NON_PRIMARY.code,
+        reason="pre-prepare from non-primary", sender="Gamma"))
+    assert beta.blacklister.is_blacklisted("Gamma")
+    before = len(beta._propagate_inbox)
+    beta.node_bus.process_incoming(
+        Propagate(request={"x": 1}, sender_client=None), "Gamma")
+    assert len(beta._propagate_inbox) == before, \
+        "blacklisted peer's traffic reached the node"
+
+    # primary-authored fault -> view-change vote recorded, no blacklist
+    primary = beta.master_replica.data.primary_name
+    beta._on_suspicion(RaisedSuspicion(
+        inst_id=0, code=Suspicions.PPR_STATE_WRONG.code,
+        reason="root mismatch", sender=primary))
+    assert not beta.blacklister.is_blacklisted(primary)
+    votes = beta.master_replica.vc_trigger._votes
+    assert any("Beta" in voters for voters in votes.values()), \
+        f"no vote recorded: {votes}"
+
+
+def test_degraded_master_voted_out_by_monitor():
+    """The RBFT monitor compares master vs backup instance throughput: stall
+    the master instance's 3PC traffic while backups keep ordering, and the
+    DELTA ratio check must vote the master out (ref monitor.py:425-492)."""
+    from plenum_tpu.common.node_messages import Commit, PrePrepare, Prepare
+    # The watchdog timeout is long enough that the MONITOR fires first (its
+    # EMA warms up in ~5s) but still live: after the view change the new
+    # primary's first batch may be lost to the still-active stall rule, and
+    # recovering THAT is the ordering-progress watchdog's job.
+    pool = fast_pool(seed=37,
+                     ORDERING_PROGRESS_TIMEOUT=8.0,
+                     STATE_FRESHNESS_UPDATE_INTERVAL=600.0,
+                     PerfCheckFreq=1.0,
+                     throughput_first_ts_window=2.0)
+    rule = pool.net.add_rule(
+        Discard(),
+        lambda m, f, d: isinstance(m, (PrePrepare, Prepare, Commit))
+        and getattr(m, "inst_id", None) == 0)
+
+    for i in range(12):
+        user = Ed25519Signer(seed=f"deg{i}".encode().ljust(32, b"\0"))
+        pool.submit(signed_nym(pool.trustee, user, req_id=i + 1))
+        pool.run(0.5)
+    pool.run(8.0)
+
+    degraded = [n for n in pool.names
+                if any(e[0] == "master_degraded" for e in pool.nodes[n].spylog)]
+    assert degraded, "no node's monitor flagged the degraded master"
+    for n in pool.names:
+        assert pool.nodes[n].master_replica.view_no >= 1, \
+            f"{n}: degraded master never voted out"
+
+    # with the stall lifted the pool orders the backlog (possibly one more
+    # watchdog-driven view change later, if the new primary's first batch
+    # was sent while the stall rule still held)
+    pool.net.remove_rule(rule)
+    pool.run(25.0)
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.names}
+    assert sizes == {13}, sizes
+
+
+def test_own_node_never_blacklisted():
+    pool = fast_pool(seed=31)
+    beta = pool.nodes["Beta"]
+    beta._on_suspicion(RaisedSuspicion(
+        inst_id=0, code=Suspicions.PPR_FRM_NON_PRIMARY.code,
+        reason="", sender="Beta"))
+    assert not beta.blacklister.is_blacklisted("Beta")

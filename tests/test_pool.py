@@ -56,7 +56,9 @@ class Pool:
         self.nodes: dict[str, Node] = {}
         for name in self.names:
             bus = self.net.create_peer(name)
-            components = NodeBootstrap(name, genesis_txns=genesis).build()
+            components = NodeBootstrap(
+                name, genesis_txns=genesis,
+                crypto_backend=self.config.crypto_backend).build()
             self.nodes[name] = Node(
                 name, self.timer, bus, components,
                 client_send=lambda msg, client, n=name:
