@@ -16,12 +16,14 @@ import time
 
 
 def make_items(n: int):
-    """n deterministic (msg, sig64, verkey32) triples, distinct keys."""
+    """n deterministic (msg, sig64, verkey32) triples, one distinct key each
+    (the verifier's per-verkey point cache is filled by the warmup pass, so
+    the timed iterations measure the warm-cache device hot path)."""
     try:
         from plenum_tpu.crypto.ed25519 import Ed25519Signer
         items = []
         for i in range(n):
-            signer = Ed25519Signer(hashlib.sha256(b"bench%d" % (i % 64)).digest())
+            signer = Ed25519Signer(hashlib.sha256(b"bench%d" % i).digest())
             msg = b"bench message %d" % i
             items.append((msg, signer.sign(msg), signer.verkey))
         return items
@@ -30,7 +32,7 @@ def make_items(n: int):
         from plenum_tpu.ops.ed25519 import pure_python_sign
         items = []
         for i in range(n):
-            seed = hashlib.sha256(b"bench%d" % (i % 16)).digest()
+            seed = hashlib.sha256(b"bench%d" % i).digest()
             msg = b"bench message %d" % i
             sig, vk = pure_python_sign(seed, msg)
             items.append((msg, sig, vk))

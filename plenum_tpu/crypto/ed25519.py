@@ -122,17 +122,20 @@ class JaxEd25519Verifier(Ed25519Verifier):
     """Batched device verification.
 
     Host prep per item: split sig into (R, S); decompress A once per verkey
-    (cached as ready-to-ship -A limb rows); reject non-canonical S or invalid
-    A; h = SHA512(R||A||M) mod L. R is NOT decompressed — the kernel
-    recomputes R' and compares its compressed form against the raw signature
-    bytes (ref10 semantics), so the only per-item bigint work left on host is
-    one sha512 and one mod-L reduction.
+    (cached as ready-to-ship limb rows for -A AND [2^128](-A), the split
+    point of the windowed ladder); reject non-canonical S or invalid A;
+    h = SHA512(R||A||M) mod L. R is NOT decompressed — the kernel recomputes
+    R' and compares its compressed form against the raw signature bytes
+    (ref10 semantics), so the only per-item bigint work left on host is one
+    sha512 and one mod-L reduction (plus, once per NEW verkey, 128 extended
+    doublings for the cached split point).
     Device: one verify_kernel dispatch over the padded batch.
     """
 
     def __init__(self, min_batch: int = 1, cache_size: int = 65536):
         # verkeys are attacker-supplied; the cache must be bounded (FIFO evict)
-        # value: (ax, ay, at) int64[10] rows for -A, or None for invalid keys
+        # value: ((a0x, a0y, a0t), (a1x, a1y, a1t)) int64[10] rows for -A and
+        # [2^128](-A), or None for invalid keys
         self._pt_cache: dict[bytes, Optional[tuple]] = {}
         self._cache_size = cache_size
         self._min_batch = min_batch
@@ -144,9 +147,15 @@ class JaxEd25519Verifier(Ed25519Verifier):
         if a is None:
             rows = None
         else:
-            x, y = (_ops.P - a[0]) % _ops.P, a[1]          # -A = (-x, y)
-            rows = (_ops.int_to_limbs(x), _ops.int_to_limbs(y),
-                    _ops.int_to_limbs(x * y % _ops.P))
+            neg = ((_ops.P - a[0]) % _ops.P, a[1])         # -A = (-x, y)
+            neg2 = _ops.mul_pow2_affine(neg, _ops.HALF_SHIFT)
+
+            def _rows(pt):
+                x, y = pt
+                return (_ops.int_to_limbs(x), _ops.int_to_limbs(y),
+                        _ops.int_to_limbs(x * y % _ops.P))
+
+            rows = (_rows(neg), _rows(neg2))
         if len(self._pt_cache) >= self._cache_size:
             self._pt_cache.pop(next(iter(self._pt_cache)))
         self._pt_cache[vk] = rows
@@ -157,8 +166,8 @@ class JaxEd25519Verifier(Ed25519Verifier):
         rows = self._neg_a_limbs(vk)
         if rows is None:
             return None
-        return ((_ops.P - _ops.limbs_to_int(rows[0])) % _ops.P,
-                _ops.limbs_to_int(rows[1]))
+        return ((_ops.P - _ops.limbs_to_int(rows[0][0])) % _ops.P,
+                _ops.limbs_to_int(rows[0][1]))
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
         import jax.numpy as jnp
@@ -195,18 +204,23 @@ class JaxEd25519Verifier(Ed25519Verifier):
             m_pad *= 2
         pad = m_pad - m
         # padding repeats the first row; its verdict is discarded
-        s_bits = _ops.scalar_bits(s_vals + [s_vals[0]] * pad)
-        h_bits = _ops.scalar_bits(h_vals + [h_vals[0]] * pad)
+        s_vals += [s_vals[0]] * pad
+        h_vals += [h_vals[0]] * pad
         a_rows += [a_rows[0]] * pad
         r_enc += [r_enc[0]] * pad
-        ax = np.stack([r[0] for r in a_rows])
-        ay = np.stack([r[1] for r in a_rows])
-        at = np.stack([r[2] for r in a_rows])
-        az = np.tile(_ops.int_to_limbs(1), (m_pad, 1))
+        half_mask = (1 << _ops.HALF_SHIFT) - 1
+        s_digits = _ops.scalar_windows(s_vals, _ops.N_COMB)
+        h0_digits = _ops.scalar_windows(
+            [h & half_mask for h in h_vals], _ops.N_WIN)
+        h1_digits = _ops.scalar_windows(
+            [h >> _ops.HALF_SHIFT for h in h_vals], _ops.N_WIN)
+        a0 = [np.stack([r[0][c] for r in a_rows]) for c in range(3)]
+        a1 = [np.stack([r[1][c] for r in a_rows]) for c in range(3)]
         ry, r_sign = _ops.r_bytes_to_limbs(r_enc)
         ok = np.asarray(_ops.verify_kernel(
-            jnp.asarray(s_bits), jnp.asarray(h_bits),
-            jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(az), jnp.asarray(at),
+            jnp.asarray(s_digits), jnp.asarray(h0_digits),
+            jnp.asarray(h1_digits),
+            *(jnp.asarray(a) for a in a0), *(jnp.asarray(a) for a in a1),
             jnp.asarray(ry), jnp.asarray(r_sign)))
         for j, i in enumerate(idxs):
             verdict[i] = bool(ok[j])

@@ -7,19 +7,34 @@ verification — the double-scalar multiplication [S]B + [h](-A) and the compare
 against R — runs for a whole batch of signatures in ONE device dispatch.
 
 Split of labor (see plenum_tpu/crypto/ed25519.py for the host side):
-  host:   decode/decompress points (pure-Python bigint sqrt, cached per verkey),
+  host:   decode/decompress points (pure-Python bigint sqrt, cached per
+          verkey, together with [2^128](-A) for the split window ladder),
           h = SHA512(R||A||M) mod L (hashlib, C speed),
-          scalars -> little-endian bit arrays
-  device: Shamir double-scalar mult over GF(2^255-19) with 10x26-bit limbs in
-          int64 lanes; 254 fori_loop iterations of (double; table-select; add);
-          affine comparison against R
+          scalars -> 4-bit window digit arrays
+  device: windowed multi-scalar mult over GF(2^255-19) with 10x26-bit limbs
+          in int64 lanes; affine comparison against R
+
+Kernel shape (v2 — windowed; the v1 shape was a 254-round 1-bit Shamir
+ladder, ~2.5x more serial field multiplies):
+  [S]B      via a 4-bit fixed-base comb: 64 precomputed constant tables
+            T[w][d] = d*16^w*B in affine "niels" form (y+x, y-x, 2d*x*y) —
+            contributes 64 mixed additions and ZERO doublings.
+  [h](-A)   split h = h0 + 2^128*h1 with A2 = [2^128](-A) cached per verkey
+            on host; two 16-entry tables are built on device (one batched
+            build for both halves), then 32 iterations of
+            (4 doublings; 2 table additions; 2 comb additions).
+  compare   one Fermat inversion (straight-line 254-squaring addition chain,
+            pow2k blocks as fori_loops) -> affine (x, y) -> byte compare
+            against the raw signature R.
 
 Design notes (TPU-first):
-- Field elements are [..., 10] int64 arrays, radix 2^26, lazily carried.
-  Products stay < 2^63: limbs enter mul below 2^28.5, the 19x fold multiplier
-  for the 2^260 overflow is 608 = 19*2^5 applied to 26-bit splits.
-- No data-dependent control flow: bit-driven point selection is an arithmetic
-  blend (multiply by 0/1 masks), constant trip counts, static shapes.
+- Field elements are [..., 10] int64 arrays, radix 2^26, LAZILY carried:
+  add/sub do not carry at all (sub adds a 40p margin to stay non-negative);
+  only f_mul carries its output. Products stay < 2^63: limbs enter mul below
+  2^28.5, the 19x fold multiplier for the 2^260 overflow is 608 = 19*2^5
+  applied to 26-bit splits.
+- No data-dependent control flow: digit-driven point selection is a one-hot
+  contraction (einsum with a 0/1 mask), constant trip counts, static shapes.
 - The whole batch advances in lockstep; the batch axis maps onto VPU lanes and
   shards cleanly across a device mesh (see plenum_tpu/parallel/).
 """
@@ -52,7 +67,11 @@ NLIMB = 10
 RADIX = 26
 MASK = (1 << RADIX) - 1
 FOLD = 19 * 32          # 2^260 = 2^5 * 2^255 ≡ 19 * 32 (mod p)
-NBITS = 254             # scalars are < L < 2^253; one spare bit
+
+WBITS = 4               # window/comb digit width
+N_COMB = 64             # comb positions for the 256-bit S
+N_WIN = 32              # windows per 128-bit half of h
+HALF_SHIFT = 128        # h = h0 + 2^HALF_SHIFT * h1
 
 
 def int_to_limbs(x: int) -> np.ndarray:
@@ -84,23 +103,43 @@ def _margin_limbs() -> np.ndarray:
 _K_SUB = _margin_limbs()
 
 
-# --- field ops (all return carried form: limbs < 2^26 + eps) --------------
+# --- field ops ------------------------------------------------------------
+#
+# Bound discipline: "carried" means limbs < 2^26 + 1 (the output of _carry);
+# add_nc/sub_nc outputs are < 2^28.3 limbwise when their inputs obey the
+# rules in the point formulas below, which keeps every f_mul product sum
+# under 2^60 — far inside int64.
 
 def _carry(c):
-    """Two carry passes with the 2^260 -> FOLD wraparound."""
-    for _ in range(2):
-        out = []
-        carry = 0
-        for i in range(NLIMB):
-            v = c[..., i] + carry
-            carry = v >> RADIX
-            out.append(v & MASK)
-        c = jnp.stack(out, axis=-1)
-        c = c.at[..., 0].add(carry * FOLD)
-    # final top carry is tiny; one more cheap pass on limb 0->1
-    v = c[..., 0]
-    c = c.at[..., 0].set(v & MASK).at[..., 1].add(v >> RADIX)
+    """Three vectorized carry passes with the 2^260 -> FOLD wraparound.
+
+    Each pass is whole-limb-axis arithmetic (mask/shift/roll) — no per-limb
+    Python loop, so a pass is ~6 XLA ops instead of ~30 and the serial
+    dependency depth is 3, not 20. Pass math: c = (c & MASK) + shift(c >> 26)
+    with the top limb's carry folding to limb 0 via FOLD. Handles transiently
+    negative limbs (arithmetic >> floors, so value is preserved exactly).
+
+    Bounds: |input limbs| < 2^60 -> pass1 < 2^43.4 -> pass2 < 2^27.4 ->
+    pass3 in [-2, 2^26 + 2] ("carried" form; the stray +-2 is absorbed by
+    the 40p margin in sub_nc and by f_canon's margin pre-add).
+    """
+    for _ in range(3):
+        lo = c & MASK
+        hi = c >> RADIX
+        c = lo + jnp.concatenate(
+            [hi[..., NLIMB - 1:] * FOLD, hi[..., :NLIMB - 1]], axis=-1)
     return c
+
+
+def add_nc(f, g):
+    """Lazy addition: no carry. Inputs must keep the sum below 2^28.3."""
+    return f + g
+
+
+def sub_nc(f, g):
+    """Lazy subtraction: f - g + 40p, no carry. g must be CARRIED (the 40p
+    margin limbs floor at 2^26, which dominates carried limbs only)."""
+    return f - g + jnp.asarray(_K_SUB)
 
 
 def f_add(f, g):
@@ -113,7 +152,8 @@ def f_sub(f, g):
 
 def f_mul(f, g):
     # schoolbook convolution: 19 coefficients
-    c = [jnp.zeros(f.shape[:-1], jnp.int64) for _ in range(2 * NLIMB - 1)]
+    c = [jnp.zeros(jnp.broadcast_shapes(f.shape[:-1], g.shape[:-1]), jnp.int64)
+         for _ in range(2 * NLIMB - 1)]
     for i in range(NLIMB):
         fi = f[..., i]
         for j in range(NLIMB):
@@ -128,32 +168,49 @@ def f_mul(f, g):
     return _carry(jnp.stack(c[:NLIMB], axis=-1))
 
 
-# p-2 bits MSB-first; the exponent is fixed so the bit table is a constant
-_P2_BITS = np.array([(P - 2) >> i & 1 for i in range(254, -1, -1)],
-                    dtype=np.int64)
+def _pow2k(z, k: int):
+    """z^(2^k) as a k-iteration squaring loop."""
+    return jax.lax.fori_loop(0, k, lambda i, v: f_mul(v, v), z)
 
 
 def f_inv(z):
-    """z^(p-2) (Fermat inversion) as ONE square-and-multiply fori_loop.
+    """z^(p-2) (Fermat inversion) via the standard curve25519 addition chain:
+    254 squarings (grouped into pow2k fori_loops so the compiled graph stays
+    small) + 11 multiplies — half the multiplies of a square-and-multiply
+    ladder.
 
     Needed to compress the recomputed R' on device (affine y = Y/Z), which is
     what lets verification compare raw signature bytes instead of paying a
     pure-Python modular sqrt per signature on host to decompress R.
-
-    Deliberately a single 254-iteration loop with an arithmetic blend rather
-    than the classic unrolled addition chain: the chain's ~265 inline f_mul
-    calls made XLA:TPU compilation take minutes, while this shape (same as the
-    main double-scalar loop) compiles fast and costs only ~25% more multiplies.
     """
-    bits = jnp.asarray(_P2_BITS)
+    z2 = f_mul(z, z)                                  # 2
+    z9 = f_mul(_pow2k(z2, 2), z)                      # 9
+    z11 = f_mul(z9, z2)                               # 11
+    z_5 = f_mul(f_mul(z11, z11), z9)                  # 2^5 - 1
+    z_10 = f_mul(_pow2k(z_5, 5), z_5)                 # 2^10 - 1
+    z_20 = f_mul(_pow2k(z_10, 10), z_10)              # 2^20 - 1
+    z_40 = f_mul(_pow2k(z_20, 20), z_20)              # 2^40 - 1
+    z_50 = f_mul(_pow2k(z_40, 10), z_10)              # 2^50 - 1
+    z_100 = f_mul(_pow2k(z_50, 50), z_50)             # 2^100 - 1
+    z_200 = f_mul(_pow2k(z_100, 100), z_100)          # 2^200 - 1
+    z_250 = f_mul(_pow2k(z_200, 50), z_50)            # 2^250 - 1
+    return f_mul(_pow2k(z_250, 5), z11)               # 2^255 - 21 = p - 2
 
-    def body(i, acc):
-        sq = f_mul(acc, acc)
-        mul = f_mul(sq, z)
-        b = bits[i]
-        return b * mul + (1 - b) * sq
 
-    return jax.lax.fori_loop(1, 255, body, z)   # MSB handled by acc=z
+def _carry_strict(c):
+    """Fully normalized limbs in [0, 2^26) via _carry + two sequential
+    signed borrow passes (arithmetic >> floors, so borrows propagate).
+    Only used on the cold path (f_canon) — the sequential pass is 10 deep."""
+    c = _carry(c)
+    for _ in range(2):
+        out = []
+        carry = 0
+        for i in range(NLIMB):
+            v = c[..., i] + carry
+            carry = v >> RADIX
+            out.append(v & MASK)
+        c = jnp.stack(out, axis=-1).at[..., 0].add(carry * FOLD)
+    return c
 
 
 def f_canon(f):
@@ -162,13 +219,15 @@ def f_canon(f):
     Carried limb form encodes values up to 2^260 ≈ 32p, so conditional
     subtraction alone is NOT enough: first fold the bits at and above 2^255
     (limb 9 bits >= 21) down with weight 19, bringing the value below
-    2^255 + 19*32 < 2p; then subtract p up to two times.
+    2^255 + 19*32 < 2p; then subtract p up to two times. The 40p margin
+    added up front restores limbwise positivity (carried limbs can dip to
+    -2) and is folded away with the other >= 2^255 content.
     """
-    f = _carry(f)
+    f = _carry_strict(f + jnp.asarray(_K_SUB))
     top = f[..., 9] >> jnp.int64(255 - 9 * RADIX)
     f = f.at[..., 9].set(f[..., 9] & jnp.int64((1 << (255 - 9 * RADIX)) - 1))
     f = f.at[..., 0].add(top * 19)
-    f = _carry(f)
+    f = _carry_strict(f)
     p_limbs = jnp.asarray(int_to_limbs(P))
     for _ in range(2):
         # compare f >= p lexicographically from the top limb
@@ -178,26 +237,48 @@ def f_canon(f):
             gt = gt | (ge & (f[..., i] > p_limbs[i]))
             ge = ge & (f[..., i] >= p_limbs[i])
         take = (gt | ge)
-        f = _carry(f - jnp.where(take[..., None], p_limbs, 0))
+        f = _carry_strict(f - jnp.where(take[..., None], p_limbs, 0))
     return f
 
 
 # --- point ops: extended twisted Edwards (X:Y:Z:T), a = -1 ----------------
 # Identity is (0, 1, 1, 0).
+#
+# All formulas below take CARRIED coordinates (every coordinate a caller can
+# pass is an f_mul output or a canonical host constant) and produce CARRIED
+# coordinates; the lazy add_nc/sub_nc intermediates never feed another
+# add/sub, only f_mul.
 
 def pt_add(p1, p2):
     """Unified addition (add-2008-hwcd-3): complete, handles identity & P+P."""
     x1, y1, z1, t1 = p1
     x2, y2, z2, t2 = p2
-    a = f_mul(f_sub(y1, x1), f_sub(y2, x2))
-    b = f_mul(f_add(y1, x1), f_add(y2, x2))
+    a = f_mul(sub_nc(y1, x1), sub_nc(y2, x2))
+    b = f_mul(add_nc(y1, x1), add_nc(y2, x2))
     c = f_mul(f_mul(t1, t2), jnp.asarray(int_to_limbs(D2)))
     zz = f_mul(z1, z2)
-    d = f_add(zz, zz)
-    e = f_sub(b, a)
-    f_ = f_sub(d, c)
-    g = f_add(d, c)
-    h = f_add(b, a)
+    d = add_nc(zz, zz)
+    e = sub_nc(b, a)
+    f_ = sub_nc(d, c)
+    g = add_nc(d, c)
+    h = add_nc(b, a)
+    return (f_mul(e, f_), f_mul(g, h), f_mul(f_, g), f_mul(e, h))
+
+
+def pt_add_t2d(p1, q):
+    """Addition where the second operand carries a precomputed 2d*T
+    coordinate: q = (X2, Y2, Z2, T2D2) — saves the d2 multiply (8M)."""
+    x1, y1, z1, t1 = p1
+    x2, y2, z2, t2d2 = q
+    a = f_mul(sub_nc(y1, x1), sub_nc(y2, x2))
+    b = f_mul(add_nc(y1, x1), add_nc(y2, x2))
+    c = f_mul(t1, t2d2)
+    zz = f_mul(z1, z2)
+    d = add_nc(zz, zz)
+    e = sub_nc(b, a)
+    f_ = sub_nc(d, c)
+    g = add_nc(d, c)
+    h = add_nc(b, a)
     return (f_mul(e, f_), f_mul(g, h), f_mul(f_, g), f_mul(e, h))
 
 
@@ -207,61 +288,235 @@ def pt_double(p1):
     a = f_mul(x1, x1)
     b = f_mul(y1, y1)
     zz = f_mul(z1, z1)
-    c = f_add(zz, zz)
-    h = f_add(a, b)
-    xy = f_add(x1, y1)
-    e = f_sub(h, f_mul(xy, xy))
-    g = f_sub(a, b)
-    f_ = f_add(c, g)
+    c = add_nc(zz, zz)
+    h = add_nc(a, b)
+    xy = add_nc(x1, y1)
+    e = sub_nc(h, f_mul(xy, xy))
+    g = sub_nc(a, b)
+    f_ = add_nc(c, g)
     return (f_mul(e, f_), f_mul(g, h), f_mul(f_, g), f_mul(e, h))
 
 
-def _blend(bit, p_true, p_false):
-    """Per-lane select between two points; bit is int64[...] of 0/1."""
-    m = bit[..., None]
-    return tuple(m * t + (1 - m) * f for t, f in zip(p_true, p_false))
+# --- fixed-base comb table (host-built, Python ints, one batch inversion) --
+
+def _ext_add_int(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = D2 * t1 * t2 % P
+    dd = 2 * z1 * z2 % P
+    e, f, g, h = b - a, dd - c, dd + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _ext_dbl_int(p):
+    x1, y1, z1, _ = p
+    a = x1 * x1 % P
+    b = y1 * y1 % P
+    c = 2 * z1 * z1 % P
+    h = a + b
+    e = h - (x1 + y1) * (x1 + y1)
+    g = a - b
+    f = c + g
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+_B_COMB: tuple | None = None     # (x, y, t2d) each np.int64[2, 16, NLIMB]
+
+
+def b_comb_table() -> tuple:
+    """Two 16-entry window tables for the fixed base:
+    T[0][d] = d*B and T[1][d] = d*[2^128]B, as affine (x, y, 2d*x*y) rows
+    (Z = 1 implied; entry 0 is the identity (0, 1, 0)).
+
+    S is split like h: S = s_lo + 2^128*s_hi. At main-loop iteration i
+    (processing window t = N_WIN-1-i) an added point gets scaled by the
+    remaining doublings, i.e. by 16^t — so adding T[0][digit_t(s_lo)] and
+    T[1][digit_t(s_hi)] contributes digit*16^t*B resp. digit*16^t*2^128*B,
+    exactly the windowed decomposition of [S]B, with zero extra doublings.
+    """
+    global _B_COMB
+    if _B_COMB is not None:
+        return _B_COMB
+    bases = [(BX, BY, 1, BX * BY % P)]
+    b2 = bases[0]
+    for _ in range(HALF_SHIFT):
+        b2 = _ext_dbl_int(b2)
+    bases.append(b2)
+    ext: list[list[tuple]] = []
+    for base in bases:
+        row = [base]
+        for _ in range(2, 16):
+            row.append(_ext_add_int(row[-1], base))
+        ext.append(row)
+    # batch-invert all Z's (Montgomery's trick: one modular inversion total)
+    zs = [p[2] for row in ext for p in row]
+    prefix = [1]
+    for z in zs:
+        prefix.append(prefix[-1] * z % P)
+    inv_all = pow(prefix[-1], P - 2, P)
+    zinv = [0] * len(zs)
+    for i in range(len(zs) - 1, -1, -1):
+        zinv[i] = prefix[i] * inv_all % P
+        inv_all = inv_all * zs[i] % P
+    tx = np.zeros((2, 16, NLIMB), np.int64)
+    ty = np.zeros((2, 16, NLIMB), np.int64)
+    t2d = np.zeros((2, 16, NLIMB), np.int64)
+    for w in range(2):
+        ty[w, 0] = int_to_limbs(1)             # digit 0: identity (0, 1, 0)
+        for d in range(1, 16):
+            x, y, _, _ = ext[w][d - 1]
+            zi = zinv[w * 15 + d - 1]
+            xa, ya = x * zi % P, y * zi % P
+            tx[w, d] = int_to_limbs(xa)
+            ty[w, d] = int_to_limbs(ya)
+            t2d[w, d] = int_to_limbs(D2 * xa * ya % P)
+    _B_COMB = (tx, ty, t2d)
+    return _B_COMB
+
+
+def mul_pow2_affine(pt: tuple[int, int], k: int) -> tuple[int, int]:
+    """[2^k] * pt for an affine host point — extended-coordinate doublings
+    (no per-step inversion) + one final inversion. Used to cache
+    A2 = [2^128](-A) per verkey."""
+    x, y = pt
+    p = (x, y, 1, x * y % P)
+    for _ in range(k):
+        p = _ext_dbl_int(p)
+    zi = pow(p[2], P - 2, P)
+    return (p[0] * zi % P, p[1] * zi % P)
+
+
+# --- the kernel -----------------------------------------------------------
+
+def _onehot(digits):
+    """int64[..., T] digit array -> int64[..., T, 16] one-hot mask."""
+    return (digits[..., None] == jnp.arange(16, dtype=digits.dtype)
+            ).astype(jnp.int64)
+
+
+def _build_a_tables(qx, qy, qt, n_half: int):
+    """16-entry window tables for BOTH halves in one batched build.
+
+    q* are [2*n_half, NLIMB]: rows [:n_half] = -A, rows [n_half:] = [2^128](-A)
+    (affine, Z = 1, T = X*Y). Returns 4 arrays [16, 2*n_half, NLIMB]
+    (x, y, z, t2d) — entry d = [d]q, entry 0 = identity.
+
+    Built as a 7-step fori_loop (tab[2k] = dbl(tab[k]);
+    tab[2k+1] = tab[2k] + q) so the compiled graph stays small.
+    """
+    m = qx.shape[0]
+    ones = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), (m, NLIMB))
+    zeros = jnp.zeros((m, NLIMB), jnp.int64)
+    tx = jnp.zeros((16, m, NLIMB), jnp.int64).at[1].set(qx)
+    ty = jnp.zeros((16, m, NLIMB), jnp.int64).at[0].set(ones).at[1].set(qy)
+    tz = jnp.zeros((16, m, NLIMB), jnp.int64).at[0].set(ones).at[1].set(ones)
+    tt = jnp.zeros((16, m, NLIMB), jnp.int64).at[1].set(qt)
+    q = (qx, qy, ones, qt)
+
+    def body(k, tabs):
+        tx, ty, tz, tt = tabs
+        pk = tuple(t[k] for t in tabs)
+        dbl = pt_double(pk)
+        odd = pt_add(dbl, q)
+        k2 = 2 * k
+        out = []
+        for t, dv, ov in zip(tabs, dbl, odd):
+            t = jax.lax.dynamic_update_index_in_dim(t, dv, k2, axis=0)
+            t = jax.lax.dynamic_update_index_in_dim(t, ov, k2 + 1, axis=0)
+            out.append(t)
+        return tuple(out)
+
+    tx, ty, tz, tt = jax.lax.fori_loop(1, 8, body, (tx, ty, tz, tt))
+    t2d = f_mul(tt, jnp.asarray(int_to_limbs(D2)))     # one stacked multiply
+    return tx, ty, tz, t2d
 
 
 @jax.jit
-def verify_kernel(s_bits, h_bits, ax, ay, az, at, ry, r_sign):
-    """Batched check compress([S]B + [h]A') == R-bytes (A' = -A, host-prepped).
+def verify_kernel(s_digits, h0_digits, h1_digits,
+                  a0x, a0y, a0t, a1x, a1y, a1t, ry, r_sign):
+    """Batched check compress([S]B + [h0]A' + [h1]A2') == R-bytes.
 
-    This is the ref10/OpenSSL verification shape: recompute R' = [S]B - [h]A,
-    compress it, and compare against the first 32 signature bytes — so the
-    host never decompresses R (no per-signature modular sqrt; non-canonical
-    or off-curve R encodings simply fail the compare, same verdict OpenSSL
-    gives).
+    A' = -A and A2' = [2^128](-A) are host-prepped affine points (Z = 1,
+    T = X*Y); h = h0 + 2^128*h1. This is the ref10/OpenSSL verification
+    shape: recompute R' = [S]B - [h]A, compress it, and compare against the
+    first 32 signature bytes — so the host never decompresses R (no
+    per-signature modular sqrt; non-canonical or off-curve R encodings simply
+    fail the compare, same verdict OpenSSL gives).
 
-    s_bits/h_bits: int64[NBITS, N] little-endian scalar bits.
-    ax..at: int64[N, 10] extended coords of A' (Z=1 from host, so T=X*Y).
-    ry: int64[N, 10] limbs of the low 255 bits of the R encoding.
-    r_sign: int64[N] top bit of the R encoding (x parity).
+    s_digits:  int64[N_COMB, N] little-endian 4-bit comb digits of S.
+    h0/h1_digits: int64[N_WIN, N] little-endian 4-bit windows of the halves.
+    a0*/a1*:   int64[N, 10] affine limbs of A' resp. A2'.
+    ry:        int64[N, 10] limbs of the low 255 bits of the R encoding.
+    r_sign:    int64[N] top bit of the R encoding (x parity).
     Returns bool[N].
     """
-    if s_bits.dtype != jnp.int64:
+    if s_digits.dtype != jnp.int64:
         raise TypeError("verify_kernel needs int64 inputs — jax x64 mode is off")
-    n = ax.shape[0]
+    n = a0x.shape[0]
     ones = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), (n, NLIMB))
     zeros = jnp.zeros((n, NLIMB), jnp.int64)
 
-    b_pt = tuple(jnp.broadcast_to(jnp.asarray(int_to_limbs(v)), (n, NLIMB))
-                 for v in (BX, BY, 1, BX * BY % P))
-    a_pt = (ax, ay, az, at)
-    ba_pt = pt_add(b_pt, a_pt)
-    o_pt = (zeros, ones, ones, zeros)
+    tx, ty, tz, t2d = _build_a_tables(
+        jnp.concatenate([a0x, a1x]), jnp.concatenate([a0y, a1y]),
+        jnp.concatenate([a0t, a1t]), n)
 
-    def body(i, acc):
-        t = NBITS - 1 - i
-        bs = jax.lax.dynamic_index_in_dim(s_bits, t, axis=0, keepdims=False)
-        bh = jax.lax.dynamic_index_in_dim(h_bits, t, axis=0, keepdims=False)
-        acc = pt_double(acc)
-        # select O / B / A' / B+A' by (bs, bh)
-        q = _blend(bs * bh, ba_pt,
-                   _blend(bs * (1 - bh), b_pt,
-                          _blend((1 - bs) * bh, a_pt, o_pt)))
-        return pt_add(acc, q)
+    # ---- operand banks: ALL table selections precomputed outside the loop
+    # (selections depend only on digits, never on the accumulator). This
+    # keeps the fori_loop body tiny — compile time on the TPU backend is
+    # dominated by loop-body HLO size, and int64 lowering multiplies it.
+    # Selection is masked multiply + reduce (NOT einsum/dot_general: the TPU
+    # X64 rewriter has no int64 dot_general lowering).
 
-    acc = jax.lax.fori_loop(0, NBITS, body, o_pt)
+    def sel_a(tab, oh):
+        """[16, N, 10] table x one-hot [W, N, 16] -> [W, N, 10]."""
+        return jnp.sum(oh[:, :, :, None] * jnp.transpose(tab, (1, 0, 2))[None],
+                       axis=2)
+
+    def sel_b(cb, oh):
+        """[16, 10] const table x one-hot [W, N, 16] -> [W, N, 10]."""
+        return jnp.sum(oh[:, :, :, None] * cb[None, None], axis=2)
+
+    oh_h0 = _onehot(h0_digits)             # [N_WIN, N, 16]
+    oh_h1 = _onehot(h1_digits)
+    oh_s0 = _onehot(s_digits[:N_WIN])      # low half of S's 64 digits
+    oh_s1 = _onehot(s_digits[N_WIN:])
+    cb_x, cb_y, cb_t2d = (jnp.asarray(t) for t in b_comb_table())
+
+    ta0 = tuple(t[:, :n] for t in (tx, ty, tz, t2d))
+    ta1 = tuple(t[:, n:] for t in (tx, ty, tz, t2d))
+    ones_w = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)),
+                              (N_WIN, n, NLIMB))
+    # per-window add operands, stacked [N_WIN, 4, N, 10] per coordinate:
+    # j=0: [h0]win of A', j=1: [h1]win of A2', j=2/3: fixed-base windows
+    # (S = s_lo + 2^128*s_hi; window t of each half aligns with the
+    # remaining-doubling scale 16^t — see b_comb_table)
+    bank = []
+    for coord, a_idx, cb in ((0, 0, cb_x), (1, 1, cb_y), (2, 2, None),
+                             (3, 3, cb_t2d)):
+        j0 = sel_a(ta0[a_idx], oh_h0)
+        j1 = sel_a(ta1[a_idx], oh_h1)
+        if cb is None:                     # B entries are affine: Z = 1
+            j2 = j3 = ones_w
+        else:
+            j2 = sel_b(cb[0], oh_s0)
+            j3 = sel_b(cb[1], oh_s1)
+        bank.append(jnp.stack([j0, j1, j2, j3], axis=1))
+    ox, oy, oz, ot = bank                  # each [N_WIN, 4, N, 10]
+
+    def win_body(i, acc):
+        t = N_WIN - 1 - i                  # MSB-first windows
+        acc = jax.lax.fori_loop(0, WBITS, lambda _, a: pt_double(a), acc)
+        qx = jax.lax.dynamic_index_in_dim(ox, t, 0, keepdims=False)
+        qy = jax.lax.dynamic_index_in_dim(oy, t, 0, keepdims=False)
+        qz = jax.lax.dynamic_index_in_dim(oz, t, 0, keepdims=False)
+        qt = jax.lax.dynamic_index_in_dim(ot, t, 0, keepdims=False)
+        return jax.lax.fori_loop(
+            0, 4, lambda j, a: pt_add_t2d(a, (qx[j], qy[j], qz[j], qt[j])),
+            acc)
+
+    acc = jax.lax.fori_loop(0, N_WIN, win_body, (zeros, ones, ones, zeros))
     px, py, pz, _ = acc
     # compress on device: affine (x, y) via one shared inversion of Z
     # (complete Edwards formulas keep Z != 0 for all valid inputs)
@@ -344,12 +599,16 @@ def decompress(comp: bytes):
     return (x, y)
 
 
-def scalar_bits(values: list[int]) -> np.ndarray:
-    """[N] ints -> int64[NBITS, N] little-endian bits."""
-    raw = b"".join(v.to_bytes(32, "little") for v in values)
-    arr = np.frombuffer(raw, dtype=np.uint8).reshape(len(values), 32)
+def scalar_windows(values: list[int], n_windows: int) -> np.ndarray:
+    """[N] ints -> int64[n_windows, N] little-endian 4-bit digits."""
+    nbytes = (n_windows * WBITS + 7) // 8
+    raw = b"".join(v.to_bytes(nbytes, "little") for v in values)
+    arr = np.frombuffer(raw, dtype=np.uint8).reshape(len(values), nbytes)
     bits = np.unpackbits(arr, axis=1, bitorder="little")
-    return bits[:, :NBITS].T.astype(np.int64)
+    weights = (1 << np.arange(WBITS, dtype=np.int64))
+    digits = bits[:, :n_windows * WBITS].reshape(
+        len(values), n_windows, WBITS).astype(np.int64) @ weights
+    return digits.T.copy()
 
 
 def r_bytes_to_limbs(r_encodings: Sequence[bytes]) -> tuple[np.ndarray, np.ndarray]:
