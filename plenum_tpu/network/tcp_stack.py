@@ -1,0 +1,500 @@
+"""Real network transport: authenticated-encrypted TCP behind the
+ExternalBus seam.
+
+Reference behavior being replaced: stp_zmq/zstack.py:52 (ROUTER/DEALER
+sockets with CurveZMQ encryption), zstack.py:322 (ZAP allowlist
+authenticator), zstack.py:520 (per-cycle receive quotas),
+stp_zmq/kit_zstack.py:28 (maintain-connections retry loop) and
+plenum/common/batched.py:20 (per-peer outbox coalescing into one wire
+frame per flush).
+
+Redesign, not a port: instead of ZMQ + CurveCP this is asyncio TCP with an
+explicit Noise-style handshake built from the primitives already in the
+image's `cryptography` package:
+
+  dialer  -> acceptor : magic || eph_A                      (32B X25519)
+  acceptor-> dialer   : eph_B || vk_B || sig_B("resp"||eph_A||eph_B)
+  dialer  -> acceptor : vk_A || sig_A("init"||eph_A||eph_B)
+
+Both sides sign the ephemeral transcript with their long-lived Ed25519 node
+key (the same key the pool ledger registers), so peer identity = ledger
+identity and the allowlist is exactly the node registry — the reference
+reuses its CurveZMQ keys the same way. Session keys are
+HKDF(X25519(eph, eph'), salt=transcript) split per direction; frames are
+length-prefixed ChaCha20-Poly1305 with a counter nonce (replay-safe: a
+counter never repeats under a session key, and sessions never resume).
+
+Wire frames carry a msgpack LIST of message dicts — the outbox batching the
+reference does in common/batched.py — so one TCP segment typically carries a
+whole prod cycle's traffic to a peer.
+
+Dialer rule: for each pair the lexicographically SMALLER name dials; the
+other side accepts. The dialer owns the retry loop (kit_zstack semantics).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable, Optional
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey, Ed25519PublicKey)
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey, X25519PublicKey)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from plenum_tpu.common.event_bus import ExternalBus
+from plenum_tpu.common.message_base import MessageBase, message_from_dict
+from plenum_tpu.common.serialization import pack, unpack
+
+logger = logging.getLogger(__name__)
+
+MAGIC = b"PTPU\x01\x00\x00\x00"
+MAX_FRAME = 8 * 1024 * 1024          # reference caps ZMQ frames similarly
+OUTBOX_CAP = 10_000                  # queued msgs per disconnected peer
+RETRY_MIN, RETRY_MAX = 0.1, 2.0      # dialer backoff (kit_zstack retries)
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class NodeRegistry:
+    """name -> (host, port, ed25519 verkey bytes); the transport allowlist.
+
+    Mutable on purpose: pool-ledger NODE txns update membership at runtime
+    (ref pool_manager reconnect semantics)."""
+
+    def __init__(self, entries: Optional[dict] = None):
+        self._entries: dict[str, tuple[str, int, bytes]] = dict(entries or {})
+
+    def set(self, name: str, host: str, port: int, verkey: bytes) -> None:
+        self._entries[name] = (host, port, bytes(verkey))
+
+    def remove(self, name: str) -> None:
+        self._entries.pop(name, None)
+
+    def get(self, name: str):
+        return self._entries.get(name)
+
+    def name_by_verkey(self, verkey: bytes) -> Optional[str]:
+        for name, (_, _, vk) in self._entries.items():
+            if vk == verkey:
+                return name
+        return None
+
+    def names(self) -> list[str]:
+        return list(self._entries)
+
+
+def _derive_keys(eph_priv: X25519PrivateKey, eph_peer_pub: bytes,
+                 transcript: bytes) -> tuple[bytes, bytes]:
+    """-> (dialer->acceptor key, acceptor->dialer key)."""
+    shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(eph_peer_pub))
+    okm = HKDF(algorithm=hashes.SHA256(), length=64, salt=transcript,
+               info=b"plenum-tpu transport v1").derive(shared)
+    return okm[:32], okm[32:]
+
+
+class _Session:
+    """One established, authenticated, encrypted peer connection."""
+
+    def __init__(self, peer: str, writer: asyncio.StreamWriter,
+                 send_key: bytes, recv_key: bytes):
+        self.peer = peer
+        self.writer = writer
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_ctr = 0
+        self._recv_ctr = 0
+
+    def encrypt_frame(self, plaintext: bytes) -> bytes:
+        nonce = b"\x00" * 4 + self._send_ctr.to_bytes(8, "little")
+        self._send_ctr += 1
+        ct = self._send_aead.encrypt(nonce, plaintext, None)
+        return len(ct).to_bytes(4, "big") + ct
+
+    def decrypt(self, ciphertext: bytes) -> bytes:
+        nonce = b"\x00" * 4 + self._recv_ctr.to_bytes(8, "little")
+        self._recv_ctr += 1
+        return self._recv_aead.decrypt(nonce, ciphertext, None)
+
+
+async def _read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
+    data = await reader.readexactly(n)
+    return data
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> bytes:
+    hdr = await _read_exact(reader, 4)
+    length = int.from_bytes(hdr, "big")
+    if length > MAX_FRAME:
+        raise HandshakeError(f"frame too large: {length}")
+    return await _read_exact(reader, length)
+
+
+class TcpStack:
+    """Node-to-node transport; owns an ExternalBus facing the Node.
+
+    Lifecycle: construct -> (optionally bind() to learn the real port)
+    -> start() -> ... -> stop(). All I/O runs on one asyncio loop; the
+    owning Looper calls drain() each prod cycle to hand queued inbound
+    messages to the bus (per-cycle quota, like zstack.py:520).
+    """
+
+    def __init__(self, name: str, host: str, port: int,
+                 registry: NodeRegistry, seed: bytes,
+                 max_inbound_per_drain: int = 1000):
+        self.name = name
+        self.host, self.port = host, port
+        self.registry = registry
+        self._sk = Ed25519PrivateKey.from_private_bytes(seed)
+        self.verkey = self._sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        self.bus = ExternalBus(self._enqueue_send)
+        self._sessions: dict[str, _Session] = {}
+        self._outboxes: dict[str, list[bytes]] = {}
+        self._inbound: list[tuple[Any, str]] = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._dial_tasks: dict[str, asyncio.Task] = {}
+        self._reader_tasks: set[asyncio.Task] = set()
+        self._flush_scheduled = False
+        self._quota = max_inbound_per_drain
+        self._stopped = False
+        self.stats = {"sent_frames": 0, "recv_frames": 0, "rejected": 0}
+
+    # --- lifecycle -------------------------------------------------------
+
+    async def bind(self) -> int:
+        """Start the listener; returns the actual port (use port=0 to let
+        the OS pick — the tests and the local-pool runner do)."""
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._on_accept, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def start(self) -> None:
+        await self.bind()
+        self.maintain_connections()
+
+    def maintain_connections(self) -> None:
+        """(Re)start dial loops for every registry peer we should dial."""
+        for peer in self.registry.names():
+            if peer == self.name or not self._is_dialer(peer):
+                continue
+            task = self._dial_tasks.get(peer)
+            if task is None or task.done():
+                self._dial_tasks[peer] = asyncio.get_running_loop(
+                ).create_task(self._dial_loop(peer))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for task in list(self._dial_tasks.values()):
+            task.cancel()
+        for task in list(self._reader_tasks):
+            task.cancel()
+        for sess in list(self._sessions.values()):
+            try:
+                sess.writer.close()
+            except Exception:
+                pass
+        self._sessions.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def _is_dialer(self, peer: str) -> bool:
+        return self.name < peer
+
+    # --- outgoing --------------------------------------------------------
+
+    def _enqueue_send(self, msg: Any, dst) -> None:
+        if isinstance(msg, MessageBase):
+            data = pack(msg.to_dict())
+        else:
+            data = pack(msg)
+        targets = dst if dst is not None else [
+            p for p in self.registry.names() if p != self.name]
+        for peer in targets:
+            box = self._outboxes.setdefault(peer, [])
+            box.append(data)
+            if len(box) > OUTBOX_CAP:          # quota: drop oldest
+                del box[:len(box) - OUTBOX_CAP]
+        self._schedule_flush()
+
+    def _schedule_flush(self) -> None:
+        if self._flush_scheduled or self._stopped:
+            return
+        self._flush_scheduled = True
+        try:
+            asyncio.get_running_loop().call_soon(self._flush)
+        except RuntimeError:
+            self._flush_scheduled = False      # no loop yet; flushed on start
+
+    def _flush(self) -> None:
+        """Coalesce each peer's queued messages into ONE encrypted frame
+        (common/batched.py flushOutBoxes equivalent)."""
+        self._flush_scheduled = False
+        for peer, box in self._outboxes.items():
+            sess = self._sessions.get(peer)
+            if sess is None or not box:
+                continue                       # keep queued until connected
+            frame_payload = pack(box)
+            box.clear()
+            try:
+                sess.writer.write(sess.encrypt_frame(frame_payload))
+                self.stats["sent_frames"] += 1
+            except Exception:
+                self._drop_session(peer)
+
+    # --- incoming --------------------------------------------------------
+
+    def drain(self) -> int:
+        """Deliver up to the per-cycle quota of inbound messages to the bus."""
+        n = 0
+        while self._inbound and n < self._quota:
+            msg, frm = self._inbound.pop(0)
+            n += 1
+            try:
+                self.bus.process_incoming(msg, frm)
+            except Exception:
+                logger.exception("handler failed for %s from %s",
+                                 type(msg).__name__, frm)
+        return n
+
+    @property
+    def connected(self) -> set[str]:
+        return set(self._sessions)
+
+    # --- handshake: dialer side -----------------------------------------
+
+    async def _dial_loop(self, peer: str) -> None:
+        delay = RETRY_MIN
+        while not self._stopped:
+            if peer in self._sessions:
+                await asyncio.sleep(RETRY_MAX)
+                continue
+            entry = self.registry.get(peer)
+            if entry is None:
+                return
+            host, port, expect_vk = entry
+            writer = None
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+                sess = await self._handshake_dialer(
+                    peer, expect_vk, reader, writer)
+                self._install_session(peer, sess, reader)
+                delay = RETRY_MIN
+            except (OSError, HandshakeError, asyncio.IncompleteReadError):
+                if writer is not None:       # failed handshake: free the fd
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, RETRY_MAX)
+
+    async def _handshake_dialer(self, peer: str, expect_vk: bytes,
+                                reader, writer) -> _Session:
+        eph = X25519PrivateKey.generate()
+        eph_pub = eph.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        writer.write(MAGIC + eph_pub)
+        await writer.drain()
+        resp = await _read_exact(reader, 32 + 32 + 64)
+        eph_b, vk_b, sig_b = resp[:32], resp[32:64], resp[64:]
+        if vk_b != expect_vk:
+            raise HandshakeError(f"{peer}: unexpected verkey")
+        transcript = eph_pub + eph_b
+        try:
+            Ed25519PublicKey.from_public_bytes(vk_b).verify(
+                sig_b, b"resp" + transcript)
+        except InvalidSignature:
+            raise HandshakeError(f"{peer}: bad responder signature")
+        sig_a = self._sk.sign(b"init" + transcript)
+        writer.write(self.verkey + sig_a)
+        await writer.drain()
+        k_d2a, k_a2d = _derive_keys(eph, eph_b, transcript)
+        return _Session(peer, writer, send_key=k_d2a, recv_key=k_a2d)
+
+    # --- handshake: acceptor side ---------------------------------------
+
+    async def _on_accept(self, reader, writer) -> None:
+        try:
+            sess = await asyncio.wait_for(
+                self._handshake_acceptor(reader, writer), timeout=5.0)
+        except Exception:
+            self.stats["rejected"] += 1
+            writer.close()
+            return
+        self._install_session(sess.peer, sess, reader)
+
+    async def _handshake_acceptor(self, reader, writer) -> _Session:
+        hello = await _read_exact(reader, len(MAGIC) + 32)
+        if hello[:len(MAGIC)] != MAGIC:
+            raise HandshakeError("bad magic")
+        eph_a = hello[len(MAGIC):]
+        eph = X25519PrivateKey.generate()
+        eph_pub = eph.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        transcript = eph_a + eph_pub
+        sig_b = self._sk.sign(b"resp" + transcript)
+        writer.write(eph_pub + self.verkey + sig_b)
+        await writer.drain()
+        fin = await _read_exact(reader, 32 + 64)
+        vk_a, sig_a = fin[:32], fin[32:]
+        peer = self.registry.name_by_verkey(vk_a)
+        if peer is None:                       # ZAP allowlist: unknown key
+            raise HandshakeError("verkey not in registry")
+        try:
+            Ed25519PublicKey.from_public_bytes(vk_a).verify(
+                sig_a, b"init" + transcript)
+        except InvalidSignature:
+            raise HandshakeError(f"{peer}: bad initiator signature")
+        k_d2a, k_a2d = _derive_keys(eph, eph_a, transcript)
+        return _Session(peer, writer, send_key=k_a2d, recv_key=k_d2a)
+
+    # --- session plumbing -----------------------------------------------
+
+    def _install_session(self, peer: str, sess: _Session, reader) -> None:
+        old = self._sessions.get(peer)
+        if old is not None:
+            # restarted peer: the new connection supersedes the old one
+            try:
+                old.writer.close()
+            except Exception:
+                pass
+        self._sessions[peer] = sess
+        task = asyncio.get_running_loop().create_task(
+            self._read_loop(peer, sess, reader))
+        self._reader_tasks.add(task)
+        task.add_done_callback(self._reader_tasks.discard)
+        self.bus.update_connecteds(self.connected)
+        self._schedule_flush()                 # release queued outbox
+
+    def _drop_session(self, peer: str) -> None:
+        sess = self._sessions.pop(peer, None)
+        if sess is not None:
+            try:
+                sess.writer.close()
+            except Exception:
+                pass
+            self.bus.update_connecteds(self.connected)
+
+    async def _read_loop(self, peer: str, sess: _Session, reader) -> None:
+        try:
+            while not self._stopped:
+                ct = await _read_frame(reader)
+                payload = sess.decrypt(ct)
+                self.stats["recv_frames"] += 1
+                # frame payload = packed list of per-message packed dicts
+                # (messages are serialized once at enqueue, even for
+                # broadcasts, then batched per peer at flush)
+                for raw in unpack(payload):
+                    try:
+                        self._inbound.append(
+                            (message_from_dict(unpack(raw)), peer))
+                    except Exception:
+                        logger.warning("undecodable message from %s", peer)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError, Exception):
+            pass
+        finally:
+            if self._sessions.get(peer) is sess:
+                self._drop_session(peer)
+
+
+class ClientStack:
+    """Client-facing listener.
+
+    Plaintext length-prefixed msgpack frames: client requests are themselves
+    Ed25519-signed at the request layer (client_authn), which is what
+    authenticates them — transport encryption for clients is TLS-termination
+    territory, out of scope the same way the reference leaves client CurveZMQ
+    keys unauthenticated (any client key is accepted, zstack.py:322).
+
+    on_request(msg_dict, client_id) is wired to Node.handle_client_message;
+    send(msg, client_id) is the Node's client_send callback.
+    """
+
+    INBOUND_CAP = 10_000          # queued requests across all clients
+
+    def __init__(self, name: str, host: str, port: int,
+                 on_request: Callable[[dict, str], None],
+                 max_inbound_per_drain: int = 500):
+        self.name = name
+        self.host, self.port = host, port
+        self._on_request = on_request
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: dict[str, asyncio.StreamWriter] = {}
+        self._next_id = 0
+        self._inbound: list[tuple[dict, str]] = []
+        self._quota = max_inbound_per_drain
+
+    async def bind(self) -> int:
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._on_accept, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        for w in self._conns.values():
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._conns.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def drain(self) -> int:
+        """Per-cycle quota, like the node stack (ref zstack.py:520) — one
+        fast client must not stall a whole prod cycle."""
+        n = 0
+        while self._inbound and n < self._quota:
+            msg, cid = self._inbound.pop(0)
+            n += 1
+            try:
+                self._on_request(msg, cid)
+            except Exception:
+                logger.exception("client request failed")
+        return n
+
+    def send(self, msg: Any, client_id: str) -> None:
+        writer = self._conns.get(client_id)
+        if writer is None:
+            return                             # client gone; reply dropped
+        data = pack(msg.to_dict() if isinstance(msg, MessageBase) else msg)
+        try:
+            writer.write(len(data).to_bytes(4, "big") + data)
+        except Exception:
+            self._conns.pop(client_id, None)
+
+    async def _on_accept(self, reader, writer) -> None:
+        cid = f"client-{self._next_id}"
+        self._next_id += 1
+        self._conns[cid] = writer
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                msg = unpack(frame)
+                if isinstance(msg, dict) and \
+                        len(self._inbound) < self.INBOUND_CAP:
+                    self._inbound.append((msg, cid))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                Exception):
+            pass
+        finally:
+            self._conns.pop(cid, None)
+            try:
+                writer.close()
+            except Exception:
+                pass

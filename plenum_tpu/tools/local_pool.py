@@ -19,34 +19,45 @@ import statistics
 import time
 
 
-def build_pool(n_nodes: int, backend: str, seed: int = 1):
+def build_genesis(names, node_data_extra=None):
+    """Pool + domain genesis txns for a named node set -> (genesis, trustee).
+
+    node_data_extra: optional {name: dict} merged into each NODE txn's data
+    (the TCP runner adds node_ip/node_port/client_ip/client_port here, the
+    same fields the reference pool ledger carries)."""
     from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID,
-                                                 POOL_LEDGER_ID, Reply)
-    from plenum_tpu.common.timer import QueueTimer
-    from plenum_tpu.config import Config
+                                                 POOL_LEDGER_ID)
     from plenum_tpu.crypto.bls import BlsCryptoSigner
     from plenum_tpu.crypto.ed25519 import Ed25519Signer
     from plenum_tpu.execution import txn as txn_lib
     from plenum_tpu.execution.txn import NODE, NYM, TRUSTEE
-    from plenum_tpu.network import SimNetwork, SimRandom
-    from plenum_tpu.node import Node, NodeBootstrap
 
-    names = [f"Node{i + 1}" for i in range(n_nodes)]
     trustee = Ed25519Signer(seed=b"local-pool-trustee".ljust(32, b"\0"))
     pool_txns = []
     for i, name in enumerate(names):
         bls_pk = BlsCryptoSigner(seed=name.encode().ljust(32, b"\0")[:32]).pk
-        txn = txn_lib.new_txn(NODE, {
-            "dest": f"{name}Dest",
-            "data": {"alias": name, "services": ["VALIDATOR"],
-                     "blskey": bls_pk}})
+        data = {"alias": name, "services": ["VALIDATOR"], "blskey": bls_pk}
+        if node_data_extra and name in node_data_extra:
+            data.update(node_data_extra[name])
+        txn = txn_lib.new_txn(NODE, {"dest": f"{name}Dest", "data": data})
         txn_lib.set_seq_no(txn, i + 1)
         pool_txns.append(txn)
     nym = txn_lib.new_txn(NYM, {"dest": trustee.identifier,
                                 "verkey": trustee.verkey_b58,
                                 "role": TRUSTEE})
     txn_lib.set_seq_no(nym, 1)
-    genesis = {POOL_LEDGER_ID: pool_txns, DOMAIN_LEDGER_ID: [nym]}
+    return {POOL_LEDGER_ID: pool_txns, DOMAIN_LEDGER_ID: [nym]}, trustee
+
+
+def build_pool(n_nodes: int, backend: str, seed: int = 1):
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID, Reply
+    from plenum_tpu.common.timer import QueueTimer
+    from plenum_tpu.config import Config
+    from plenum_tpu.network import SimNetwork, SimRandom
+    from plenum_tpu.node import Node, NodeBootstrap
+
+    names = [f"Node{i + 1}" for i in range(n_nodes)]
+    genesis, trustee = build_genesis(names)
 
     timer = QueueTimer(time.perf_counter)
     net = SimNetwork(timer, SimRandom(seed))

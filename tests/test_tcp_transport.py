@@ -1,0 +1,279 @@
+"""Real-socket transport tests: handshake, allowlist, batching, reconnect,
+and a full 4-node pool ordering a NYM over localhost TCP.
+
+Reference test model: stp_zmq tests (connect/auth) + the pool e2e NYM flow
+(SURVEY.md §4). Everything runs in one asyncio loop — real sockets, no OS
+process per node.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+
+import pytest
+
+from plenum_tpu.common.node_messages import InstanceChange
+from plenum_tpu.common.event_bus import ExternalBus
+from plenum_tpu.common.serialization import pack, unpack
+from plenum_tpu.network.tcp_stack import ClientStack, NodeRegistry, TcpStack
+
+
+def _seed(name: str) -> bytes:
+    return hashlib.sha256(b"tcp-test-" + name.encode()).digest()
+
+
+def _vk(seed: bytes) -> bytes:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+    sk = Ed25519PrivateKey.from_private_bytes(seed)
+    return sk.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+
+
+async def _make_pair(names=("Alpha", "Beta")):
+    reg = NodeRegistry()
+    stacks = {}
+    for n in names:
+        stacks[n] = TcpStack(n, "127.0.0.1", 0, reg, _seed(n))
+        port = await stacks[n].bind()
+        reg.set(n, "127.0.0.1", port, stacks[n].verkey)
+    for n in names:
+        await stacks[n].start()
+    return reg, stacks
+
+
+async def _wait(cond, timeout=5.0, interval=0.01):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return cond()
+
+
+def test_handshake_and_message_roundtrip():
+    async def main():
+        reg, stacks = await _make_pair()
+        a, b = stacks["Alpha"], stacks["Beta"]
+        assert await _wait(lambda: a.connected == {"Beta"}
+                           and b.connected == {"Alpha"})
+
+        got = []
+        b.bus.subscribe(InstanceChange,
+                        lambda msg, frm: got.append((msg, frm)))
+        a.bus.send(InstanceChange(view_no=3, reason=0), "Beta")
+        assert await _wait(lambda: b.drain() + len(got) and got)
+        msg, frm = got[0]
+        assert isinstance(msg, InstanceChange) and msg.view_no == 3
+        assert frm == "Alpha"
+
+        # and the reverse direction (acceptor -> dialer)
+        got_a = []
+        a.bus.subscribe(InstanceChange,
+                        lambda msg, frm: got_a.append((msg, frm)))
+        b.bus.send(InstanceChange(view_no=7, reason=0), "Alpha")
+        assert await _wait(lambda: a.drain() + len(got_a) and got_a)
+        assert got_a[0][0].view_no == 7 and got_a[0][1] == "Beta"
+
+        # Connected events reached the bus subscribers
+        assert a.bus.connecteds == {"Beta"}
+        await a.stop()
+        await b.stop()
+
+    asyncio.run(main())
+
+
+def test_allowlist_rejects_unknown_verkey():
+    async def main():
+        reg, stacks = await _make_pair()
+        a, b = stacks["Alpha"], stacks["Beta"]
+        assert await _wait(lambda: a.connected == {"Beta"})
+
+        # an impostor dialing Beta with a key not in the registry: the
+        # acceptor must refuse (ZAP allowlist, zstack.py:322)
+        evil_reg = NodeRegistry()
+        evil_reg.set("Beta", "127.0.0.1", b.port, b.verkey)
+        evil = TcpStack("AAAevil", "127.0.0.1", 0, evil_reg,
+                        _seed("not-in-registry"))
+        await evil.bind()
+        evil.maintain_connections()
+        await asyncio.sleep(0.5)
+        assert evil.connected == set()
+        assert b.stats["rejected"] >= 1
+        assert b.connected == {"Alpha"}      # honest session unaffected
+        await evil.stop()
+        await a.stop()
+        await b.stop()
+
+    asyncio.run(main())
+
+
+def test_outbox_batching_one_frame_per_flush():
+    async def main():
+        reg, stacks = await _make_pair()
+        a, b = stacks["Alpha"], stacks["Beta"]
+        assert await _wait(lambda: a.connected == {"Beta"})
+        base = a.stats["sent_frames"]
+        got = []
+        b.bus.subscribe(InstanceChange, lambda m, f: got.append(m))
+        for v in range(50):
+            a.bus.send(InstanceChange(view_no=v, reason=0), "Beta")
+        assert await _wait(lambda: (b.drain(), len(got))[1] >= 50)
+        # 50 messages coalesced into one encrypted frame (batched.py:20)
+        assert a.stats["sent_frames"] == base + 1
+        assert [m.view_no for m in got] == list(range(50))
+        await a.stop()
+        await b.stop()
+
+    asyncio.run(main())
+
+
+def test_queued_outbox_flushes_after_reconnect():
+    async def main():
+        reg = NodeRegistry()
+        a = TcpStack("Alpha", "127.0.0.1", 0, reg, _seed("Alpha"))
+        await a.bind()
+        reg.set("Alpha", "127.0.0.1", a.port, a.verkey)
+        # Beta is registered but not yet listening: messages queue
+        beta_seed = _seed("Beta")
+        reg.set("Beta", "127.0.0.1", 1, _vk(beta_seed))  # dead port
+        await a.start()
+        a.bus.send(InstanceChange(view_no=9, reason=0), "Beta")
+        await asyncio.sleep(0.3)
+        assert a.connected == set()
+
+        # now Beta comes up on a real port; update registry; dialer retries
+        b = TcpStack("Beta", "127.0.0.1", 0, reg, beta_seed)
+        port = await b.bind()
+        reg.set("Beta", "127.0.0.1", port, b.verkey)
+        await b.start()
+        got = []
+        b.bus.subscribe(InstanceChange, lambda m, f: got.append(m))
+        assert await _wait(lambda: (b.drain(), len(got))[1] >= 1, timeout=8.0)
+        assert got[0].view_no == 9           # queued message survived
+        await a.stop()
+        await b.stop()
+
+    asyncio.run(main())
+
+
+def test_session_supersede_on_peer_restart():
+    async def main():
+        reg, stacks = await _make_pair()
+        a, b = stacks["Alpha"], stacks["Beta"]
+        assert await _wait(lambda: a.connected == {"Beta"})
+        b_port = b.port
+        await b.stop()
+        assert await _wait(lambda: a.connected == set(), timeout=5.0)
+
+        # Beta restarts on the SAME port with the same identity
+        b2 = TcpStack("Beta", "127.0.0.1", b_port, reg, _seed("Beta"))
+        await b2.start()
+        assert await _wait(lambda: a.connected == {"Beta"}
+                           and b2.connected == {"Alpha"}, timeout=8.0)
+        got = []
+        b2.bus.subscribe(InstanceChange, lambda m, f: got.append(m))
+        a.bus.send(InstanceChange(view_no=4, reason=0), "Beta")
+        assert await _wait(lambda: (b2.drain(), len(got))[1] >= 1)
+        await a.stop()
+        await b2.stop()
+
+    asyncio.run(main())
+
+
+# --- full pool over real sockets -----------------------------------------
+
+def _build_tcp_pool(n_nodes=4):
+    """Nodes + TCP stacks + client stacks in one loop; returns the parts."""
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    from plenum_tpu.common.timer import QueueTimer
+    from plenum_tpu.config import Config
+    from plenum_tpu.node import Node, NodeBootstrap
+    from plenum_tpu.node.looper import Looper, Prodable
+    from plenum_tpu.tools.local_pool import build_genesis
+
+    names = [f"Node{i + 1}" for i in range(n_nodes)]
+    genesis, trustee = build_genesis(names)
+    reg = NodeRegistry()
+    config = Config(Max3PCBatchWait=0.005,
+                    STATE_FRESHNESS_UPDATE_INTERVAL=600.0)
+    looper = Looper(prod_interval=0.002)
+    nodes, node_stacks, client_stacks = {}, {}, {}
+
+    async def setup():
+        for name in names:
+            stack = TcpStack(name, "127.0.0.1", 0, reg, _seed(name))
+            await stack.bind()
+            reg.set(name, "127.0.0.1", stack.port, stack.verkey)
+            node_stacks[name] = stack
+        for name in names:
+            components = NodeBootstrap(name, genesis_txns=genesis).build()
+            timer = QueueTimer(time.perf_counter)
+            cstack = ClientStack(name, "127.0.0.1", 0, on_request=None)
+            node = Node(name, timer, node_stacks[name].bus, components,
+                        client_send=cstack.send, config=config)
+            cstack._on_request = node.handle_client_message
+            nodes[name] = node
+            client_stacks[name] = cstack
+            looper.add(Prodable(node, node_stacks[name], cstack, timer))
+
+    return names, reg, looper, nodes, client_stacks, setup, trustee
+
+
+@pytest.mark.slow
+def test_pool_orders_nym_over_tcp():
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import NYM
+
+    (names, reg, looper, nodes, client_stacks,
+     setup, trustee) = _build_tcp_pool()
+
+    async def main():
+        await setup()
+        async with looper:
+            # all nodes fully meshed
+            ok = await looper.run_until(
+                lambda: all(len(n.node_bus.connecteds) == 3
+                            for n in nodes.values()), timeout=10.0)
+            assert ok, "pool never meshed over TCP"
+
+            # a real TCP client submits a signed NYM to every node
+            user = Ed25519Signer(seed=b"tcp-pool-user".ljust(32, b"\0"))
+            req = Request(trustee.identifier, 1,
+                          {"type": NYM, "dest": user.identifier,
+                           "verkey": user.verkey_b58})
+            req.signature = trustee.sign_b58(req.signing_bytes())
+            replies = []
+
+            async def submit(name):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", client_stacks[name].port)
+                data = pack(req.to_dict())
+                writer.write(len(data).to_bytes(4, "big") + data)
+                await writer.drain()
+                try:
+                    while True:
+                        hdr = await asyncio.wait_for(
+                            reader.readexactly(4), timeout=15.0)
+                        frame = await reader.readexactly(
+                            int.from_bytes(hdr, "big"))
+                        msg = unpack(frame)
+                        replies.append(msg)
+                        if msg.get("op") == "REPLY":
+                            break
+                except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+                    pass
+                writer.close()
+
+            await asyncio.gather(*(submit(n) for n in names))
+            assert any(m.get("op") == "REPLY" for m in replies), replies
+
+            sizes = {nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+                     for n in names}
+            assert sizes == {2}, sizes       # genesis NYM + the new one
+
+    asyncio.run(main())
