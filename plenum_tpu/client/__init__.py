@@ -1,0 +1,4 @@
+from .wallet import Wallet
+from .client import PoolClient
+
+__all__ = ["Wallet", "PoolClient"]

@@ -1,0 +1,130 @@
+"""Metrics collection: named counters/timers accumulated in memory and
+periodically flushed to a KV store.
+
+Reference behavior: plenum/common/metrics_collector.py — a MetricsName enum,
+`add_event(name, value)`, accumulators folding (count, sum, min, max) per
+name, and KvStoreMetricsCollector flushing timestamped accumulator rows so
+external tooling (validator info, process_logs) can read a node's history.
+
+Redesign notes: names are plain strings grouped in a namespace class (an
+IntEnum wire format buys nothing here — metrics never cross the network);
+storage rows are msgpack maps keyed by (ms-timestamp, name), same
+information content as the reference's struct-packed rows.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+from plenum_tpu.common.serialization import pack, unpack
+
+
+class MetricsName:
+    """Namespaced metric names (subset of the reference's ~300, the ones this
+    node actually emits; extend freely — collectors are name-agnostic)."""
+    # node event loop
+    PROD_TIME = "node.prod_time"
+    CLIENT_MSGS = "node.client_msgs"
+    PROPAGATES = "node.propagates"
+    ORDERED_BATCH_SIZE = "node.ordered_batch_size"
+    EXECUTE_BATCH_TIME = "node.execute_batch_time"
+    BACKUP_ORDERED = "node.backup_ordered"
+    # crypto planes
+    SIG_BATCH_SIZE = "crypto.sig_batch_size"
+    SIG_BATCH_TIME = "crypto.sig_batch_time"
+    BLS_VERIFY_TIME = "crypto.bls_verify_time"
+    # consensus
+    VIEW_CHANGES = "consensus.view_changes"
+    SUSPICIONS = "consensus.suspicions"
+    CATCHUPS = "consensus.catchups"
+    MASTER_3PC_BATCH_TIME = "consensus.master_3pc_batch_time"
+    # transport
+    NODE_MSGS_IN = "transport.node_msgs_in"
+    NODE_FRAMES_OUT = "transport.node_frames_out"
+
+
+class Accumulator:
+    """Fold of all events for one name since the last flush."""
+
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+
+    def to_dict(self) -> dict:
+        avg = self.total / self.count if self.count else 0.0
+        return {"count": self.count, "sum": self.total, "avg": avg,
+                "min": self.min, "max": self.max}
+
+
+class MetricsCollector:
+    """In-memory accumulator set. add_event is the single write point."""
+
+    def __init__(self, now: Optional[Callable[[], float]] = None):
+        self._now = now or time.time
+        self.accumulators: dict[str, Accumulator] = {}
+
+    def add_event(self, name: str, value: float = 1.0) -> None:
+        acc = self.accumulators.get(name)
+        if acc is None:
+            acc = self.accumulators[name] = Accumulator()
+        acc.add(value)
+
+    @contextmanager
+    def measure_time(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_event(name, time.perf_counter() - start)
+
+    def summary(self) -> dict:
+        return {name: acc.to_dict()
+                for name, acc in sorted(self.accumulators.items())}
+
+    def flush(self) -> None:
+        self.accumulators.clear()
+
+
+class NullMetricsCollector(MetricsCollector):
+    """Zero-cost sink for benchmarks that must not pay the dict updates."""
+
+    def add_event(self, name: str, value: float = 1.0) -> None:
+        pass
+
+    @contextmanager
+    def measure_time(self, name: str):
+        yield
+
+
+class KvMetricsCollector(MetricsCollector):
+    """Flushes accumulator rows to a KV store; key = ms-timestamp || name,
+    value = msgpack of the fold — read back with read_rows()."""
+
+    def __init__(self, storage, now: Optional[Callable[[], float]] = None):
+        super().__init__(now)
+        self._storage = storage
+
+    def flush(self) -> None:
+        ts_ms = int(self._now() * 1000)
+        for name, acc in self.accumulators.items():
+            key = ts_ms.to_bytes(8, "big") + name.encode()
+            self._storage.put(key, pack(acc.to_dict()))
+        self.accumulators.clear()
+
+    def read_rows(self) -> list[tuple[float, str, dict]]:
+        rows = []
+        for key, value in self._storage.iterator():
+            ts_ms = int.from_bytes(key[:8], "big")
+            rows.append((ts_ms / 1000.0, key[8:].decode(), unpack(value)))
+        return rows

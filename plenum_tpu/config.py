@@ -54,6 +54,9 @@ class Config:
 
     # --- catchup (ref config.py:297) ---
     CATCHUP_BATCH_SIZE: int = 5
+
+    # --- metrics (ref config.py METRICS_COLLECTOR_TYPE/flush) ---
+    METRICS_FLUSH_INTERVAL: float = 10.0
     CatchupTransactionsTimeout: float = 6.0
     ConsistencyProofsTimeout: float = 5.0
 

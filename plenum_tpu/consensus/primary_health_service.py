@@ -82,7 +82,10 @@ class PrimaryHealthService:
         """A live primary orders SOMETHING (a freshness batch at minimum)
         every STATE_FRESHNESS_UPDATE_INTERVAL; silence far beyond that means
         the primary is gone even if no client traffic is pending."""
-        limit = self._config.STATE_FRESHNESS_UPDATE_INTERVAL * 1.5
+        interval = self._config.STATE_FRESHNESS_UPDATE_INTERVAL
+        if interval <= 0:
+            return        # freshness disabled: mirror _send_freshness_batches
+        limit = interval * 1.5
         if now - self._last_order_time >= limit:
             self._vote(Suspicions.STATE_SIGS_ARE_NOT_UPDATED)
             self._last_order_time = now      # re-vote cadence, not a reset

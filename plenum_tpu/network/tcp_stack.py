@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from collections import deque
 from typing import Any, Callable, Optional
 
 from cryptography.exceptions import InvalidSignature
@@ -55,6 +56,7 @@ logger = logging.getLogger(__name__)
 MAGIC = b"PTPU\x01\x00\x00\x00"
 MAX_FRAME = 8 * 1024 * 1024          # reference caps ZMQ frames similarly
 OUTBOX_CAP = 10_000                  # queued msgs per disconnected peer
+WRITE_HWM = 8 * 1024 * 1024          # drop a peer that stops reading (ZMQ HWM)
 RETRY_MIN, RETRY_MAX = 0.1, 2.0      # dialer backoff (kit_zstack retries)
 
 
@@ -157,7 +159,7 @@ class TcpStack:
         self.bus = ExternalBus(self._enqueue_send)
         self._sessions: dict[str, _Session] = {}
         self._outboxes: dict[str, list[bytes]] = {}
-        self._inbound: list[tuple[Any, str]] = []
+        self._inbound: deque[tuple[Any, str]] = deque()
         self._server: Optional[asyncio.AbstractServer] = None
         self._dial_tasks: dict[str, asyncio.Task] = {}
         self._reader_tasks: set[asyncio.Task] = set()
@@ -247,6 +249,12 @@ class TcpStack:
             frame_payload = pack(box)
             box.clear()
             try:
+                # backpressure: a peer that stopped reading is dead to us —
+                # unbounded transport buffering would OOM the node (the
+                # reference's ZMQ high-water mark drops slow peers the same
+                # way; the dialer's retry loop gives it a fresh start)
+                if sess.writer.transport.get_write_buffer_size() > WRITE_HWM:
+                    raise ConnectionError("peer write buffer over HWM")
                 sess.writer.write(sess.encrypt_frame(frame_payload))
                 self.stats["sent_frames"] += 1
             except Exception:
@@ -258,7 +266,7 @@ class TcpStack:
         """Deliver up to the per-cycle quota of inbound messages to the bus."""
         n = 0
         while self._inbound and n < self._quota:
-            msg, frm = self._inbound.pop(0)
+            msg, frm = self._inbound.popleft()
             n += 1
             try:
                 self.bus.process_incoming(msg, frm)
@@ -286,11 +294,15 @@ class TcpStack:
             writer = None
             try:
                 reader, writer = await asyncio.open_connection(host, port)
-                sess = await self._handshake_dialer(
-                    peer, expect_vk, reader, writer)
+                # a wedged acceptor must not hang the dial loop forever:
+                # same 5s budget the acceptor gives us
+                sess = await asyncio.wait_for(
+                    self._handshake_dialer(peer, expect_vk, reader, writer),
+                    timeout=5.0)
                 self._install_session(peer, sess, reader)
                 delay = RETRY_MIN
-            except (OSError, HandshakeError, asyncio.IncompleteReadError):
+            except (OSError, HandshakeError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError):
                 if writer is not None:       # failed handshake: free the fd
                     try:
                         writer.close()
@@ -433,7 +445,7 @@ class ClientStack:
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: dict[str, asyncio.StreamWriter] = {}
         self._next_id = 0
-        self._inbound: list[tuple[dict, str]] = []
+        self._inbound: deque[tuple[dict, str]] = deque()
         self._quota = max_inbound_per_drain
 
     async def bind(self) -> int:
@@ -460,7 +472,7 @@ class ClientStack:
         fast client must not stall a whole prod cycle."""
         n = 0
         while self._inbound and n < self._quota:
-            msg, cid = self._inbound.pop(0)
+            msg, cid = self._inbound.popleft()
             n += 1
             try:
                 self._on_request(msg, cid)
@@ -474,9 +486,15 @@ class ClientStack:
             return                             # client gone; reply dropped
         data = pack(msg.to_dict() if isinstance(msg, MessageBase) else msg)
         try:
+            if writer.transport.get_write_buffer_size() > WRITE_HWM:
+                raise ConnectionError("client write buffer over HWM")
             writer.write(len(data).to_bytes(4, "big") + data)
         except Exception:
             self._conns.pop(client_id, None)
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     async def _on_accept(self, reader, writer) -> None:
         cid = f"client-{self._next_id}"

@@ -65,9 +65,13 @@ class Looper:
     def add(self, prodable: Prodable) -> None:
         self._prodables.append(prodable)
         if self._running:
+            # late-added prodables must bind/dial their stacks first
+            async def start_then_drive():
+                await prodable.start()
+                await self._drive(prodable)
+
             self._tasks.append(
-                asyncio.get_running_loop().create_task(
-                    self._drive(prodable)))
+                asyncio.get_running_loop().create_task(start_then_drive()))
 
     async def __aenter__(self):
         await self.start()

@@ -22,6 +22,7 @@ from plenum_tpu.common.node_messages import (MessageRep, MessageReq, NewView,
 
 PROPAGATE = "PROPAGATE"
 PREPREPARE = "PREPREPARE"
+OLD_VIEW_PREPREPARE = "OLD_VIEW_PREPREPARE"
 VIEW_CHANGE = "VIEW_CHANGE"
 NEW_VIEW = "NEW_VIEW"
 
@@ -66,6 +67,7 @@ class MessageReqProcessor:
         server = {
             PROPAGATE: self._serve_propagate,
             PREPREPARE: self._serve_preprepare,
+            OLD_VIEW_PREPREPARE: self._serve_old_view_preprepare,
             VIEW_CHANGE: self._serve_view_change,
             NEW_VIEW: self._serve_new_view,
         }.get(msg.msg_type)
@@ -95,6 +97,28 @@ class MessageReqProcessor:
         ordering = self._node.replicas[inst_id].ordering
         return ordering.prePrepares.get(key) or \
             ordering.sent_preprepares.get(key)
+
+    def _serve_old_view_preprepare(self, params: dict) -> Optional[PrePrepare]:
+        """Old-view pre-prepare cited by a NewView (ref
+        OldViewPrePrepareRequest, ordering_service.py:2409); keyed by
+        ORIGINAL view — peers that ordered it keep it in old_view_preprepares
+        after view_change_started, or still in prePrepares if they ordered it
+        in the cited view itself."""
+        inst_id = int(params["inst_id"])
+        key = (int(params["view_no"]), int(params["pp_seq_no"]))
+        if inst_id >= len(self._node.replicas):
+            return None
+        ordering = self._node.replicas[inst_id].ordering
+        found = ordering.old_view_preprepares.get(key)
+        if found is not None:
+            return found
+        pp = ordering.prePrepares.get(key) or ordering.sent_preprepares.get(key)
+        if pp is not None:
+            orig = pp.original_view_no if pp.original_view_no is not None \
+                else pp.view_no
+            if orig == key[0]:
+                return pp
+        return None
 
     def _serve_view_change(self, params: dict) -> Optional[ViewChange]:
         vc_service = self._node.replicas.master.view_changer
@@ -132,6 +156,11 @@ class MessageReqProcessor:
             if inner.inst_id < len(self._node.replicas):
                 self._node.replicas[inner.inst_id].ordering \
                     .process_requested_preprepare(inner)
+        elif msg.msg_type == OLD_VIEW_PREPREPARE and \
+                isinstance(inner, PrePrepare):
+            if inner.inst_id < len(self._node.replicas):
+                self._node.replicas[inner.inst_id].ordering \
+                    .process_requested_old_view_preprepare(inner)
         elif msg.msg_type == VIEW_CHANGE and isinstance(inner, ViewChange):
             vc_service = self._node.replicas.master.view_changer
             if vc_service is not None:

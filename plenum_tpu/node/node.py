@@ -27,12 +27,12 @@ from plenum_tpu.common.internal_messages import (MissingMessage,
                                                  RequestPropagates,
                                                  VoteForViewChange)
 from plenum_tpu.common.suspicion_codes import Suspicions
-from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID, CatchupRep,
-                                             CatchupReq, ConsistencyProof,
-                                             LedgerStatus, Ordered,
-                                             POOL_LEDGER_ID, Propagate,
-                                             Reject, Reply, RequestAck,
-                                             RequestNack)
+from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID, BatchCommitted,
+                                             CatchupRep, CatchupReq,
+                                             ConsistencyProof, LedgerStatus,
+                                             Ordered, POOL_LEDGER_ID,
+                                             Propagate, Reject, Reply,
+                                             RequestAck, RequestNack)
 from plenum_tpu.common.serialization import unpack
 from plenum_tpu.execution.database_manager import SEQ_NO_DB_LABEL
 from plenum_tpu.common.request import Request
@@ -45,10 +45,13 @@ from plenum_tpu.execution import txn as txn_lib
 from plenum_tpu.execution.exceptions import (InvalidClientRequest,
                                              UnauthorizedClientRequest)
 from plenum_tpu.execution.write_manager import ThreePcBatch
+from plenum_tpu.common.metrics import (KvMetricsCollector, MetricsCollector,
+                                       MetricsName)
 from plenum_tpu.node.blacklister import Blacklister
 from plenum_tpu.node.bootstrap import NodeComponents
 from plenum_tpu.node.message_req_processor import MessageReqProcessor
 from plenum_tpu.node.monitor import Monitor
+from plenum_tpu.node.observer import Observable
 from plenum_tpu.node.propagator import Propagator
 
 # Suspicions whose message only the primary can have authored: these implicate
@@ -73,16 +76,27 @@ class Node:
                  components: NodeComponents,
                  client_send: Optional[Callable[[Any, str], None]] = None,
                  config: Optional[Config] = None,
-                 instance_count: Optional[int] = None):
+                 instance_count: Optional[int] = None,
+                 metrics: Optional[MetricsCollector] = None):
         self.name = name
         self.timer = timer
         self.node_bus = node_bus
         self.config = config or Config()
         self.c = components
         self._client_send = client_send or (lambda msg, client: None)
+        self.started_at = timer.get_current_time()
+
+        # named-metric accumulators (ref common/metrics_collector.py:331);
+        # KV-backed collectors get a periodic flush so history survives
+        self.metrics = metrics or MetricsCollector()
+        if isinstance(self.metrics, KvMetricsCollector):
+            self._metrics_flush_timer = RepeatingTimer(
+                timer, self.config.METRICS_FLUSH_INTERVAL,
+                self.metrics.flush)
 
         self.pool_manager = components.pool_manager
         self.pool_manager._on_changed = self._on_pool_changed
+        self.on_pool_changed_callbacks: list[Callable[[], None]] = []
         self.validators = self.pool_manager.node_names or [name]
         self.quorums = self.pool_manager.quorums
 
@@ -141,6 +155,9 @@ class Node:
         self.node_bus.subscribe(Propagate, self._receive_propagate)
         # "ask peers for a missing message" (ref message_req_processor.py:13)
         self.message_req = MessageReqProcessor(self)
+        # observers are remote followers addressed like clients
+        # (ref server/observer/observable.py:11; push in _execute_batch)
+        self.observable = Observable(send=self._client_send)
         from collections import deque
         self.spylog: Any = deque(maxlen=1000)      # bounded event trace
 
@@ -241,12 +258,14 @@ class Node:
         for replica in self.replicas:
             replica.adopt_new_view(msg.view_no, primaries)
         self.monitor.reset()
+        self.metrics.add_event(MetricsName.VIEW_CHANGES)
         self.spylog.append(("view_change_complete", msg.view_no))
 
     def _on_suspicion(self, msg: RaisedSuspicion) -> None:
         """Route a protocol suspicion: primary-authored faults become
         view-change votes; unambiguous peer misbehavior blacklists the
         sender (ref node.py:2854-2944)."""
+        self.metrics.add_event(MetricsName.SUSPICIONS)
         self.spylog.append(("suspicion", (msg.code, msg.sender)))
         if msg.inst_id >= len(self.replicas):
             return
@@ -269,6 +288,7 @@ class Node:
         (ref node.py:2610 start_catchup → NodeLeecherService.start)."""
         if self.leecher.is_running:
             return
+        self.metrics.add_event(MetricsName.CATCHUPS)
         self.spylog.append(("catchup_started", None))
         for replica in self.replicas:
             replica.ordering.catchup_started()
@@ -329,6 +349,10 @@ class Node:
             replica.set_validators(self.validators)
         for n in self.pool_manager.node_names:
             self.c.bls_register.set_key(n, self.pool_manager.bls_key_of(n))
+        # transport reacts too (TCP runner syncs its NodeRegistry + dials
+        # new members here; ref kit_zstack connectToMissing)
+        for cb in self.on_pool_changed_callbacks:
+            cb()
 
     # --- ingress ----------------------------------------------------------
 
@@ -343,8 +367,14 @@ class Node:
     def prod(self) -> int:
         """One event-loop cycle (ref node.py:1037). Returns work count."""
         count = 0
-        count += self._service_client_msgs()
-        count += self._service_propagates()
+        n = self._service_client_msgs()
+        if n:
+            self.metrics.add_event(MetricsName.CLIENT_MSGS, n)
+        count += n
+        n = self._service_propagates()
+        if n:
+            self.metrics.add_event(MetricsName.PROPAGATES, n)
+        count += n
         self.replicas.service_all()
         count += self._service_ordered()
         return count
@@ -490,9 +520,13 @@ class Node:
                 for digest in msg.discarded:
                     self.monitor.req_tracker.drop(digest)
             if msg.inst_id != 0:
+                self.metrics.add_event(MetricsName.BACKUP_ORDERED)
                 self.spylog.append(("backup_ordered", msg))
                 continue
-            self._execute_batch(msg)
+            self.metrics.add_event(MetricsName.ORDERED_BATCH_SIZE,
+                                   len(msg.req_idr))
+            with self.metrics.measure_time(MetricsName.EXECUTE_BATCH_TIME):
+                self._execute_batch(msg)
         return done
 
     def _execute_batch(self, msg: Ordered) -> None:
@@ -509,6 +543,28 @@ class Node:
             node_reg=tuple(self.validators))
         committed = self.c.executor.commit_batch(batch)
         self.spylog.append(("executed", (msg.view_no, msg.pp_seq_no)))
+        if committed and self.observable.observer_ids:
+            reqs = []
+            complete = True
+            for digest in msg.req_idr:
+                if digest in msg.discarded:
+                    continue
+                state = self.propagator.requests.get(digest)
+                if state is None:
+                    complete = False      # swept request: a partial push
+                    break                 # would wedge observers on a root
+                reqs.append(state.request.to_dict())      # mismatch forever
+            if complete:
+                self.observable.append_input(BatchCommitted(
+                    requests=tuple(reqs), ledger_id=msg.ledger_id, inst_id=0,
+                    view_no=msg.view_no, pp_seq_no=msg.pp_seq_no,
+                    pp_time=msg.pp_time, state_root=msg.state_root,
+                    txn_root=msg.txn_root,
+                    seq_no_start=txn_lib.txn_seq_no(committed[0]),
+                    seq_no_end=txn_lib.txn_seq_no(committed[-1])))
+            else:
+                self.spylog.append(("observer_push_skipped",
+                                    (msg.view_no, msg.pp_seq_no)))
         for txn in committed:
             digest = txn_lib.txn_digest(txn)
             state = self.propagator.requests.get(digest) if digest else None
@@ -544,3 +600,38 @@ class Node:
     @property
     def f(self) -> int:
         return self.quorums.f
+
+    def validator_info(self) -> dict:
+        """Operational snapshot (ref plenum/server/validator_info_tool.py):
+        identity, pool view, per-ledger sizes/roots, 3PC position, catchup
+        and connection state, metrics summary. Everything here is cheap to
+        read — safe to poll."""
+        master = self.master_replica
+        ledgers = {}
+        for ledger_id, ledger in self.c.db.ledgers():
+            state = self.c.db.get_state(ledger_id)
+            ledgers[ledger_id] = {
+                "size": ledger.size,
+                "uncommitted": ledger.uncommitted_size - ledger.size,
+                "root": ledger.root_hash.hex(),
+                "state_root": state.committed_head_hash.hex()
+                if state is not None else None,
+            }
+        return {
+            "name": self.name,
+            "uptime": self.timer.get_current_time() - self.started_at,
+            "validators": list(self.validators),
+            "f": self.quorums.f,
+            "connected": sorted(self.node_bus.connecteds),
+            "blacklisted": sorted(self.blacklister.blacklisted),
+            "view_no": master.data.view_no,
+            "primaries": list(master.data.primaries),
+            "is_primary": {r.inst_id: r.data.is_primary
+                           for r in self.replicas},
+            "last_ordered_3pc": tuple(master.last_ordered_3pc),
+            "catchup_in_progress": self.leecher.is_running,
+            "instances": len(self.replicas),
+            "ledgers": ledgers,
+            "metrics": self.metrics.summary(),
+            "monitor": self.monitor.stats(),
+        }

@@ -1,0 +1,98 @@
+"""Observer framework: push committed batches to non-validator followers.
+
+Reference behavior: plenum/server/observer/observable.py:11 (the node-side
+registry + policy that fans BatchCommitted out to registered observers) and
+observer/observer_node.py + observer_sync_policy_each_batch.py (the follower
+that applies each batch to its own ledger copy).
+
+The node-side Observable subscribes nothing by itself: Node._execute_batch
+calls append_input() after commit, and the policy decides who gets the
+message. The follower side (NodeObserver) re-derives the ledger from the
+batch's request list and REFUSES batches whose claimed txn root does not
+match what its own Merkle tree computes — an observer is untrusted-input
+tolerant even though it trusts the pool's ordering.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from plenum_tpu.common.node_messages import BatchCommitted
+
+
+class Observable:
+    """Node-side observer registry + each-batch send policy."""
+
+    def __init__(self, send: Callable[[Any, str], None]):
+        self._send = send
+        self._observers: dict[str, str] = {}      # observer id -> policy
+
+    def add_observer(self, observer_id: str,
+                     policy: str = "each_batch") -> None:
+        if policy != "each_batch":
+            raise ValueError(f"unknown observer policy {policy!r}")
+        self._observers[observer_id] = policy
+
+    def remove_observer(self, observer_id: str) -> None:
+        self._observers.pop(observer_id, None)
+
+    @property
+    def observer_ids(self) -> list[str]:
+        return list(self._observers)
+
+    def append_input(self, batch: BatchCommitted) -> None:
+        for observer_id in self._observers:
+            self._send(batch, observer_id)
+
+
+class NodeObserver:
+    """Follower: applies each pushed batch to its own ledgers/states.
+
+    Built from the same NodeBootstrap components as a validator (minus
+    consensus); process_batch is idempotent and gap-safe: batches at or
+    below the ledger's size are ignored, a batch leaving a gap is rejected
+    (the caller should catch up out of band, same as the reference's
+    can_process check in observer_sync_policy_each_batch.py).
+    """
+
+    def __init__(self, components):
+        self.c = components
+        self.last_applied: dict[int, int] = {}
+
+    def process_batch(self, batch: BatchCommitted, frm: str = "") -> bool:
+        from plenum_tpu.common.request import Request
+        from plenum_tpu.execution.write_manager import ThreePcBatch
+
+        ledger = self.c.db.get_ledger(batch.ledger_id)
+        if ledger is None:
+            return False
+        if batch.seq_no_end <= ledger.size:
+            return False                            # already have it
+        if batch.seq_no_start != ledger.size + 1:
+            return False                            # gap: needs catchup
+
+        # re-run the write pipeline: apply -> compare roots -> commit
+        requests = [Request.from_dict(r) for r in batch.requests]
+        valid, _rejected, roots = self.c.write_manager.apply_batch(
+            batch.ledger_id, requests, batch.pp_time, batch.view_no,
+            batch.pp_seq_no)
+        if roots["txn_root"] != batch.txn_root or \
+                roots["state_root"] != batch.state_root:
+            # claimed roots don't match recomputation: refuse and revert.
+            # (The audit ledger is NOT compared: its txns snapshot primaries,
+            # which a follower has no view of — same scope as the reference's
+            # each-batch policy, which replays domain/pool data only.)
+            self.c.write_manager.revert_last_batch(batch.ledger_id)
+            return False
+        self.c.write_manager.commit_batch(ThreePcBatch(
+            ledger_id=batch.ledger_id, view_no=batch.view_no,
+            pp_seq_no=batch.pp_seq_no, pp_time=batch.pp_time,
+            valid_digests=tuple(r.digest for r in valid),
+            state_root=bytes.fromhex(roots["state_root"])
+            if roots["state_root"] else b"",
+            txn_root=bytes.fromhex(roots["txn_root"])
+            if roots["txn_root"] else b"",
+            audit_txn_root=bytes.fromhex(roots["audit_txn_root"])
+            if roots["audit_txn_root"] else b"",
+            primaries=(), node_reg=()))
+        self.last_applied[batch.ledger_id] = batch.seq_no_end
+        return True

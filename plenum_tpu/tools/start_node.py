@@ -1,0 +1,115 @@
+"""Start one validator node as an OS process.
+
+Reference behavior: scripts/start_plenum_node — load the node's keys and the
+genesis files from a base dir, stand up the real transport stacks, and run
+the node until killed. A 4-node localhost pool is four of these processes
+(ports from the genesis node specs) — see tests/test_tools.py for the
+scripted version.
+
+    python -m plenum_tpu.tools.start_node --name Node1 --base-dir /tmp/pool \
+        [--backend cpu|jax] [--kv file|memory]
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+
+def build_node(name: str, base_dir: str, backend: str = "cpu",
+               kv: str = "file"):
+    """-> (prodable, node, registry) ready for a Looper."""
+    from plenum_tpu.common.node_messages import POOL_LEDGER_ID
+    from plenum_tpu.common.timer import QueueTimer
+    from plenum_tpu.config import Config, load_config
+    from plenum_tpu.network.tcp_stack import (ClientStack, NodeRegistry,
+                                              TcpStack)
+    from plenum_tpu.node import Node, NodeBootstrap
+    from plenum_tpu.node.looper import Prodable
+    from plenum_tpu.tools.genesis import load_genesis_files
+    from plenum_tpu.tools.keygen import load_keys
+
+    keys = load_keys(base_dir, name)
+    genesis = load_genesis_files(base_dir)
+
+    registry = NodeRegistry()
+    my_ha = my_client_ha = None
+    for txn in genesis[POOL_LEDGER_ID]:
+        data = txn["txn"]["data"]["data"]
+        alias = data["alias"]
+        registry.set(alias, data["node_ip"], data["node_port"],
+                     bytes.fromhex(data["verkey"]))
+        if alias == name:
+            my_ha = (data["node_ip"], data["node_port"])
+            my_client_ha = (data["client_ip"], data["client_port"])
+    if my_ha is None:
+        raise SystemExit(f"{name} is not in the pool genesis")
+
+    data_dir = os.path.join(base_dir, name, "data") if kv == "file" else None
+    components = NodeBootstrap(
+        name, genesis_txns=genesis, data_dir=data_dir,
+        crypto_backend=backend,
+        bls_seed=bytes.fromhex(keys["bls_seed"])).build()
+    timer = QueueTimer(time.perf_counter)
+    node_stack = TcpStack(name, my_ha[0], my_ha[1], registry,
+                          seed=bytes.fromhex(keys["seed"]))
+    client_stack = ClientStack(name, my_client_ha[0], my_client_ha[1],
+                               on_request=None)
+    config = Config(crypto_backend=backend, kv_backend=kv)
+    node = Node(name, timer, node_stack.bus, components,
+                client_send=client_stack.send, config=config)
+    client_stack._on_request = node.handle_client_message
+
+    def sync_registry_from_pool():
+        """Pool-ledger NODE txns drive the transport allowlist + dialing
+        (ref kit_zstack connectToMissing / pool_manager reconnect)."""
+        members = set(node.pool_manager.node_names)
+        for alias in members:
+            info = node.pool_manager.node_info(alias) or {}
+            vk = info.get("verkey")
+            if vk and "node_ip" in info:
+                registry.set(alias, info["node_ip"], info["node_port"],
+                             bytes.fromhex(vk))
+        for alias in registry.names():
+            if alias not in members:
+                registry.remove(alias)
+        node_stack.maintain_connections()
+
+    node.on_pool_changed_callbacks.append(sync_registry_from_pool)
+    return Prodable(node, node_stack, client_stack, timer), node, registry
+
+
+def main(argv=None):
+    from plenum_tpu.node.looper import Looper
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--base-dir", required=True)
+    ap.add_argument("--backend", default="cpu", choices=["cpu", "jax"])
+    ap.add_argument("--kv", default="file", choices=["file", "memory"])
+    args = ap.parse_args(argv)
+
+    prodable, node, _ = build_node(args.name, args.base_dir, args.backend,
+                                   args.kv)
+    looper = Looper()
+    looper.add(prodable)
+
+    async def forever():
+        print(json.dumps({"started": args.name,
+                          "node_port": prodable.node_stack.port,
+                          "client_port": prodable.client_stack.port}),
+              flush=True)
+        while True:
+            await asyncio.sleep(60)
+            info = node.validator_info()
+            print(json.dumps({"uptime": round(info["uptime"], 1),
+                              "last_ordered_3pc": info["last_ordered_3pc"],
+                              "connected": info["connected"]}), flush=True)
+
+    looper.run(forever())
+
+
+if __name__ == "__main__":
+    main()

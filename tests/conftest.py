@@ -29,3 +29,8 @@ import pytest  # noqa: E402
 @pytest.fixture
 def tdir(tmp_path):
     return str(tmp_path)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running e2e tests (process pools, fuzzing)")

@@ -1,0 +1,185 @@
+"""Aux subsystems: metrics collection, validator info, recorder/replay,
+observer framework.
+
+Reference test model: plenum/test/metrics, plenum/test/recorder,
+plenum/test/observer (SURVEY.md §5 aux subsystems).
+"""
+from __future__ import annotations
+
+import pytest
+
+from plenum_tpu.common.metrics import (KvMetricsCollector, MetricsCollector,
+                                       MetricsName, NullMetricsCollector)
+from plenum_tpu.common.timer import MockTimer
+from plenum_tpu.storage.kv_memory import KvMemory
+
+from test_pool import Pool, make_genesis, signed_nym
+
+
+# --- metrics --------------------------------------------------------------
+
+def test_metrics_accumulator_folds():
+    m = MetricsCollector()
+    for v in (3.0, 1.0, 2.0):
+        m.add_event("x", v)
+    m.add_event("y")
+    s = m.summary()
+    assert s["x"] == {"count": 3, "sum": 6.0, "avg": 2.0, "min": 1.0,
+                      "max": 3.0}
+    assert s["y"]["count"] == 1
+    with m.measure_time("t"):
+        pass
+    assert m.summary()["t"]["count"] == 1
+
+
+def test_kv_metrics_flush_and_read_back():
+    store = KvMemory()
+    clock = [1000.0]
+    m = KvMetricsCollector(store, now=lambda: clock[0])
+    m.add_event("a", 5.0)
+    m.add_event("a", 7.0)
+    m.flush()
+    clock[0] = 1010.0
+    m.add_event("a", 1.0)
+    m.flush()
+    rows = m.read_rows()
+    assert [(ts, name, d["count"], d["sum"]) for ts, name, d in rows] == [
+        (1000.0, "a", 2, 12.0), (1010.0, "a", 1, 1.0)]
+    assert m.summary() == {}            # flushed clean
+
+
+def test_null_collector_is_inert():
+    m = NullMetricsCollector()
+    m.add_event("x", 1.0)
+    with m.measure_time("y"):
+        pass
+    assert m.summary() == {}
+
+
+def test_pool_populates_metrics_and_validator_info():
+    pool = Pool()
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    user = Ed25519Signer(seed=b"aux-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(5.0)
+    node = pool.nodes[pool.names[0]]
+    assert pool.replies(pool.names[0])
+    s = node.metrics.summary()
+    assert s[MetricsName.CLIENT_MSGS]["count"] >= 1
+    assert s[MetricsName.ORDERED_BATCH_SIZE]["count"] >= 1
+    assert s[MetricsName.EXECUTE_BATCH_TIME]["count"] >= 1
+
+    info = node.validator_info()
+    assert info["name"] == pool.names[0]
+    assert sorted(info["validators"]) == sorted(pool.names)
+    assert info["f"] == 1
+    assert info["view_no"] == 0
+    assert not info["catchup_in_progress"]
+    assert info["last_ordered_3pc"][1] >= 1
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    dom = info["ledgers"][DOMAIN_LEDGER_ID]
+    assert dom["size"] == 2 and dom["uncommitted"] == 0
+    # info snapshots from every node agree on the ordered state
+    other = pool.nodes[pool.names[-1]].validator_info()
+    assert other["ledgers"][DOMAIN_LEDGER_ID]["root"] == dom["root"]
+
+
+# --- recorder / replay ----------------------------------------------------
+
+def test_record_and_replay_reproduces_state():
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.node import Node, NodeBootstrap
+    from plenum_tpu.node.recorder import Recorder, attach_recorder, replay
+
+    pool = Pool()
+    target = pool.names[0]
+    store = KvMemory()
+    recorder = Recorder(store, now=pool.timer.get_current_time)
+    attach_recorder(pool.nodes[target], recorder)
+
+    user = Ed25519Signer(seed=b"rec-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(5.0)
+    user2 = Ed25519Signer(seed=b"rec-user2".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user2, 2))
+    pool.run(5.0)
+    live = pool.nodes[target]
+    live_root = live.c.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+    assert live.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 3
+
+    # fresh node, same genesis, fed ONLY the recorded stream
+    genesis, _ = make_genesis(pool.names)
+    timer = MockTimer()
+    components = NodeBootstrap(target, genesis_txns=genesis).build()
+    from plenum_tpu.common.event_bus import ExternalBus
+    bus = ExternalBus(send_handler=lambda msg, dst: None)   # sends -> sink
+    node = Node(target, timer, bus, components, config=pool.config)
+    n = replay(recorder.iter_records(), node, timer)
+    assert n > 0
+    ledger = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
+    assert ledger.size == 3
+    assert ledger.root_hash == live_root
+
+
+# --- observer -------------------------------------------------------------
+
+def _observer_components(names):
+    from plenum_tpu.node import NodeBootstrap
+    genesis, _ = make_genesis(names)
+    return NodeBootstrap("Observer", genesis_txns=genesis).build()
+
+
+def test_observer_follows_committed_batches():
+    from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID,
+                                                 BatchCommitted)
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.node.observer import NodeObserver
+
+    pool = Pool()
+    target = pool.names[0]
+    node = pool.nodes[target]
+    node.observable.add_observer("obs-client-1")
+    assert node.observable.observer_ids == ["obs-client-1"]
+
+    user = Ed25519Signer(seed=b"obs-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(5.0)
+
+    pushes = [m for m, client in pool.client_msgs[target]
+              if isinstance(m, BatchCommitted) and client == "obs-client-1"]
+    assert pushes, "no BatchCommitted pushed to the registered observer"
+
+    observer = NodeObserver(_observer_components(pool.names))
+    for batch in pushes:
+        assert observer.process_batch(batch)
+        assert not observer.process_batch(batch)     # idempotent
+    ledger = observer.c.db.get_ledger(DOMAIN_LEDGER_ID)
+    live = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
+    assert ledger.size == live.size == 2
+    assert ledger.root_hash == live.root_hash
+
+
+def test_observer_refuses_tampered_batch():
+    from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID,
+                                                 BatchCommitted)
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.node.observer import NodeObserver
+
+    pool = Pool()
+    target = pool.names[0]
+    node = pool.nodes[target]
+    node.observable.add_observer("obs")
+    user = Ed25519Signer(seed=b"obs-user-2".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(5.0)
+    batch = next(m for m, c in pool.client_msgs[target]
+                 if isinstance(m, BatchCommitted))
+
+    observer = NodeObserver(_observer_components(pool.names))
+    import dataclasses
+    bad = dataclasses.replace(batch, txn_root="00" * 32)
+    assert not observer.process_batch(bad)
+    # refusal reverted cleanly: the honest batch still applies
+    assert observer.process_batch(batch)
+    assert observer.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2

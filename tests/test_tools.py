@@ -1,0 +1,155 @@
+"""Ops-surface tests: wallet, keygen, genesis files, and a real 4-process
+pool started via the start_node script, written to and read from with the
+PoolClient over TCP.
+
+Reference test model: the scripts/ + client e2e flow (SURVEY.md §2 tools,
+client wallet).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- wallet ---------------------------------------------------------------
+
+def test_wallet_sign_and_roundtrip(tmp_path):
+    from plenum_tpu.client import Wallet
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.execution.txn import NYM
+    from plenum_tpu.utils.base58 import b58decode
+
+    w = Wallet("w1")
+    did = w.add_identifier(seed=b"wallet-seed-0001".ljust(32, b"\0"))
+    assert w.default_id == did
+    req = w.sign_request({"type": NYM, "dest": "X", "verkey": "Y"})
+    assert req.identifier == did and req.signature
+    ok = CpuEd25519Verifier().verify(
+        req.signing_bytes(), b58decode(req.signature),
+        b58decode(w.verkey_of(did)))
+    assert ok
+
+    # persistence: same keys come back
+    path = str(tmp_path / "wallet.bin")
+    w.save(path)
+    assert oct(os.stat(path).st_mode & 0o777) == "0o600"
+    w2 = Wallet.load(path)
+    assert w2.identifiers() == [did] and w2.default_id == did
+    assert w2.verkey_of(did) == w.verkey_of(did)
+
+
+# --- keygen + genesis -----------------------------------------------------
+
+def test_keygen_and_genesis_files(tmp_path):
+    from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID,
+                                                 POOL_LEDGER_ID)
+    from plenum_tpu.crypto.bls import verify_pop
+    from plenum_tpu.tools import genesis as gen
+    from plenum_tpu.tools import keygen
+
+    base = str(tmp_path)
+    for i, name in enumerate(("Alpha", "Beta")):
+        keys = keygen.generate_keys(
+            name, seed=(b"kg%d" % i).ljust(32, b"\0"))
+        keygen.save_keys(keys, base)
+        loaded = keygen.load_keys(base, name)
+        assert loaded == keys
+        assert verify_pop(keys["bls_pop"], keys["bls_pk"])
+
+    out = gen.build_genesis_files(
+        base, [("Alpha", "127.0.0.1", 9701, 9702),
+               ("Beta", "127.0.0.1", 9703, 9704)],
+        trustee_seed=b"t".ljust(32, b"\0"))
+    assert os.path.exists(out["pool_genesis"])
+    loaded = gen.load_genesis_files(base)
+    assert len(loaded[POOL_LEDGER_ID]) == 2
+    assert len(loaded[DOMAIN_LEDGER_ID]) == 1
+    data = loaded[POOL_LEDGER_ID][0]["txn"]["data"]["data"]
+    assert data["alias"] == "Alpha" and data["node_port"] == 9701
+
+
+# --- 4 OS processes over real sockets -------------------------------------
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.slow
+def test_four_process_pool_orders_nym(tmp_path):
+    from plenum_tpu.client import PoolClient, Wallet
+    from plenum_tpu.execution.txn import NYM
+    from plenum_tpu.tools import genesis as gen
+    from plenum_tpu.tools import keygen
+
+    base = str(tmp_path)
+    names = ["Node1", "Node2", "Node3", "Node4"]
+    ports = _free_ports(8)
+    specs = []
+    for i, name in enumerate(names):
+        keygen.save_keys(keygen.generate_keys(
+            name, seed=(b"proc%d" % i).ljust(32, b"\0")), base)
+        specs.append((name, "127.0.0.1", ports[2 * i], ports[2 * i + 1]))
+    trustee_seed = b"proc-trustee".ljust(32, b"\0")
+    gen.build_genesis_files(base, specs, trustee_seed)
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        for name in names:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "plenum_tpu.tools.start_node",
+                 "--name", name, "--base-dir", base, "--kv", "memory"],
+                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT))
+        # wait for every process to report "started"
+        for p in procs:
+            line = p.stdout.readline()
+            assert b"started" in line, line
+
+        wallet = Wallet("cli")
+        trustee_did = wallet.add_identifier(seed=trustee_seed)
+        user_did = wallet.add_identifier(seed=b"proc-user".ljust(32, b"\0"))
+        req = wallet.sign_request(
+            {"type": NYM, "dest": user_did,
+             "verkey": wallet.verkey_of(user_did)}, identifier=trustee_did)
+
+        async def run():
+            client = PoolClient(
+                {name: ("127.0.0.1", spec[3])
+                 for name, spec in zip(names, specs)}, f=1)
+            try:
+                return await client.submit(req, timeout=30.0)
+            finally:
+                await client.close()
+
+        reply = asyncio.run(run())
+        assert reply["op"] == "REPLY", reply
+        txn = reply["result"]
+        assert txn["txn"]["data"]["dest"] == user_did
+        assert txn["txnMetadata"]["seqNo"] == 2
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
