@@ -30,8 +30,11 @@ class BatchExecutor(ABC):
 
     @abstractmethod
     def apply_batch(self, ledger_id: int, requests: Sequence[Request],
-                    pp_time: float, view_no: int, pp_seq_no: int) -> AppliedBatch:
-        """Dynamic-validate + apply to uncommitted ledger/state; returns roots."""
+                    pp_time: float, view_no: int, pp_seq_no: int,
+                    primaries=None) -> AppliedBatch:
+        """Dynamic-validate + apply to uncommitted ledger/state; returns
+        roots. view_no/primaries are the batch's ORIGINAL view and its
+        primaries (audit-txn reproducibility across re-ordering)."""
 
     @abstractmethod
     def revert_last_batch(self, ledger_id: int) -> None:
@@ -56,7 +59,8 @@ class SimBatchExecutor(BatchExecutor):
     def _root(self, ledger_id: int) -> str:
         return self._roots.get(ledger_id, "genesis")
 
-    def apply_batch(self, ledger_id, requests, pp_time, view_no, pp_seq_no):
+    def apply_batch(self, ledger_id, requests, pp_time, view_no, pp_seq_no,
+                    primaries=None):
         valid, discarded = [], []
         for req in requests:
             (discarded if req.digest in self.reject else valid).append(req.digest)

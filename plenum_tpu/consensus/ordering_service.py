@@ -39,6 +39,7 @@ from .batch_executor import AppliedBatch, BatchExecutor
 from .batch_id import BatchID
 from .bls_bft_replica import BlsBftReplica
 from .consensus_shared_data import ConsensusSharedData
+from .primary_selector import RoundRobinPrimariesSelector
 
 
 def _orig_view(pp: PrePrepare) -> int:
@@ -72,6 +73,10 @@ class OrderingService:
         self.prepares: dict[tuple[int, int], dict[str, Prepare]] = {}
         self.commits: dict[tuple[int, int], dict[str, Commit]] = {}
         self.ordered: set[tuple[int, int]] = set()
+        # (original_view, pp_seq_no) -> digest of every batch this node has
+        # EXECUTED; re-ordered incarnations of these re-certify (vote) but
+        # must never re-apply or re-emit Ordered (see _order)
+        self._ordered_originals: dict[tuple[int, int], str] = {}
         self._commits_sent: set[tuple[int, int]] = set()
         self._stashed_ooo_commits: dict[tuple[int, int], PrePrepare] = {}
         # Old-view pre-prepares kept for re-ordering after a view change,
@@ -136,6 +141,12 @@ class OrderingService:
             self._freshness_deadline.clear()
             return
         if not self._data.is_participating:
+            return
+        if self._awaited_old_view:
+            # a new primary must finish re-proposing the NewView's cited
+            # batches before cutting fresh ones — a fresh batch slotted
+            # between pending re-proposals applies out of seq order and
+            # corrupts the uncommitted stack (found by the view-change fuzz)
             return
         self.send_3pc_batch()
         self._send_freshness_batches()
@@ -227,10 +238,22 @@ class OrderingService:
 
     def _apply(self, ledger_id, reqs, pp_time, view_no, pp_seq_no) -> AppliedBatch:
         if self._data.is_master and self._executor is not None:
-            return self._executor.apply_batch(ledger_id, reqs, pp_time,
-                                              view_no, pp_seq_no)
+            return self._executor.apply_batch(
+                ledger_id, reqs, pp_time, view_no, pp_seq_no,
+                primaries=self._primaries_for_view(view_no))
         digests = tuple(r.digest for r in reqs)
         return AppliedBatch("", "", "", "", digests, ())
+
+    def _primaries_for_view(self, view_no: int) -> list[str]:
+        """Primaries the audit txn must snapshot for a batch ORIGINATING in
+        view_no. Round-robin selection is a pure function of (view,
+        validators), so every node reconstructs the same list when
+        re-applying a re-ordered batch after one or more view changes."""
+        if view_no == self._data.view_no:
+            return list(self._data.primaries)
+        return RoundRobinPrimariesSelector().select_primaries(
+            view_no, max(1, len(self._data.primaries)),
+            self._data.validators)
 
     def _last_state_root(self, ledger_id: int) -> str:
         """State root of the previous batch on this ledger (what the previous
@@ -361,11 +384,29 @@ class OrderingService:
 
     def _process_valid_preprepare(self, msg: PrePrepare, sender: str):
         key = (msg.view_no, msg.pp_seq_no)
+        # A re-ordered incarnation of a batch whose effects our state already
+        # contains: either we executed it ourselves (digest recorded) or a
+        # catchup advanced us past its seq_no. This pass only re-certifies it
+        # into the new view (vote, count quorums) — never re-apply. If we
+        # executed a DIFFERENT batch at this seq_no, voting would endorse a
+        # fork — discard and let the suspicion machinery handle the primary.
+        rerun = msg.pp_seq_no <= self._data.last_ordered_3pc[1]
+        if rerun:
+            known = self._ordered_originals.get(
+                (_orig_view(msg), msg.pp_seq_no))
+            if known is not None and known != msg.digest:
+                self._suspect(Suspicions.PPR_DIGEST_WRONG, sender)
+                return DISCARD
         # Re-apply the batch and cross-check every root (ref :871-931).
-        if self._data.is_master and self._executor is not None:
+        if self._data.is_master and self._executor is not None and not rerun:
             reqs = [self._get_request(d) for d in msg.req_idr]
-            applied = self._executor.apply_batch(msg.ledger_id, reqs, msg.pp_time,
-                                                 msg.view_no, msg.pp_seq_no)
+            # apply under the ORIGINAL view: the audit txn snapshots
+            # (viewNo, primaries), and a re-ordered batch must reproduce the
+            # audit root minted in its original view
+            orig = _orig_view(msg)
+            applied = self._executor.apply_batch(
+                msg.ledger_id, reqs, msg.pp_time, orig, msg.pp_seq_no,
+                primaries=self._primaries_for_view(orig))
             fault = None
             if tuple(applied.discarded) != tuple(msg.discarded):
                 fault = Suspicions.PPR_REJECT_WRONG
@@ -580,7 +621,10 @@ class OrderingService:
         return None
 
     def _order(self, key: tuple[int, int], pp: PrePrepare) -> None:
+        orig_key = (_orig_view(pp), pp.pp_seq_no)
+        rerun = self._ordered_originals.get(orig_key) == pp.digest
         self.ordered.add(key)
+        self._ordered_originals[orig_key] = pp.digest
         self._data.last_ordered_3pc = key
         # Ordered requests must never be re-proposed from this node's queue.
         for queue in self.request_queues.values():
@@ -588,11 +632,20 @@ class OrderingService:
                 queue.pop(digest, None)
         batch_id = BatchID(pp.view_no, _orig_view(pp),
                            pp.pp_seq_no, pp.digest)
-        self._data.free_batch(batch_id)
+        # NOTE: the batch's prepared/preprepared certificate deliberately
+        # SURVIVES ordering (gc() drops it at checkpoint stabilization): a
+        # view change before the covering checkpoint must still carry this
+        # certificate, or peers that didn't order it can never recover it
+        # and the new primary could even mint a different batch at this
+        # seq_no (fork). Found by the seeded view-change fuzz.
         self._applied_unordered = [(lid, b) for (lid, b) in self._applied_unordered
                                    if b != batch_id]
         if self._bls is not None:
             self._bls.process_order(key, pp)
+        if rerun:
+            # already executed under its original view: this pass only
+            # re-certified the batch into the new view's 3PC chain
+            return
         discarded_set = set(pp.discarded)
         ordered = Ordered(inst_id=pp.inst_id, view_no=key[0],
                           pp_seq_no=key[1], pp_time=pp.pp_time,
@@ -616,7 +669,11 @@ class OrderingService:
             ledger_id, batch_id = self._applied_unordered.pop()
             if self._executor is not None and self._data.is_master:
                 self._executor.revert_last_batch(ledger_id)
-            self._data.free_batch(batch_id)
+            # The certificate is NOT freed: a reverted-but-prepared batch
+            # must keep appearing in this node's ViewChange messages across
+            # ESCALATED view changes too (each escalation re-snapshots
+            # data.prepared) — gc() at checkpoint stabilization is the only
+            # legitimate certificate reaper.
             # Reverted requests go back in the queue (ref :2201) — they will
             # either be re-ordered from the old-view pre-prepare or re-batched.
             pp = self.prePrepares.get((batch_id.view_no, batch_id.pp_seq_no))
@@ -655,10 +712,12 @@ class OrderingService:
         """Entering a view change: revert uncommitted work, remember old-view
         pre-prepares for possible re-ordering (ref :2380)."""
         self.revert_unordered_batches()
+        # ALL pre-prepares (ordered ones too) become old-view material: a
+        # NewView may cite an already-ordered batch, and both the re-sending
+        # primary and the MessageReq server look it up by ORIGINAL view here
         for key, pp in self.prePrepares.items():
-            if key not in self.ordered:
-                orig = pp.original_view_no if pp.original_view_no is not None else key[0]
-                self.old_view_preprepares[(orig, key[1])] = pp
+            orig = pp.original_view_no if pp.original_view_no is not None else key[0]
+            self.old_view_preprepares[(orig, key[1])] = pp
         self.prePrepares = {k: v for k, v in self.prePrepares.items()
                             if k in self.ordered}
         self.sent_preprepares.clear()
@@ -700,9 +759,17 @@ class OrderingService:
         cited_seqs = [b[2] for b in msg.batches]
         self._data.pp_seq_no = max([self._data.last_ordered_3pc[1],
                                     msg.checkpoint[2]] + cited_seqs)
-        for (_view, orig_view, pp_seq_no, digest) in msg.batches:
-            if pp_seq_no <= self._data.last_ordered_3pc[1]:
-                continue
+        # NOTE batches at or below our last_ordered are NOT skipped: a
+        # lagging peer needs the whole quorum to re-run 3PC on them (we
+        # vote without re-executing — see the rerun guards); skipping
+        # here stranded laggards forever (found by the view-change fuzz).
+        #
+        # Pass 1: fetch EVERY missing old-view pre-prepare in parallel.
+        todo = []
+        for (_view, orig_view, pp_seq_no, digest) in sorted(
+                msg.batches, key=lambda b: b[2]):
+            if pp_seq_no <= msg.checkpoint[2]:
+                continue      # below the quorum checkpoint: catchup ground
             if (self._data.view_no, pp_seq_no) in self.prePrepares:
                 continue      # already re-ordered (idempotent re-entry)
             old_pp = self.old_view_preprepares.get((orig_view, pp_seq_no))
@@ -717,7 +784,15 @@ class OrderingService:
                     key={"inst_id": self._data.inst_id,
                          "view_no": orig_view, "pp_seq_no": pp_seq_no},
                     inst_id=self._data.inst_id, dst=None))
-                continue
+                old_pp = None
+            todo.append((orig_view, pp_seq_no, digest, old_pp))
+        # Pass 2: re-send/apply STRICTLY in seq order, stopping at the first
+        # still-missing batch — each reply re-enters this method, and
+        # applying whatever happened to be available produced out-of-order
+        # uncommitted applies (commit then crashed; found by the fuzz).
+        for (orig_view, pp_seq_no, digest, old_pp) in todo:
+            if old_pp is None:
+                break
             # These requests ride the re-ordered batch; don't re-batch them.
             for queue in self.request_queues.values():
                 for d in old_pp.req_idr:
@@ -726,15 +801,24 @@ class OrderingService:
             new_pp = dataclasses.replace(old_pp, view_no=self._data.view_no,
                                          original_view_no=orig_view)
             key = (self._data.view_no, pp_seq_no)
+            # seq-based like _process_valid_preprepare: _ordered_originals is
+            # in-memory only (empty after restart, trimmed by gc), but
+            # last_ordered survives restart via the audit restore — a batch
+            # at or below it is already in our committed state
+            rerun = (pp_seq_no <= self._data.last_ordered_3pc[1]
+                     or self._ordered_originals.get(
+                         (orig_view, pp_seq_no)) == digest)
             if self.is_primary:
                 self.sent_preprepares[key] = new_pp
                 self.prePrepares[key] = new_pp
                 self._data.pp_seq_no = max(self._data.pp_seq_no, pp_seq_no)
-                if self._data.is_master and self._executor is not None:
+                if self._data.is_master and self._executor is not None \
+                        and not rerun:
                     reqs = [self._get_request(d) for d in new_pp.req_idr]
-                    self._executor.apply_batch(new_pp.ledger_id, reqs,
-                                               new_pp.pp_time,
-                                               self._data.view_no, pp_seq_no)
+                    self._executor.apply_batch(
+                        new_pp.ledger_id, reqs, new_pp.pp_time,
+                        orig_view, pp_seq_no,
+                        primaries=self._primaries_for_view(orig_view))
                     self._applied_unordered.append(
                         (new_pp.ledger_id,
                          BatchID(self._data.view_no, orig_view, pp_seq_no, digest)))
@@ -759,7 +843,18 @@ class OrderingService:
                       self.prepares, self.commits):
             for k in [k for k in store if k[1] <= seq]:
                 del store[k]
+        # certificate lists follow the same lifetime as the 3PC logs
+        self._data.preprepared = [b for b in self._data.preprepared
+                                  if b.pp_seq_no > seq]
+        self._data.prepared = [b for b in self._data.prepared
+                               if b.pp_seq_no > seq]
         self.ordered = {k for k in self.ordered if k[1] > seq}
+        self._ordered_originals = {k: v for k, v in
+                                   self._ordered_originals.items()
+                                   if k[1] > seq}
+        self._stashed_ooo_commits = {k: v for k, v in
+                                     self._stashed_ooo_commits.items()
+                                     if k[1] > seq}
         self._commits_sent = {k for k in self._commits_sent if k[1] > seq}
         self.old_view_preprepares = {k: v for k, v in self.old_view_preprepares.items()
                                      if k[1] > seq}

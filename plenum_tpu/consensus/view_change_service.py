@@ -22,6 +22,7 @@ from typing import Optional
 from plenum_tpu.common.event_bus import ExternalBus, InternalBus
 from plenum_tpu.common.internal_messages import (MissingMessage,
                                                  NeedViewChange,
+                                                 VoteForViewChange,
                                                  NewViewAccepted,
                                                  NewViewCheckpointsApplied,
                                                  PrimarySelected,
@@ -218,8 +219,17 @@ class ViewChangeService:
     def _schedule_timeout(self, view_no: int) -> None:
         def on_timeout():
             if self._data.waiting_for_new_view and self._data.view_no == view_no:
-                # View change didn't complete: escalate to the next view.
-                self._bus.send(NeedViewChange(view_no=view_no + 1))
+                # View change didn't complete: VOTE to escalate — through
+                # the InstanceChange quorum, never unilaterally. A node that
+                # jumps to view+1 alone strands itself views ahead of the
+                # pool (found by the view-change fuzz: one node escalated to
+                # view 11 while the quorum sat at 1). Ref: the reference
+                # routes VC timeouts through instance changes too
+                # (view_change_trigger_service + INSTANCE_CHANGE_TIMEOUT).
+                self._bus.send(VoteForViewChange(
+                    suspicion_code=Suspicions.INSTANCE_CHANGE_TIMEOUT.code,
+                    view_no=view_no + 1))
+                self._schedule_timeout(view_no)     # keep voting while stuck
         self._timer.schedule(self._config.NEW_VIEW_TIMEOUT, on_timeout)
 
         def request_new_view():

@@ -19,9 +19,11 @@ class LedgerBatchExecutor(BatchExecutor):
         self.write_manager = write_manager
 
     def apply_batch(self, ledger_id: int, requests: Sequence[Request],
-                    pp_time: float, view_no: int, pp_seq_no: int) -> AppliedBatch:
+                    pp_time: float, view_no: int, pp_seq_no: int,
+                    primaries=None) -> AppliedBatch:
         valid, rejected, roots = self.write_manager.apply_batch(
-            ledger_id, requests, pp_time, view_no, pp_seq_no)
+            ledger_id, requests, pp_time, view_no, pp_seq_no,
+            primaries=primaries)
         return AppliedBatch(
             state_root=roots["state_root"],
             txn_root=roots["txn_root"],

@@ -141,9 +141,15 @@ class WriteRequestManager:
     # --- apply / revert / commit -----------------------------------------
 
     def apply_batch(self, ledger_id: int, requests: Sequence[Request],
-                    pp_time: float, view_no: int, pp_seq_no: int
+                    pp_time: float, view_no: int, pp_seq_no: int,
+                    primaries: Optional[Sequence[str]] = None
                     ) -> tuple[list[Request], list[tuple[Request, str]], dict]:
         """Dynamic-validate and apply a batch to uncommitted ledger+state.
+
+        view_no/primaries must be the batch's ORIGINAL view and that view's
+        primaries: the audit txn snapshots them, and a batch re-ordered after
+        a view change must hash to the same audit root it was minted with
+        (ref audit_batch_handler original_view_no semantics).
 
         Returns (valid, [(request, reason) rejected], roots) where roots has
         hex 'state_root', 'txn_root', 'pool_state_root', 'audit_txn_root'.
@@ -178,7 +184,9 @@ class WriteRequestManager:
             last = self._last_uncommitted_audit(audit_ledger)
             audit_txn = audit_lib.build_audit_txn(
                 self.db, view_no, pp_seq_no, pp_time, ledger_id,
-                self._primaries_provider(), self._node_reg_provider(), last)
+                list(primaries) if primaries is not None
+                else self._primaries_provider(),
+                self._node_reg_provider(), last)
             txn_lib.set_seq_no(audit_txn, audit_ledger.uncommitted_size + 1)
             audit_ledger.append_txns_to_uncommitted([audit_txn])
 

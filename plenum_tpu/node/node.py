@@ -125,6 +125,9 @@ class Node:
         components.write_manager._node_reg_provider = (
             lambda: list(self.validators))
 
+        # highest pp_seq_no this node has executed (via ordering OR catchup);
+        # an Ordered re-emitted for a re-certified batch must not double-commit
+        self._last_executed_pp_seq = 0
         # inboxes (quota-drained each prod; ref zstack quotas config.py:250)
         self._client_inbox: list[tuple[dict, str]] = []
         self._propagate_inbox: list[tuple[Propagate, str]] = []
@@ -173,6 +176,29 @@ class Node:
         self.monitor = Monitor(self.config, now=timer.get_current_time)
         self._perf_check_timer = RepeatingTimer(
             timer, self.config.PerfCheckFreq, self.check_performance)
+
+        # crash-restart: a node rebuilt over durable storage resumes at the
+        # audit ledger's 3PC position and primaries instead of view 0 / seq 0
+        # (ref node.py:1830,1875 — the same restore catchup applies later)
+        self._restore_3pc_from_audit()
+
+    def _restore_3pc_from_audit(self) -> None:
+        from plenum_tpu.execution.handlers import audit as audit_lib
+        audit = self.c.db.get_ledger(AUDIT_LEDGER_ID)
+        view_no, pp_seq_no, primaries = audit_lib.last_audited_view(audit)
+        if (view_no, pp_seq_no) == (0, 0):
+            return
+        for replica in self.replicas:
+            replica.data.view_no = view_no
+            if primaries:
+                replica.data.primaries = list(primaries)
+            replica.ordering.caught_up_till_3pc(
+                (view_no, pp_seq_no) if replica.is_master
+                else replica.last_ordered_3pc)
+        # the duplicate-Ordered execution guard must survive restart too
+        self._last_executed_pp_seq = max(self._last_executed_pp_seq,
+                                         pp_seq_no)
+        self.spylog.append(("restored_from_audit", (view_no, pp_seq_no)))
 
     def check_performance(self) -> None:
         if self.leecher.is_running:
@@ -321,6 +347,8 @@ class Node:
         if last_3pc is not None and last_3pc > (view_no, pp_seq_no):
             view_no, pp_seq_no = last_3pc
         self.pool_manager.pool_changed()
+        self._last_executed_pp_seq = max(self._last_executed_pp_seq,
+                                         pp_seq_no)
         for replica in self.replicas:
             if view_no > replica.data.view_no:
                 replica.data.view_no = view_no
@@ -523,10 +551,17 @@ class Node:
                 self.metrics.add_event(MetricsName.BACKUP_ORDERED)
                 self.spylog.append(("backup_ordered", msg))
                 continue
+            if msg.pp_seq_no <= self._last_executed_pp_seq:
+                # a batch ordered pre-view-change and re-certified after it
+                # can surface twice; the ledger effects are already durable
+                self.spylog.append(("duplicate_ordered_skipped",
+                                    (msg.view_no, msg.pp_seq_no)))
+                continue
             self.metrics.add_event(MetricsName.ORDERED_BATCH_SIZE,
                                    len(msg.req_idr))
             with self.metrics.measure_time(MetricsName.EXECUTE_BATCH_TIME):
                 self._execute_batch(msg)
+            self._last_executed_pp_seq = msg.pp_seq_no
         return done
 
     def _execute_batch(self, msg: Ordered) -> None:

@@ -46,25 +46,44 @@ def make_genesis(names):
 
 
 class Pool:
-    def __init__(self, names=NODES, seed=42, config=None):
+    def __init__(self, names=NODES, seed=42, config=None, data_dir=None):
         self.names = list(names)
         self.timer = MockTimer()
         self.net = SimNetwork(self.timer, SimRandom(seed))
         self.config = config or Config(Max3PCBatchWait=0.05)
-        genesis, self.trustee = make_genesis(self.names)
+        self.data_dir = data_dir          # per-node durable storage root
+        self.genesis, self.trustee = make_genesis(self.names)
         self.client_msgs: dict[str, list] = {n: [] for n in self.names}
         self.nodes: dict[str, Node] = {}
         for name in self.names:
-            bus = self.net.create_peer(name)
-            components = NodeBootstrap(
-                name, genesis_txns=genesis,
-                crypto_backend=self.config.crypto_backend).build()
-            self.nodes[name] = Node(
-                name, self.timer, bus, components,
-                client_send=lambda msg, client, n=name:
-                    self.client_msgs[n].append((msg, client)),
-                config=self.config)
+            self.start_node(name)
         self.net.connect_all()
+
+    def _node_data_dir(self, name):
+        import os
+        return os.path.join(self.data_dir, name) if self.data_dir else None
+
+    def start_node(self, name: str) -> Node:
+        """(Re)build a node from genesis + its durable dir and attach it
+        to the fabric; used both at pool build and for restart tests."""
+        bus = self.net.create_peer(name)
+        components = NodeBootstrap(
+            name, genesis_txns=self.genesis,
+            data_dir=self._node_data_dir(name),
+            crypto_backend=self.config.crypto_backend).build()
+        self.nodes[name] = Node(
+            name, self.timer, bus, components,
+            client_send=lambda msg, client, n=name:
+                self.client_msgs[n].append((msg, client)),
+            config=self.config)
+        return self.nodes[name]
+
+    def crash_node(self, name: str) -> None:
+        """Hard-stop: drop the node object with NO clean shutdown; its
+        durable files keep whatever was committed."""
+        node = self.nodes.pop(name)
+        node.c.db.close()
+        self.net.remove_peer(name)
 
     def run(self, seconds=5.0, step=0.1):
         elapsed = 0.0

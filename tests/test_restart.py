@@ -1,0 +1,129 @@
+"""Full-node crash-restart: durable storage, audit-ledger 3PC restore,
+rejoin via catchup.
+
+Reference behavior under test: node restart recovery — ledgers/states
+reopen from disk (ledger.py:70-113), the node resumes at the audit ledger's
+3PC position and primaries (node.py:1830,1875), and a node that missed
+traffic while down catches up and keeps ordering (SURVEY.md §5
+checkpoint/resume).
+"""
+from __future__ import annotations
+
+import pytest
+
+from plenum_tpu.common.node_messages import AUDIT_LEDGER_ID, DOMAIN_LEDGER_ID
+from plenum_tpu.config import Config
+from plenum_tpu.crypto.ed25519 import Ed25519Signer
+
+from test_pool import Pool, signed_nym
+
+
+def _file_pool(tmp_path, **kw):
+    return Pool(config=Config(Max3PCBatchWait=0.05, kv_backend="file"),
+                data_dir=str(tmp_path), **kw)
+
+
+def _user(tag: bytes) -> Ed25519Signer:
+    return Ed25519Signer(seed=tag.ljust(32, b"\0"))
+
+
+def test_single_node_crash_restart_rejoins_and_orders(tmp_path):
+    pool = _file_pool(tmp_path)
+    victim = "Delta"          # not the master primary (Alpha)
+
+    pool.submit(signed_nym(pool.trustee, _user(b"rs-u1"), 1))
+    pool.run(5.0)
+    assert pool.nodes[victim].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2
+
+    # hard-stop mid-stream: no clean shutdown, then the pool moves on
+    pool.crash_node(victim)
+    pool.submit(signed_nym(pool.trustee, _user(b"rs-u2"), 2),
+                to=[n for n in pool.names if n != victim])
+    pool.run(5.0)
+    survivors = [n for n in pool.names if n != victim]
+    assert all(pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 3
+               for n in survivors)
+
+    # restart from disk: committed state is back without any traffic
+    node = pool.start_node(victim)
+    pool.net.connect_all()
+    ledger = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
+    assert ledger.size == 2               # durable recovery of what it saw
+    # audit restore: resumed at its pre-crash 3PC position, not (0, 0)
+    assert node.master_replica.last_ordered_3pc[1] >= 1
+    assert ("restored_from_audit", node.master_replica.last_ordered_3pc) \
+        in list(node.spylog)
+
+    # it catches up the missed txn...
+    node.start_catchup()
+    pool.run(10.0)
+    assert ledger.size == 3
+    assert ledger.root_hash == pool.nodes["Alpha"].c.db.get_ledger(
+        DOMAIN_LEDGER_ID).root_hash
+
+    # ...and participates in ordering NEW traffic
+    pool.submit(signed_nym(pool.trustee, _user(b"rs-u3"), 3))
+    pool.run(5.0)
+    assert ledger.size == 4
+    assert all(pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 4
+               for n in pool.names)
+
+
+def test_whole_pool_restart_resumes_without_catchup(tmp_path):
+    pool = _file_pool(tmp_path)
+    pool.submit(signed_nym(pool.trustee, _user(b"wp-u1"), 1))
+    pool.run(5.0)
+    last_3pc = pool.nodes["Alpha"].master_replica.last_ordered_3pc
+    assert last_3pc[1] >= 1
+    root = pool.nodes["Alpha"].c.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+
+    # power failure: every node hard-stops
+    for name in list(pool.names):
+        pool.crash_node(name)
+    for name in pool.names:
+        pool.start_node(name)
+    pool.net.connect_all()
+
+    for name in pool.names:
+        node = pool.nodes[name]
+        assert node.c.db.get_ledger(DOMAIN_LEDGER_ID).root_hash == root
+        assert node.master_replica.last_ordered_3pc == last_3pc
+        audit = node.c.db.get_ledger(AUDIT_LEDGER_ID)
+        assert audit.size >= 1
+
+    # the pool keeps ordering from where it left off — no catchup needed
+    pool.submit(signed_nym(pool.trustee, _user(b"wp-u2"), 2))
+    pool.run(5.0)
+    for name in pool.names:
+        ledger = pool.nodes[name].c.db.get_ledger(DOMAIN_LEDGER_ID)
+        assert ledger.size == 3
+        assert pool.nodes[name].master_replica.last_ordered_3pc[1] == \
+            last_3pc[1] + 1
+
+
+def test_restart_discards_uncommitted_tail(tmp_path):
+    """A torn write in the ledger log must not poison recovery: the file KV
+    drops the torn tail and the node restarts from the last durable record."""
+    import os
+
+    pool = _file_pool(tmp_path)
+    pool.submit(signed_nym(pool.trustee, _user(b"tt-u1"), 1))
+    pool.run(5.0)
+    victim = "Delta"
+    size_before = pool.nodes[victim].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+    pool.crash_node(victim)
+
+    # tear the tail of the domain ledger log (crash mid-write)
+    log = os.path.join(str(tmp_path), victim, "domain_log", "kv.kvlog")
+    file_size = os.path.getsize(log)
+    os.truncate(log, file_size - 3)
+
+    node = pool.start_node(victim)
+    pool.net.connect_all()
+    ledger = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
+    assert ledger.size == size_before - 1     # torn record dropped
+    node.start_catchup()
+    pool.run(10.0)
+    assert ledger.size == size_before         # catchup refills it
+    assert ledger.root_hash == pool.nodes["Alpha"].c.db.get_ledger(
+        DOMAIN_LEDGER_ID).root_hash
