@@ -1,79 +1,46 @@
-"""Benchmark: batched Ed25519 verification throughput on device vs CPU.
+"""Benchmark: the north-star metric — 4-node pool write throughput.
 
-This is the north-star hot path (SURVEY.md §3.2: CoreAuthNr.authenticate →
-libsodium scalar verify, n× per request across the pool; BASELINE.md: the
-reference publishes no numbers, so the CPU backend of this framework — a
-scalar loop over the C Ed25519 implementation, the same work the reference
-does per request — is the measured baseline denominator).
+BASELINE.json defines the metric as "write txns/sec at f=1 (4-node pool);
+p50 commit latency", with the reference publishing no numbers, so the CPU
+backend of this framework — the same per-request scalar Ed25519 work the
+reference does via libsodium, plus the same RBFT pipeline — is the measured
+baseline denominator (BASELINE.md). Both backends run the REAL pipeline:
+client authN -> propagate quorum -> 3PC with BLS signing + order-time
+aggregate verification -> execute -> REPLY, over real wall-clock time
+(plenum_tpu/tools/local_pool.py).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The jax backend routes every client-signature batch to the windowed
+Ed25519 device kernel at ONE fixed dispatch shape (pow-2 bucket >= the
+receive quotas) so XLA compiles a single program; the Merkle hasher stays
+on hashlib below its batch threshold (device dispatch on a tunneled TPU
+only pays off at catchup-scale batches).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 from __future__ import annotations
 
-import hashlib
 import json
-import time
-
-
-def make_items(n: int):
-    """n deterministic (msg, sig64, verkey32) triples, one distinct key each
-    (the verifier's per-verkey point cache is filled by the warmup pass, so
-    the timed iterations measure the warm-cache device hot path)."""
-    try:
-        from plenum_tpu.crypto.ed25519 import Ed25519Signer
-        items = []
-        for i in range(n):
-            signer = Ed25519Signer(hashlib.sha256(b"bench%d" % i).digest())
-            msg = b"bench message %d" % i
-            items.append((msg, signer.sign(msg), signer.verkey))
-        return items
-    except Exception:
-        # no `cryptography` package: pure-Python signing (slow, host-only)
-        from plenum_tpu.ops.ed25519 import pure_python_sign
-        items = []
-        for i in range(n):
-            seed = hashlib.sha256(b"bench%d" % i).digest()
-            msg = b"bench message %d" % i
-            sig, vk = pure_python_sign(seed, msg)
-            items.append((msg, sig, vk))
-        return items
-
-
-def bench_jax(items, iters: int = 5) -> float:
-    from plenum_tpu.crypto.ed25519 import JaxEd25519Verifier
-    v = JaxEd25519Verifier()
-    ok = v.verify_batch(items)          # warmup: compile + point-cache fill
-    assert ok.all(), "bench signatures must verify"
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        v.verify_batch(items)
-    dt = time.perf_counter() - t0
-    return iters * len(items) / dt
-
-
-def bench_cpu(items) -> float:
-    try:
-        from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
-        v = CpuEd25519Verifier()
-    except Exception:
-        return 0.0
-    v.verify_batch(items[:8])           # warmup
-    t0 = time.perf_counter()
-    ok = v.verify_batch(items)
-    dt = time.perf_counter() - t0
-    assert ok.all()
-    return len(items) / dt
 
 
 def main():
-    items = make_items(2048)
-    jax_tps = bench_jax(items)
-    cpu_tps = bench_cpu(items[:256])
+    from plenum_tpu.tools.local_pool import run_load
+
+    cpu = run_load(n_nodes=4, n_txns=300, backend="cpu")
+    jax_stats = run_load(n_nodes=4, n_txns=300, backend="jax",
+                         timeout=240.0)
+
+    cpu_tps = cpu["tps"] or 1e-9
     print(json.dumps({
-        "metric": "ed25519_batch_verify_throughput",
-        "value": round(jax_tps, 1),
-        "unit": "sigs/s",
-        "vs_baseline": round(jax_tps / cpu_tps, 3) if cpu_tps else 0.0,
+        "metric": "pool_write_tps_4node",
+        "value": jax_stats["tps"],
+        "unit": "txns/s",
+        "vs_baseline": round(jax_stats["tps"] / cpu_tps, 3),
+        "cpu_tps": cpu["tps"],
+        "cpu_p50_ms": cpu["p50_latency_ms"],
+        "jax_p50_ms": jax_stats["p50_latency_ms"],
+        "jax_ordered": jax_stats["txns_ordered"],
+        "ledgers_agree": bool(cpu["ledger_sizes_agree"]
+                              and jax_stats["ledger_sizes_agree"]),
     }))
 
 

@@ -113,12 +113,6 @@ class ConsensusSharedData:
         if batch_id not in self.prepared:
             self.prepared.append(batch_id)
 
-    def free_batch(self, batch_id: BatchID) -> None:
-        if batch_id in self.preprepared:
-            self.preprepared.remove(batch_id)
-        if batch_id in self.prepared:
-            self.prepared.remove(batch_id)
-
     def reset_in_flight(self) -> None:
         self.preprepared.clear()
         self.prepared.clear()

@@ -39,7 +39,6 @@ from .batch_executor import AppliedBatch, BatchExecutor
 from .batch_id import BatchID
 from .bls_bft_replica import BlsBftReplica
 from .consensus_shared_data import ConsensusSharedData
-from .primary_selector import RoundRobinPrimariesSelector
 
 
 def _orig_view(pp: PrePrepare) -> int:
@@ -238,22 +237,14 @@ class OrderingService:
 
     def _apply(self, ledger_id, reqs, pp_time, view_no, pp_seq_no) -> AppliedBatch:
         if self._data.is_master and self._executor is not None:
+            # primaries resolution is the executor's: the audit ledger is
+            # the exact historical record (write_manager._resolve_primaries)
             return self._executor.apply_batch(
                 ledger_id, reqs, pp_time, view_no, pp_seq_no,
-                primaries=self._primaries_for_view(view_no))
+                primaries=(list(self._data.primaries)
+                           if view_no == self._data.view_no else None))
         digests = tuple(r.digest for r in reqs)
         return AppliedBatch("", "", "", "", digests, ())
-
-    def _primaries_for_view(self, view_no: int) -> list[str]:
-        """Primaries the audit txn must snapshot for a batch ORIGINATING in
-        view_no. Round-robin selection is a pure function of (view,
-        validators), so every node reconstructs the same list when
-        re-applying a re-ordered batch after one or more view changes."""
-        if view_no == self._data.view_no:
-            return list(self._data.primaries)
-        return RoundRobinPrimariesSelector().select_primaries(
-            view_no, max(1, len(self._data.primaries)),
-            self._data.validators)
 
     def _last_state_root(self, ledger_id: int) -> str:
         """State root of the previous batch on this ledger (what the previous
@@ -406,7 +397,8 @@ class OrderingService:
             orig = _orig_view(msg)
             applied = self._executor.apply_batch(
                 msg.ledger_id, reqs, msg.pp_time, orig, msg.pp_seq_no,
-                primaries=self._primaries_for_view(orig))
+                primaries=(list(self._data.primaries)
+                           if orig == self._data.view_no else None))
             fault = None
             if tuple(applied.discarded) != tuple(msg.discarded):
                 fault = Suspicions.PPR_REJECT_WRONG
@@ -818,7 +810,9 @@ class OrderingService:
                     self._executor.apply_batch(
                         new_pp.ledger_id, reqs, new_pp.pp_time,
                         orig_view, pp_seq_no,
-                        primaries=self._primaries_for_view(orig_view))
+                        primaries=(list(self._data.primaries)
+                                   if orig_view == self._data.view_no
+                                   else None))
                     self._applied_unordered.append(
                         (new_pp.ledger_id,
                          BatchID(self._data.view_no, orig_view, pp_seq_no, digest)))

@@ -227,7 +227,11 @@ class JaxEd25519Verifier(Ed25519Verifier):
         return verdict
 
 
-def make_verifier(backend: str) -> Ed25519Verifier:
+def make_verifier(backend: str, min_batch: int = 1) -> Ed25519Verifier:
+    """min_batch (jax only): pad every dispatch to at least this power of
+    two. A pool node should pick one bucket covering its receive quotas so
+    XLA compiles exactly ONE program shape — recompiles at novel shapes cost
+    minutes on a tunneled TPU and starve the prod loop."""
     if backend == "jax":
-        return JaxEd25519Verifier()
+        return JaxEd25519Verifier(min_batch=min_batch)
     return CpuEd25519Verifier()

@@ -185,7 +185,7 @@ class WriteRequestManager:
             audit_txn = audit_lib.build_audit_txn(
                 self.db, view_no, pp_seq_no, pp_time, ledger_id,
                 list(primaries) if primaries is not None
-                else self._primaries_provider(),
+                else self._resolve_primaries(view_no),
                 self._node_reg_provider(), last)
             txn_lib.set_seq_no(audit_txn, audit_ledger.uncommitted_size + 1)
             audit_ledger.append_txns_to_uncommitted([audit_txn])
@@ -201,6 +201,44 @@ class WriteRequestManager:
                                if audit_ledger is not None else ""),
         }
         return valid, rejected, roots
+
+    def _resolve_primaries(self, view_no: int) -> list:
+        """Primaries the audit txn must snapshot for a batch ORIGINATING in
+        view_no. The audit ledger itself is the exact historical record: a
+        txn from that view carries the primaries then in force, and a txn
+        from an earlier view carries the node registry current at the
+        boundary — the round-robin rule over THAT registry reproduces the
+        selection every node made, even if membership changed since
+        (recomputing over today's validators would desynchronize re-applied
+        batches after a view change; audit roots must be reproducible)."""
+        audit = self.db.get_ledger(AUDIT_LEDGER_ID)
+        if audit is not None:
+            staged = list(audit.uncommitted_txns)
+            newest_first = list(reversed(staged))
+            lo = max(1, audit.size - 400)          # bounded scan (LOG_SIZE)
+            for seq in range(audit.size, lo - 1, -1):
+                newest_first.append(audit.get_by_seq_no(seq))
+            for txn in newest_first:
+                data = txn_lib.txn_data(txn)
+                v = data.get("viewNo", 0)
+                if v > view_no:
+                    continue
+                if v == view_no:
+                    return list(data.get("primaries", []))
+                node_reg = list(data.get("nodeReg", []))
+                count = max(1, len(data.get("primaries", [])))
+                if node_reg:
+                    return [node_reg[(view_no + i) % len(node_reg)]
+                            for i in range(count)]
+                break
+        # empty audit (the very first batches): round-robin over the current
+        # registry — NOT the caller's current primaries, which depend on the
+        # caller's view and would desynchronize re-applies after a VC
+        reg = sorted(self._node_reg_provider())
+        count = max(1, len(self._primaries_provider()))
+        if reg:
+            return [reg[(view_no + i) % len(reg)] for i in range(count)]
+        return self._primaries_provider()
 
     def _last_uncommitted_audit(self, audit_ledger) -> Optional[dict]:
         staged = audit_ledger.uncommitted_txns

@@ -58,6 +58,7 @@ class NodeComponents(NamedTuple):
     bls_signer: Optional[BlsCryptoSigner]
     bls_register: BlsKeyRegister
     bls_store: BlsStore
+    plugins: list = []          # effective plugin objects (init'd by Node)
 
 
 class NodeBootstrap:
@@ -67,12 +68,24 @@ class NodeBootstrap:
                  genesis_txns: Optional[dict[int, Sequence[dict]]] = None,
                  data_dir: Optional[str] = None,
                  crypto_backend: str = "cpu",
-                 bls_seed: Optional[bytes] = None):
+                 bls_seed: Optional[bytes] = None,
+                 verifier_min_batch: int = 128,
+                 storage_backend: str = "native",
+                 plugins=None):
         self.name = name
         self.genesis = genesis_txns or {}
         self.data_dir = data_dir
         self.crypto_backend = crypto_backend
+        # durable stores: "native" = the C++ log-structured engine
+        # (LevelDB/RocksDB slot), "file" = the pure-python append log
+        self.storage_backend = storage_backend
+        # extension handlers (ref plugin_loader.py); merged with the
+        # globally-registered set at build time
+        self.plugins = list(plugins or [])
         self.bls_seed = bls_seed or name.encode().ljust(32, b"\0")[:32]
+        # one fixed device-program shape covering the receive quotas: novel
+        # shapes recompile, which costs minutes on a tunneled TPU
+        self.verifier_min_batch = verifier_min_batch
 
     # --- storage factories -------------------------------------------------
 
@@ -80,7 +93,29 @@ class NodeBootstrap:
         if self.data_dir is None:
             return KvMemory()
         os.makedirs(self.data_dir, exist_ok=True)
-        return KvFile(os.path.join(self.data_dir, label))
+        path = os.path.join(self.data_dir, label)
+        has_native = os.path.exists(os.path.join(path, "kv.kvn"))
+        has_file = os.path.exists(os.path.join(path, "kv.kvlog"))
+        if self.storage_backend == "native" or has_native:
+            from plenum_tpu.storage.kv_native import (KvNative,
+                                                      native_available)
+            if native_available():
+                if has_file and not has_native:
+                    # existing KvFile data: honor the on-disk format rather
+                    # than silently opening an empty native store
+                    return KvFile(path)
+                return KvNative(path)
+            if has_native:
+                # NEVER silently restart from genesis because the toolchain
+                # went away: the durable data is in the native format
+                raise RuntimeError(
+                    f"{path} holds native-engine data but the native "
+                    f"kvstore is unavailable (g++ build failed?)")
+            import logging
+            logging.getLogger(__name__).warning(
+                "native kvstore unavailable; falling back to the "
+                "pure-python file log for %s", path)
+        return KvFile(path)
 
     def _ledger(self, ledger_id: int, label: str) -> Ledger:
         # crypto_backend routes to EVERY ledger's tree hasher — with "jax"
@@ -128,12 +163,20 @@ class NodeBootstrap:
         read_manager.register_handler(GetTxnAuthorAgreementAmlHandler(db))
         read_manager.register_handler(GetFrozenLedgersHandler(db))
 
+        # plugins contribute extra txn types before genesis replay so
+        # plugin txns can even appear in genesis (ref plugin_loader.py)
+        from plenum_tpu.plugins import install_plugins
+        self.effective_plugins = install_plugins(
+            db, write_manager, read_manager, self.plugins)
+
         self._replay_genesis_state(db, nym, node_handler, write_manager)
 
         # client authN over the Ed25519 provider seam (cpu | jax)
         authnr = ReqAuthenticator()
         authnr.register_authenticator(CoreAuthNr(
-            make_verifier(self.crypto_backend), get_verkey=nym.get_verkey))
+            make_verifier(self.crypto_backend,
+                          min_batch=self.verifier_min_batch),
+            get_verkey=nym.get_verkey))
 
         # BLS: signer from seed; registry fed from pool state
         bls_signer = BlsCryptoSigner(seed=self.bls_seed)
@@ -144,7 +187,8 @@ class NodeBootstrap:
         executor = LedgerBatchExecutor(write_manager)
         return NodeComponents(db, write_manager, read_manager, executor,
                               authnr, pool_manager, nym, node_handler,
-                              bls_signer, bls_register, bls_store)
+                              bls_signer, bls_register, bls_store,
+                              self.effective_plugins)
 
     def _replay_genesis_state(self, db, nym, node_handler, wm) -> None:
         """Replay committed ledger txns through handlers into state (restart

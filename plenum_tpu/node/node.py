@@ -182,6 +182,10 @@ class Node:
         # (ref node.py:1830,1875 — the same restore catchup applies later)
         self._restore_3pc_from_audit()
 
+        # plugins get the finished node last (ref plugin init hooks)
+        from plenum_tpu.plugins import init_plugins
+        init_plugins(self, getattr(components, "plugins", []))
+
     def _restore_3pc_from_audit(self) -> None:
         from plenum_tpu.execution.handlers import audit as audit_lib
         audit = self.c.db.get_ledger(AUDIT_LEDGER_ID)

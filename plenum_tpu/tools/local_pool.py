@@ -103,7 +103,8 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
         for node in nodes.values():
             node.prod()
 
-    # warmup: one txn end-to-end (compiles jax kernels, fills caches)
+    # warmup: one txn end-to-end (compiles the single fixed-shape jax
+    # program, fills the per-verkey point caches)
     warm = requests.pop()
     submit_times = {}
     for n in names:
@@ -125,7 +126,7 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     while done < n_txns and time.perf_counter() < deadline:
         # feed in chunks so the propagate pipeline stays busy but inboxes
         # don't balloon
-        while next_submit < n_txns and next_submit - done < 50:
+        while next_submit < n_txns and next_submit - done < 100:
             req = requests[next_submit]
             submit_times[req.digest] = time.perf_counter()
             for n in names:

@@ -70,7 +70,8 @@ class Pool:
         components = NodeBootstrap(
             name, genesis_txns=self.genesis,
             data_dir=self._node_data_dir(name),
-            crypto_backend=self.config.crypto_backend).build()
+            crypto_backend=self.config.crypto_backend,
+            storage_backend=self.config.kv_backend).build()
         self.nodes[name] = Node(
             name, self.timer, bus, components,
             client_send=lambda msg, client, n=name:
@@ -79,10 +80,11 @@ class Pool:
         return self.nodes[name]
 
     def crash_node(self, name: str) -> None:
-        """Hard-stop: drop the node object with NO clean shutdown; its
-        durable files keep whatever was committed."""
-        node = self.nodes.pop(name)
-        node.c.db.close()
+        """Hard-stop: drop the node object with NO clean shutdown (no
+        close, no compaction) — the durable files are left exactly as the
+        last flushed write; the dropped handles leak until GC, as in a
+        real crash."""
+        self.nodes.pop(name)
         self.net.remove_peer(name)
 
     def run(self, seconds=5.0, step=0.1):
@@ -206,3 +208,33 @@ def test_audit_ledger_tracks_batches(pool):
     view_no, pp_seq_no, primaries = audit_lib.last_audited_view(audit)
     assert view_no == 0 and pp_seq_no >= 1
     assert primaries == pool.nodes["Alpha"].master_replica.data.primaries
+
+
+@pytest.mark.slow
+def test_pool_jax_backend_end_to_end():
+    """The full 4-node pool with crypto_backend=jax: every client signature
+    is verified by the device kernel (one fixed-shape dispatch per prod
+    cycle) and every ledger uses the jax-backed tree hasher. Slow: the
+    kernel compiles once for the pool's dispatch bucket."""
+    pool = Pool(config=Config(Max3PCBatchWait=0.05, crypto_backend="jax"))
+    assert type(pool.nodes["Alpha"].c.authenticator.core_authenticator
+                .verifier).__name__ == "JaxEd25519Verifier"
+    user = Ed25519Signer(seed=b"jax-pool-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(10.0)
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.names}
+    assert sizes == {2}, sizes
+    roots = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+             for n in pool.names}
+    assert len(roots) == 1
+    assert pool.replies("Alpha")
+
+    # a bad signature is rejected by the SAME device path
+    bad = signed_nym(pool.trustee, Ed25519Signer(
+        seed=b"jax-bad-user".ljust(32, b"\0")), 2)
+    bad.signature = bad.signature[:-2] + "11"
+    pool.submit(bad)
+    pool.run(3.0)
+    from plenum_tpu.common.node_messages import RequestNack
+    assert pool.replies("Alpha", RequestNack)

@@ -19,7 +19,7 @@ from test_pool import Pool, signed_nym
 
 
 def _file_pool(tmp_path, **kw):
-    return Pool(config=Config(Max3PCBatchWait=0.05, kv_backend="file"),
+    return Pool(config=Config(Max3PCBatchWait=0.05, kv_backend="native"),
                 data_dir=str(tmp_path), **kw)
 
 
@@ -102,8 +102,12 @@ def test_whole_pool_restart_resumes_without_catchup(tmp_path):
 
 
 def test_restart_discards_uncommitted_tail(tmp_path):
-    """A torn write in the ledger log must not poison recovery: the file KV
-    drops the torn tail and the node restarts from the last durable record."""
+    from plenum_tpu.storage.kv_native import native_available
+    if not native_available():
+        pytest.skip("native kvstore engine unavailable")
+    """A torn write in the ledger log must not poison recovery: the native
+    KV engine drops the torn tail (CRC + truncation) and the node restarts
+    from the last durable record."""
     import os
 
     pool = _file_pool(tmp_path)
@@ -114,7 +118,7 @@ def test_restart_discards_uncommitted_tail(tmp_path):
     pool.crash_node(victim)
 
     # tear the tail of the domain ledger log (crash mid-write)
-    log = os.path.join(str(tmp_path), victim, "domain_log", "kv.kvlog")
+    log = os.path.join(str(tmp_path), victim, "domain_log", "kv.kvn")
     file_size = os.path.getsize(log)
     os.truncate(log, file_size - 3)
 
