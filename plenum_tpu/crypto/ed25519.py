@@ -34,6 +34,18 @@ from plenum_tpu.ops import ed25519 as _ops
 VerifyItem = tuple[bytes, bytes, bytes]   # (message, signature64, verkey32)
 
 
+class _JaxToken:
+    """In-flight device verification: the dispatched verdict array plus the
+    mapping back to the caller's item order."""
+
+    __slots__ = ("ok", "idxs", "n")
+
+    def __init__(self, ok, idxs, n):
+        self.ok = ok
+        self.idxs = idxs
+        self.n = n
+
+
 class Ed25519Signer:
     """Deterministic Ed25519 signing from a 32-byte seed."""
 
@@ -77,6 +89,19 @@ class Ed25519Verifier(ABC):
 
     def verify(self, msg: bytes, sig: bytes, vk: bytes) -> bool:
         return bool(self.verify_batch([(msg, sig, vk)])[0])
+
+    # --- async pipelining seam -------------------------------------------
+    # The device backend overrides these so a caller can overlap the device
+    # round-trip with other work (accumulate-then-flush, SURVEY.md §7):
+    # submit returns immediately after dispatch; collect(wait=False) returns
+    # None while the device is still computing. The default (CPU) behavior
+    # computes at submit, so collect is always immediately ready.
+
+    def submit_batch(self, items: Sequence[VerifyItem]):
+        return self.verify_batch(items)
+
+    def collect_batch(self, token, wait: bool = True) -> Optional[np.ndarray]:
+        return token
 
 
 def _precheck(msg, sig, vk) -> bool:
@@ -169,7 +194,7 @@ class JaxEd25519Verifier(Ed25519Verifier):
         return ((_ops.P - _ops.limbs_to_int(rows[0][0])) % _ops.P,
                 _ops.limbs_to_int(rows[0][1]))
 
-    def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
+    def _dispatch(self, items: Sequence[VerifyItem]):
         import jax.numpy as jnp
         n = len(items)
         verdict = np.zeros(n, dtype=bool)
@@ -197,7 +222,7 @@ class JaxEd25519Verifier(Ed25519Verifier):
             a_rows.append(rows)
             r_enc.append(sig[:32])
         if not idxs:
-            return verdict
+            return verdict                     # all malformed: ready ndarray
         m = len(idxs)
         m_pad = 1
         while m_pad < max(m, self._min_batch):
@@ -217,14 +242,31 @@ class JaxEd25519Verifier(Ed25519Verifier):
         a0 = [np.stack([r[0][c] for r in a_rows]) for c in range(3)]
         a1 = [np.stack([r[1][c] for r in a_rows]) for c in range(3)]
         ry, r_sign = _ops.r_bytes_to_limbs(r_enc)
-        ok = np.asarray(_ops.verify_kernel(
+        ok = _ops.verify_kernel(
             jnp.asarray(s_digits), jnp.asarray(h0_digits),
             jnp.asarray(h1_digits),
             *(jnp.asarray(a) for a in a0), *(jnp.asarray(a) for a in a1),
-            jnp.asarray(ry), jnp.asarray(r_sign)))
-        for j, i in enumerate(idxs):
+            jnp.asarray(ry), jnp.asarray(r_sign))
+        return _JaxToken(ok, idxs, n)
+
+    # verify_batch = submit + blocking collect; submit_batch returns right
+    # after the (asynchronous) device dispatch
+    def submit_batch(self, items: Sequence[VerifyItem]):
+        return self._dispatch(items)
+
+    def collect_batch(self, token, wait: bool = True) -> Optional[np.ndarray]:
+        if isinstance(token, np.ndarray):
+            return token                       # empty/hard-fail fast path
+        if not wait and not token.ok.is_ready():
+            return None
+        ok = np.asarray(token.ok)
+        verdict = np.zeros(token.n, dtype=bool)
+        for j, i in enumerate(token.idxs):
             verdict[i] = bool(ok[j])
         return verdict
+
+    def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        return self.collect_batch(self.submit_batch(items), wait=True)
 
 
 def make_verifier(backend: str, min_batch: int = 1) -> Ed25519Verifier:

@@ -93,12 +93,11 @@ class CoreAuthNr:
             raise InvalidSignature(f"request {request.req_id} failed auth")
         return list(request.all_signatures())
 
-    def authenticate_batch(self, requests: Sequence[Request]) -> np.ndarray:
-        """ONE device dispatch for all signatures of all requests -> bool[N].
-
-        A request passes only if EVERY signer's signature verifies (multi-sig
-        endorsement semantics, ref client_authn.py authenticate_multi:84).
-        """
+    def submit_batch(self, requests: Sequence[Request]):
+        """Stage ONE device dispatch for all signatures of all requests;
+        returns an opaque token for collect_batch. The dispatch is
+        asynchronous on the jax backend — callers can overlap the device
+        round-trip with other work (the node's pipelined prod loop does)."""
         spans: list[tuple[int, int]] = []       # [start, end) into items
         items: list[tuple[bytes, bytes, bytes]] = []
         hard_fail = np.zeros(len(requests), dtype=bool)
@@ -113,15 +112,28 @@ class CoreAuthNr:
                 continue
             spans.append((len(items), len(items) + len(got)))
             items.extend(got)
-        if items:
-            ok = self.verifier.verify_batch(items)
+        vtoken = self.verifier.submit_batch(items) if items else None
+        return (spans, hard_fail, vtoken, len(requests))
+
+    def collect_batch(self, token, wait: bool = True) -> Optional[np.ndarray]:
+        """-> bool[N] verdicts, or None if wait=False and the device is
+        still computing. A request passes only if EVERY signer's signature
+        verifies (multi-sig endorsement, ref authenticate_multi:84)."""
+        spans, hard_fail, vtoken, n = token
+        if vtoken is not None:
+            ok = self.verifier.collect_batch(vtoken, wait=wait)
+            if ok is None:
+                return None
         else:
             ok = np.zeros(0, dtype=bool)
-        out = np.zeros(len(requests), dtype=bool)
+        out = np.zeros(n, dtype=bool)
         for i, (start, end) in enumerate(spans):
             out[i] = (not hard_fail[i]) and bool(ok[start:end].all()) \
                 and end > start
         return out
+
+    def authenticate_batch(self, requests: Sequence[Request]) -> np.ndarray:
+        return self.collect_batch(self.submit_batch(requests), wait=True)
 
 
 class ReqAuthenticator:
@@ -149,3 +161,19 @@ class ReqAuthenticator:
         for a in self._authnrs:
             verdict &= a.authenticate_batch(requests)
         return verdict
+
+    def submit_batch(self, requests: Sequence[Request]):
+        return [a.submit_batch(requests) for a in self._authnrs]
+
+    def collect_batch(self, tokens, wait: bool = True) -> Optional[np.ndarray]:
+        """None while ANY registered authenticator's device is busy."""
+        verdicts = []
+        for a, token in zip(self._authnrs, tokens):
+            v = a.collect_batch(token, wait=wait)
+            if v is None:
+                return None
+            verdicts.append(v)
+        out = verdicts[0]
+        for v in verdicts[1:]:
+            out &= v
+        return out

@@ -128,6 +128,17 @@ class Node:
         # highest pp_seq_no this node has executed (via ordering OR catchup);
         # an Ordered re-emitted for a re-certified batch must not double-commit
         self._last_executed_pp_seq = 0
+        # pipelined signature verification: one in-flight device dispatch per
+        # pipeline; while a dispatch is computing, the prod loop keeps doing
+        # consensus work instead of blocking on the device round-trip
+        # (accumulate-then-flush, SURVEY.md §7 stage 6). After MAX_AUTH_POLLS
+        # unproductive polls the collect BLOCKS: prod loops that spin faster
+        # than the device computes (MockTimer sims) must not starve the
+        # pipeline forever, and a wedged dispatch must surface, not hang the
+        # inbox silently.
+        self.MAX_AUTH_POLLS = 50
+        self._auth_inflight = None      # (token, items, polls)
+        self._prop_inflight = None
         # inboxes (quota-drained each prod; ref zstack quotas config.py:250)
         self._client_inbox: list[tuple[dict, str]] = []
         self._propagate_inbox: list[tuple[Propagate, str]] = []
@@ -414,6 +425,13 @@ class Node:
     # --- client pipeline --------------------------------------------------
 
     def _service_client_msgs(self) -> int:
+        # finish last cycle's device dispatch first; while it's still
+        # computing, leave the inbox queued (natural backpressure) and let
+        # the rest of the prod cycle run
+        self._auth_inflight, count = self._poll_inflight(
+            self._auth_inflight, self._finish_client_auth)
+        if self._auth_inflight is not None:
+            return 0
         quota = self.config.LISTENER_MESSAGE_QUOTA
         batch, self._client_inbox = (self._client_inbox[:quota],
                                      self._client_inbox[quota:])
@@ -430,14 +448,27 @@ class Node:
             if self.c.read_manager.is_query_type(request.txn_type):
                 self._answer_query(request, frm)
             elif self.c.write_manager.is_write_type(request.txn_type):
+                try:
+                    self.c.write_manager.static_validation(request)
+                except InvalidClientRequest as e:
+                    self._client_send(RequestNack(
+                        identifier=request.identifier,
+                        req_id=request.req_id, reason=e.reason), frm)
+                    continue
                 to_auth.append((request, frm))
             else:
                 self._client_send(RequestNack(
                     identifier=request.identifier, req_id=request.req_id,
                     reason=f"unknown txn type {request.txn_type!r}"), frm)
         if to_auth:
-            self._auth_and_propagate(to_auth)
-        return len(batch)
+            self._auth_inflight = self._submit_auth(
+                to_auth, [r for r, _ in to_auth], self._finish_client_auth)
+            if self._auth_inflight is not None:
+                # deferred items are counted when their verdicts land, so
+                # the work count (and CLIENT_MSGS/PROPAGATES metrics) stay
+                # 1x regardless of backend
+                return count + len(batch) - len(to_auth)
+        return count + len(batch)
 
     def _answer_query(self, request: Request, frm: str) -> None:
         try:
@@ -456,24 +487,11 @@ class Node:
             return
         self._client_send(Reply(result=result), frm)
 
-    def _auth_and_propagate(self, items: list[tuple[Request, str]]) -> None:
-        """Batch-verify client signatures, then ack+propagate the valid ones
-        (ref processRequest:2000 → recordAndPropagate)."""
-        requests = [r for r, _ in items]
-        statics_ok = []
-        for req, frm in items:
-            try:
-                self.c.write_manager.static_validation(req)
-                statics_ok.append(True)
-            except InvalidClientRequest as e:
-                self._client_send(RequestNack(identifier=req.identifier,
-                                              req_id=req.req_id,
-                                              reason=e.reason), frm)
-                statics_ok.append(False)
-        verdicts = self.c.authenticator.authenticate_batch(requests)
-        for (req, frm), ok, st in zip(items, verdicts, statics_ok):
-            if not st:
-                continue
+    def _finish_client_auth(self, items: list[tuple[Request, str]],
+                            verdicts) -> None:
+        """Ack + propagate statically-valid requests whose signatures the
+        device accepted (ref processRequest:2000 → recordAndPropagate)."""
+        for (req, frm), ok in zip(items, verdicts):
             if not ok:
                 self._client_send(RequestNack(identifier=req.identifier,
                                               req_id=req.req_id,
@@ -507,6 +525,13 @@ class Node:
     # --- node pipeline ----------------------------------------------------
 
     def _service_propagates(self) -> int:
+        # pipelined like the client path: finish the in-flight device
+        # dispatch; while busy, keep the inbox queued (propagate votes are
+        # order-insensitive, so interleaving with fresh drains is safe)
+        self._prop_inflight, count = self._poll_inflight(
+            self._prop_inflight, self._finish_propagate_auth)
+        if self._prop_inflight is not None:
+            return 0
         quota = self.config.REMOTES_MESSAGE_QUOTA
         batch, self._propagate_inbox = (self._propagate_inbox[:quota],
                                         self._propagate_inbox[quota:])
@@ -528,17 +553,56 @@ class Node:
                 continue     # late propagate of an already-executed request
             else:
                 to_auth.append((msg, frm, request))
-        if to_auth:
-            verdicts = self.c.authenticator.authenticate_batch(
-                [r for _, _, r in to_auth])
-            for (msg, frm, req), ok in zip(to_auth, verdicts):
-                if ok:
-                    verified.append((msg, frm, req))
-                else:
-                    self.spylog.append(("suspicious_propagate", frm))
         for msg, frm, _ in verified:
             self.propagator.process_propagate(msg, frm)
-        return len(batch)
+        if to_auth:
+            self._prop_inflight = self._submit_auth(
+                to_auth, [r for _, _, r in to_auth],
+                self._finish_propagate_auth)
+            if self._prop_inflight is not None:
+                return count + len(batch) - len(to_auth)
+        return count + len(batch)
+
+    def _finish_propagate_auth(self, pending, verdicts) -> None:
+        for (msg, frm, req), ok in zip(pending, verdicts):
+            if not ok:
+                self.spylog.append(("suspicious_propagate", frm))
+                continue
+            # verdicts can be up to MAX_AUTH_POLLS prods stale: a catchup
+            # may have committed the request meanwhile — re-check the
+            # executed guard the drain applied, or a late propagate would
+            # resurrect request state for an already-executed txn
+            if req.digest not in self.propagator.requests and \
+                    self._executed_txn(req) is not None:
+                continue
+            self.propagator.process_propagate(msg, frm)
+
+    # --- pipelined device-auth plumbing -----------------------------------
+
+    def _poll_inflight(self, inflight, finish):
+        """-> (inflight', n_finished): poll a pending device dispatch,
+        blocking once it has been polled MAX_AUTH_POLLS times (prod loops
+        that spin faster than the device computes — MockTimer sims — must
+        not starve the pipeline, and a wedged dispatch must surface)."""
+        if inflight is None:
+            return None, 0
+        token, pending, polls = inflight
+        verdicts = self.c.authenticator.collect_batch(
+            token, wait=polls >= self.MAX_AUTH_POLLS)
+        if verdicts is None:
+            return (token, pending, polls + 1), 0
+        finish(pending, verdicts)
+        return None, len(pending)
+
+    def _submit_auth(self, items, requests, finish):
+        """Dispatch a signature batch; -> in-flight state or None if the
+        verdicts were ready immediately (CPU backend)."""
+        token = self.c.authenticator.submit_batch(requests)
+        verdicts = self.c.authenticator.collect_batch(token, wait=False)
+        if verdicts is None:
+            return (token, items, 0)
+        finish(items, verdicts)
+        return None
 
     # --- ordered batches --------------------------------------------------
 

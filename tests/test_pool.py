@@ -235,6 +235,6 @@ def test_pool_jax_backend_end_to_end():
         seed=b"jax-bad-user".ljust(32, b"\0")), 2)
     bad.signature = bad.signature[:-2] + "11"
     pool.submit(bad)
-    pool.run(3.0)
+    pool.run(8.0)     # > MAX_AUTH_POLLS prods so the pipelined collect blocks
     from plenum_tpu.common.node_messages import RequestNack
     assert pool.replies("Alpha", RequestNack)
