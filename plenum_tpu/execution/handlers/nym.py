@@ -102,6 +102,10 @@ class NymHandler(WriteRequestHandler):
         rec = self._read(did, committed=committed)
         return rec.get("verkey") if rec else None
 
+    def get_role(self, did: str, committed: bool = True) -> Optional[str]:
+        rec = self._read(did, committed=committed)
+        return rec.get("role") if rec else None
+
 
 class GetNymHandler(ReadRequestHandler):
     def __init__(self, db):

@@ -59,6 +59,7 @@ class NodeComponents(NamedTuple):
     bls_register: BlsKeyRegister
     bls_store: BlsStore
     plugins: list = []          # effective plugin objects (init'd by Node)
+    action_manager: object = None
 
 
 class NodeBootstrap:
@@ -163,6 +164,11 @@ class NodeBootstrap:
         read_manager.register_handler(GetTxnAuthorAgreementAmlHandler(db))
         read_manager.register_handler(GetFrozenLedgersHandler(db))
 
+        # action requests: privileged, node-local, no consensus
+        # (ref action_request_manager.py; Node registers its own handlers)
+        from plenum_tpu.execution.action_manager import ActionRequestManager
+        action_manager = ActionRequestManager(get_role=nym.get_role)
+
         # plugins contribute extra txn types before genesis replay so
         # plugin txns can even appear in genesis (ref plugin_loader.py)
         from plenum_tpu.plugins import install_plugins
@@ -188,7 +194,7 @@ class NodeBootstrap:
         return NodeComponents(db, write_manager, read_manager, executor,
                               authnr, pool_manager, nym, node_handler,
                               bls_signer, bls_register, bls_store,
-                              self.effective_plugins)
+                              self.effective_plugins, action_manager)
 
     def _replay_genesis_state(self, db, nym, node_handler, wm) -> None:
         """Replay committed ledger txns through handlers into state (restart

@@ -193,6 +193,12 @@ class Node:
         # (ref node.py:1830,1875 — the same restore catchup applies later)
         self._restore_3pc_from_audit()
 
+        # built-in actions need the finished node (ref validator_info_tool)
+        from plenum_tpu.execution.action_manager import ValidatorInfoAction
+        self.action_manager = components.action_manager
+        if self.action_manager is not None:
+            self.action_manager.register_handler(ValidatorInfoAction(self))
+
         # plugins get the finished node last (ref plugin init hooks)
         from plenum_tpu.plugins import init_plugins
         init_plugins(self, getattr(components, "plugins", []))
@@ -447,6 +453,10 @@ class Node:
                 continue
             if self.c.read_manager.is_query_type(request.txn_type):
                 self._answer_query(request, frm)
+            elif self.action_manager is not None and \
+                    self.action_manager.is_action_type(request.txn_type):
+                # actions authenticate like writes but execute locally
+                to_auth.append((request, frm))
             elif self.c.write_manager.is_write_type(request.txn_type):
                 try:
                     self.c.write_manager.static_validation(request)
@@ -497,6 +507,24 @@ class Node:
                                               req_id=req.req_id,
                                               reason="signature verification failed"),
                                   frm)
+                continue
+            if self.action_manager is not None and \
+                    self.action_manager.is_action_type(req.txn_type):
+                # actions execute on THIS node only: no propagate, no 3PC
+                try:
+                    result = self.action_manager.process(req)
+                except InvalidClientRequest as e:
+                    self._client_send(RequestNack(
+                        identifier=req.identifier, req_id=req.req_id,
+                        reason=e.reason), frm)
+                    continue
+                except UnauthorizedClientRequest as e:
+                    # well-formed but refused -> REJECT, never NACK
+                    self._client_send(Reject(
+                        identifier=req.identifier, req_id=req.req_id,
+                        reason=e.reason), frm)
+                    continue
+                self._client_send(Reply(result=result), frm)
                 continue
             # dedup: an already-executed request gets its Reply resent
             # (durable lookup via the seq-no DB, ref node.py:2000 seqNoMap)

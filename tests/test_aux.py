@@ -183,3 +183,44 @@ def test_observer_refuses_tampered_batch():
     # refusal reverted cleanly: the honest batch still applies
     assert observer.process_batch(batch)
     assert observer.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2
+
+
+# --- action requests ------------------------------------------------------
+
+def test_validator_info_action_requires_privilege():
+    from plenum_tpu.common.node_messages import Reject, Reply, RequestNack
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.action_manager import VALIDATOR_INFO_ACTION
+
+    pool = Pool()
+    # trustee invokes the action: executes locally, no consensus round
+    req = Request(pool.trustee.identifier, 1,
+                  {"type": VALIDATOR_INFO_ACTION})
+    req.signature = pool.trustee.sign_b58(req.signing_bytes())
+    pool.submit(req, to=["Alpha"])
+    pool.run(2.0)
+    replies = [m for m, _ in pool.client_msgs["Alpha"]
+               if isinstance(m, Reply)
+               and m.result.get("type") == VALIDATOR_INFO_ACTION]
+    assert replies, "no validator-info reply"
+    info = replies[0].result["data"]
+    assert info["name"] == "Alpha" and info["f"] == 1
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    # the action itself wrote NO txn (local execution, no consensus)
+    assert pool.nodes["Alpha"].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 1
+
+    # an unprivileged (but registered and validly signed) identity is
+    # refused by the authorization check, not the signature check
+    nobody = Ed25519Signer(seed=b"action-nobody".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, nobody, 2))
+    pool.run(3.0)
+    req2 = Request(nobody.identifier, 1, {"type": VALIDATOR_INFO_ACTION})
+    req2.signature = nobody.sign_b58(req2.signing_bytes())
+    pool.submit(req2, to=["Alpha"])
+    pool.run(2.0)
+    # well-formed but refused -> REJECT (never NACK: the NACK/REJECT wire
+    # split reserves NACK for malformed requests)
+    rejects = [m for m, _ in pool.client_msgs["Alpha"]
+               if isinstance(m, Reject) and "TRUSTEE" in m.reason]
+    assert rejects
