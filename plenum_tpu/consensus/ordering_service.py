@@ -99,7 +99,10 @@ class OrderingService:
         bus.subscribe(NewViewCheckpointsApplied,
                       self.process_new_view_checkpoints_applied)
 
-        self._batch_wait_scheduled = False
+        # ledger_id -> time the oldest queued request arrived; a partial
+        # batch is cut only after Max3PCBatchWait so small flushes coalesce
+        # (the accumulate-then-flush policy of SURVEY.md §7 stage 6)
+        self._queue_first_ts: dict[int, float] = {}
         # ledger_id -> absolute deadline for the next freshness batch
         self._freshness_deadline: dict[int, float] = {}
         # (orig_view, pp_seq_no) -> cited digest: NewView batches we lack
@@ -124,6 +127,8 @@ class OrderingService:
         ledger_id = (self._executor.ledger_id_for(req)
                      if self._executor else DOMAIN_LEDGER_ID)
         self.request_queues.setdefault(ledger_id, OrderedDict())[msg.digest] = None
+        self._queue_first_ts.setdefault(ledger_id,
+                                        self._timer.get_current_time())
         self._stasher.process_all_stashed(StashReason.MISSING_REQUESTS)
 
     # ------------------------------------------------------------------ #
@@ -176,13 +181,27 @@ class OrderingService:
         """Create and broadcast PRE-PREPAREs from queued requests
         (ref send_3pc_batch :1961). Returns number of batches sent."""
         sent = 0
+        now = self._timer.get_current_time()
         ledgers = [ledger_id] if ledger_id is not None else list(self.request_queues)
         for lid in ledgers:
             queue = self.request_queues.setdefault(lid, OrderedDict())
             if not queue and not force_empty:
+                self._queue_first_ts.pop(lid, None)
+                continue
+            # partial batches wait up to Max3PCBatchWait for more requests
+            # (full ones cut immediately) — the previously-dead batching knob
+            if (not force_empty
+                    and len(queue) < self._config.Max3PCBatchSize
+                    and now - self._queue_first_ts.get(lid, now)
+                    < self._config.Max3PCBatchWait):
                 continue
             while queue or force_empty:
                 if self._data.pp_seq_no + 1 > self._data.high_watermark:
+                    break
+                # bound the pipeline depth (ref Max3PCBatchesInFlight)
+                if (not force_empty and self._data.pp_seq_no
+                        - self._data.last_ordered_3pc[1]
+                        >= self._config.Max3PCBatchesInFlight):
                     break
                 digests = []
                 while queue and len(digests) < self._config.Max3PCBatchSize:
@@ -191,6 +210,10 @@ class OrderingService:
                 sent += 1
                 if force_empty:
                     break
+            if queue:
+                self._queue_first_ts[lid] = now     # leftovers start waiting
+            else:
+                self._queue_first_ts.pop(lid, None)
         return sent
 
     def _send_one_batch(self, ledger_id: int, digests: list[str]) -> None:
@@ -673,6 +696,10 @@ class OrderingService:
                 queue = self.request_queues.setdefault(ledger_id, OrderedDict())
                 for digest in pp.req_idr:
                     queue[digest] = None
+                # start the batch-wait clock: without this the partial-batch
+                # gate would postpone re-proposing reverted requests forever
+                self._queue_first_ts.setdefault(
+                    ledger_id, self._timer.get_current_time())
             count += 1
         return count
 

@@ -84,7 +84,11 @@ class ActionRequestManager:
             raise UnauthorizedClientRequest(
                 request.identifier, request.req_id,
                 "stale action req_id (replay?)")
-        if len(self._last_req_id) >= self.MAX_TRACKED_IDENTITIES:
+        if request.identifier not in self._last_req_id and \
+                len(self._last_req_id) >= self.MAX_TRACKED_IDENTITIES:
             self._last_req_id.pop(next(iter(self._last_req_id)))
+        # delete+insert keeps the dict ordered by recency (approximate LRU),
+        # so eviction hits the longest-idle identity, not an active one
+        self._last_req_id.pop(request.identifier, None)
         self._last_req_id[request.identifier] = request.req_id
         return handler.execute(request)
