@@ -96,3 +96,36 @@ class NodeObserver:
             primaries=(), node_reg=()))
         self.last_applied[batch.ledger_id] = batch.seq_no_end
         return True
+
+    def catch_up(self, ledger_id: int, fetch_txn, limit: int = 10_000) -> int:
+        """Fill a gap by pulling committed txns one-by-one (the observer
+        analog of the reference's client-seeder catchup; the transport-level
+        fetch is typically a GET_TXN query via PoolClient — its reply quorum
+        is the trust anchor, and the NEXT pushed batch's recomputed roots
+        revalidate the whole chain).
+
+        fetch_txn(ledger_id, seq_no) -> committed txn dict or None.
+        Applies ledger + state (the catchup replay path, not the write
+        pipeline: fetched txns are already validated history). Returns the
+        number of txns applied; stops at the first miss.
+        """
+        from plenum_tpu.execution import txn as txn_lib
+
+        ledger = self.c.db.get_ledger(ledger_id)
+        state = self.c.db.get_state(ledger_id)
+        applied = 0
+        while applied < limit:
+            txn = fetch_txn(ledger_id, ledger.size + 1)
+            if txn is None:
+                break
+            ledger.append_txns_to_uncommitted([txn])
+            ledger.commit_txns(1)
+            handler = self.c.write_manager._handlers.get(
+                txn_lib.txn_type_of(txn))
+            if handler is not None and state is not None:
+                handler.update_state(txn, is_committed=True)
+                state.commit(state.head_hash)
+            applied += 1
+        if applied:
+            self.last_applied[ledger_id] = ledger.size
+        return applied

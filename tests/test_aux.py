@@ -224,3 +224,44 @@ def test_validator_info_action_requires_privilege():
     rejects = [m for m, _ in pool.client_msgs["Alpha"]
                if isinstance(m, Reject) and "TRUSTEE" in m.reason]
     assert rejects
+
+
+def test_observer_catches_up_across_a_gap():
+    """An observer that missed pushes pulls the gap via GET_TXN-style
+    fetches, then resumes applying pushed batches."""
+    from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID,
+                                                 BatchCommitted)
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.node.observer import NodeObserver
+
+    pool = Pool()
+    node = pool.nodes["Alpha"]
+    node.observable.add_observer("obs")
+    for i in range(3):
+        user = Ed25519Signer(seed=(b"gap-u%d" % i).ljust(32, b"\0"))
+        pool.submit(signed_nym(pool.trustee, user, i + 1))
+        pool.run(3.0)
+    pushes = [m for m, c in pool.client_msgs["Alpha"]
+              if isinstance(m, BatchCommitted)]
+    assert len(pushes) == 3
+
+    observer = NodeObserver(_observer_components(pool.names))
+    # the observer only sees the LAST push: gap -> refused
+    assert not observer.process_batch(pushes[-1])
+
+    # pull the gap from the (trusted) pool ledger, GET_TXN-style
+    live = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
+
+    def fetch(ledger_id, seq_no):
+        ledger = node.c.db.get_ledger(ledger_id)
+        return ledger.get_by_seq_no(seq_no) if seq_no <= ledger.size - 1 \
+            else None          # last txn withheld: the push covers it
+
+    n = observer.catch_up(DOMAIN_LEDGER_ID, fetch)
+    assert n == 2              # genesis nym is already there; pulled 2
+
+    # now the pushed batch applies cleanly on top of the pulled history
+    assert observer.process_batch(pushes[-1])
+    obs_ledger = observer.c.db.get_ledger(DOMAIN_LEDGER_ID)
+    assert obs_ledger.size == live.size == 4
+    assert obs_ledger.root_hash == live.root_hash
