@@ -32,6 +32,12 @@ class QueueTimer(TimerService):
 
     def __init__(self, get_current_time: Callable[[], float] = time.perf_counter):
         self._get_current_time = get_current_time
+        # latched at each service(): every read within one prod cycle sees
+        # the SAME timestamp (the cycle start). Determinism requirement: a
+        # recorded run replays tick-by-tick under a mock clock, and any
+        # mid-cycle wall-clock read (e.g. a batch's pp_time, which enters
+        # the 3PC digest) would diverge between live and replay.
+        self._frozen_now: float | None = None
         self._heap: list[tuple[float, int, Callable[[], None]]] = []
         self._seq = 0  # tie-break so equal deadlines fire FIFO
         self._cancelled: set[int] = set()
@@ -42,6 +48,8 @@ class QueueTimer(TimerService):
         self._ids: dict[Callable, list[int]] = {}  # callback -> seq numbers
 
     def get_current_time(self) -> float:
+        if self._frozen_now is not None:
+            return self._frozen_now
         return self._get_current_time()
 
     def schedule(self, delay: float, callback: Callable[[], None]) -> None:
@@ -56,7 +64,8 @@ class QueueTimer(TimerService):
     def service(self) -> int:
         """Fire due callbacks; returns how many fired."""
         fired = 0
-        now = self.get_current_time()
+        self._frozen_now = self._get_current_time()
+        now = self._frozen_now
         while self._heap and self._heap[0][0] <= now:
             _, seq, cb = heappop(self._heap)
             if seq in self._cancelled:
@@ -83,6 +92,9 @@ class MockTimer(QueueTimer):
         self._now = start
         super().__init__(get_current_time=lambda: self._now)
 
+    def get_current_time(self) -> float:
+        return self._now            # mock time is already cycle-frozen
+
     def advance(self, delta: float) -> None:
         self.set_time(self._now + delta)
 
@@ -95,6 +107,13 @@ class MockTimer(QueueTimer):
 
     def advance_until(self, value: float) -> None:
         self.set_time(value)
+
+    def set_time_no_service(self, value: float) -> None:
+        """Jump the clock WITHOUT stepping through intermediate deadlines.
+        The replayer pairs this with one service() call so due callbacks
+        fire in a batch at the jump target — exactly how a live QueueTimer
+        services them at the next prod cycle's frozen time."""
+        self._now = max(self._now, value)
 
     def run_to_completion(self, max_events: int = 10000) -> None:
         for _ in range(max_events):

@@ -240,6 +240,18 @@ class WriteRequestManager:
             return [reg[(view_no + i) % len(reg)] for i in range(count)]
         return self._primaries_provider()
 
+    def apply_committed_txn(self, ledger_id: int, txn: dict,
+                            committed: bool = True) -> None:
+        """Replay an already-validated committed txn into state (the
+        catchup/observer path — no dynamic validation, no audit txn; the
+        txn's provenance is the caller's verified ledger transfer)."""
+        handler = self._handlers.get(txn_lib.txn_type_of(txn))
+        state = self.db.get_state(ledger_id)
+        if handler is not None and state is not None:
+            handler.update_state(txn, is_committed=committed)
+            if committed:
+                state.commit(state.head_hash)
+
     def _last_uncommitted_audit(self, audit_ledger) -> Optional[dict]:
         staged = audit_ledger.uncommitted_txns
         if staged:

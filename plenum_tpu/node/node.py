@@ -349,11 +349,7 @@ class Node:
     def _on_catchup_txn(self, ledger_id: int, txn: dict) -> None:
         """A catchup txn was committed to the ledger: replay it into state
         and bookkeeping (ref node.py:1748 postTxnFromCatchupAddedToLedger)."""
-        handler = self.c.write_manager._handlers.get(txn_lib.txn_type_of(txn))
-        state = self.c.db.get_state(ledger_id)
-        if handler is not None and state is not None:
-            handler.update_state(txn, is_committed=True)
-            state.commit(state.head_hash)
+        self.c.write_manager.apply_committed_txn(ledger_id, txn)
         digest = txn_lib.txn_digest(txn)
         if digest:
             self.propagator.requests.mark_executed(digest)

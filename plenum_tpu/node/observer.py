@@ -97,35 +97,68 @@ class NodeObserver:
         self.last_applied[batch.ledger_id] = batch.seq_no_end
         return True
 
-    def catch_up(self, ledger_id: int, fetch_txn, limit: int = 10_000) -> int:
-        """Fill a gap by pulling committed txns one-by-one (the observer
-        analog of the reference's client-seeder catchup; the transport-level
-        fetch is typically a GET_TXN query via PoolClient — its reply quorum
-        is the trust anchor, and the NEXT pushed batch's recomputed roots
-        revalidate the whole chain).
+    def catch_up(self, batch: BatchCommitted, fetch_txn,
+                 limit: int = 10_000) -> bool:
+        """Fill the gap below `batch` and apply the batch ATOMICALLY.
 
-        fetch_txn(ledger_id, seq_no) -> committed txn dict or None.
-        Applies ledger + state (the catchup replay path, not the write
-        pipeline: fetched txns are already validated history). Returns the
-        number of txns applied; stops at the first miss.
+        fetch_txn(ledger_id, seq_no) -> committed txn dict or None (the
+        transport is typically a GET_TXN query via PoolClient). Fetched txns
+        are staged UNCOMMITTED; the pushed batch is then applied on top and
+        its roots — which bind the ENTIRE preceding chain through the Merkle
+        tree — are compared against the push. Nothing commits until the
+        comparison passes; on any mismatch or missing txn every staged
+        change is discarded, so a Byzantine fetch peer can stall this
+        observer but never corrupt it (same invariant as validator catchup:
+        plenum_tpu/catchup/rep.py verify-before-commit).
         """
-        from plenum_tpu.execution import txn as txn_lib
+        from plenum_tpu.common.request import Request
+        from plenum_tpu.execution.write_manager import ThreePcBatch
 
-        ledger = self.c.db.get_ledger(ledger_id)
-        state = self.c.db.get_state(ledger_id)
-        applied = 0
-        while applied < limit:
-            txn = fetch_txn(ledger_id, ledger.size + 1)
+        ledger = self.c.db.get_ledger(batch.ledger_id)
+        state = self.c.db.get_state(batch.ledger_id)
+        if ledger is None or batch.seq_no_end <= ledger.size:
+            return False
+        prev_state_root = state.head_hash if state is not None else None
+
+        def discard(n_pulled: int) -> bool:
+            if n_pulled:
+                ledger.discard_txns(n_pulled)
+                if state is not None and prev_state_root is not None:
+                    state.revert_to_head(prev_state_root)
+            return False
+
+        pulled = 0
+        while ledger.size + pulled + 1 < batch.seq_no_start:
+            if pulled >= limit:
+                return discard(pulled)
+            txn = fetch_txn(batch.ledger_id, ledger.size + pulled + 1)
             if txn is None:
-                break
+                return discard(pulled)
             ledger.append_txns_to_uncommitted([txn])
-            ledger.commit_txns(1)
-            handler = self.c.write_manager._handlers.get(
-                txn_lib.txn_type_of(txn))
-            if handler is not None and state is not None:
-                handler.update_state(txn, is_committed=True)
-                state.commit(state.head_hash)
-            applied += 1
-        if applied:
-            self.last_applied[ledger_id] = ledger.size
-        return applied
+            self.c.write_manager.apply_committed_txn(
+                batch.ledger_id, txn, committed=False)
+            pulled += 1
+
+        requests = [Request.from_dict(r) for r in batch.requests]
+        valid, _rejected, roots = self.c.write_manager.apply_batch(
+            batch.ledger_id, requests, batch.pp_time, batch.view_no,
+            batch.pp_seq_no)
+        if roots["txn_root"] != batch.txn_root or \
+                roots["state_root"] != batch.state_root:
+            self.c.write_manager.revert_last_batch(batch.ledger_id)
+            return discard(pulled)
+        if pulled:
+            ledger.commit_txns(pulled)
+        self.c.write_manager.commit_batch(ThreePcBatch(
+            ledger_id=batch.ledger_id, view_no=batch.view_no,
+            pp_seq_no=batch.pp_seq_no, pp_time=batch.pp_time,
+            valid_digests=tuple(r.digest for r in valid),
+            state_root=bytes.fromhex(roots["state_root"])
+            if roots["state_root"] else b"",
+            txn_root=bytes.fromhex(roots["txn_root"])
+            if roots["txn_root"] else b"",
+            audit_txn_root=bytes.fromhex(roots["audit_txn_root"])
+            if roots["audit_txn_root"] else b"",
+            primaries=(), node_reg=()))
+        self.last_applied[batch.ledger_id] = batch.seq_no_end
+        return True

@@ -26,6 +26,9 @@ CLIENT = "C"         # client request dict
 CONNECTED = "+"      # peer connection event
 DISCONNECTED = "-"
 TICK = "T"           # a prod cycle ran at this timer time
+RUN_START = "B"      # a process (re)started recording
+
+IDLE_HEARTBEAT = 1.0     # max silence between recorded ticks
 
 
 class Recorder:
@@ -45,6 +48,7 @@ class Recorder:
         self._seq = store.size if hasattr(store, "size") else 0
         self._last_tick_ts: Optional[float] = None
         self._input_since_tick = True
+        self._sends_since_tick = 0
 
     def record(self, kind: str, frm: str, data: Any) -> None:
         if kind != TICK:
@@ -53,12 +57,26 @@ class Recorder:
         self._seq += 1
         self._store.put(key, pack([self._now(), kind, frm, data]))
 
-    def record_tick(self) -> None:
+    def note_send(self) -> None:
+        self._sends_since_tick += 1
+
+    def record_tick(self, work: int = 0) -> None:
+        """Record a tick only when the cycle DID something (ingress, node
+        work, or outbound sends — outbound catches time-driven actions like
+        freshness batches) or at a coarse idle heartbeat. A real-time node
+        prods ~500x/s; recording every idle cycle would write gigabytes a
+        day for nothing and make replay re-run them all."""
         ts = self._now()
-        if ts == self._last_tick_ts and not self._input_since_tick:
+        busy = (self._input_since_tick or work > 0
+                or self._sends_since_tick > 0)
+        if not busy and self._last_tick_ts is not None and \
+                ts - self._last_tick_ts < IDLE_HEARTBEAT:
+            return
+        if ts == self._last_tick_ts and not busy:
             return
         self._last_tick_ts = ts
         self._input_since_tick = False
+        self._sends_since_tick = 0
         self.record(TICK, "", None)
 
     def iter_records(self):
@@ -69,11 +87,23 @@ class Recorder:
 
 
 def attach_recorder(node, recorder: Recorder) -> None:
-    """Instrument a node's ingress + prod seams. Must run before traffic."""
+    """Instrument a node's ingress + prod + egress seams. Must run before
+    traffic. Appends a RUN_START boundary: replay stops at a second boundary
+    (a restarted process starts a fresh perf_counter epoch, and one replayed
+    node cannot cross it — replay the FIRST run; later runs start from the
+    restart's durable state, not genesis)."""
+    recorder.record(RUN_START, node.name, None)
     bus = node.node_bus
     orig_incoming = bus.process_incoming
     orig_client = node.handle_client_message
     orig_prod = node.prod
+    orig_send = bus.send
+
+    def counting_send(message, dst=None):
+        recorder.note_send()
+        orig_send(message, dst)
+
+    bus.send = counting_send
 
     def recording_incoming(message, frm):
         if isinstance(message, ExternalBus.Connected):
@@ -89,8 +119,11 @@ def attach_recorder(node, recorder: Recorder) -> None:
         orig_client(msg, frm)
 
     def recording_prod():
-        recorder.record_tick()
-        return orig_prod()
+        work = orig_prod()
+        # ts is the cycle's FROZEN clock value, unchanged since the cycle
+        # began, so appending the tick after the fact keeps log order
+        recorder.record_tick(work)
+        return work
 
     bus.process_incoming = recording_incoming
     node.handle_client_message = recording_client
@@ -109,9 +142,19 @@ def replay(records, node, timer) -> int:
     sink) — replay only reproduces STATE, not traffic.
     """
     n = 0
+    runs_seen = 0
     connected: set[str] = set(node.node_bus.connecteds)
     for ts, kind, frm, data in records:
-        timer.advance_until(ts)
+        if kind == RUN_START:
+            runs_seen += 1
+            if runs_seen > 1:
+                break     # next process epoch: fresh clock, fresh node state
+            continue
+        # jump WITHOUT stepping through intermediate deadlines, then service
+        # once: live QueueTimer fires due callbacks in a batch at the frozen
+        # cycle time, never at their exact deadlines — replay must match
+        timer.set_time_no_service(ts)
+        timer.service()
         if kind == TICK:
             node.prod()
         elif kind == CONNECTED:

@@ -19,7 +19,7 @@ import time
 
 
 def build_node(name: str, base_dir: str, backend: str = "cpu",
-               kv: str = "file"):
+               kv: str = "file", record: bool = False):
     """-> (prodable, node, registry) ready for a Looper."""
     from plenum_tpu.common.node_messages import POOL_LEDGER_ID
     from plenum_tpu.common.timer import QueueTimer
@@ -60,7 +60,19 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
     config = Config(crypto_backend=backend, kv_backend=kv)
     node = Node(name, timer, node_stack.bus, components,
                 client_send=client_stack.send, config=config)
-    client_stack._on_request = node.handle_client_message
+    # late-bound: the recorder may wrap handle_client_message below, and the
+    # client stack must call through the WRAPPED method
+    client_stack._on_request = \
+        lambda msg, frm: node.handle_client_message(msg, frm)
+
+    if record:
+        # the reference's STACK_COMPANION=1 mode: record every ingress +
+        # prod tick durably so tools.replay can re-run this node offline
+        from plenum_tpu.node.recorder import Recorder, attach_recorder
+        from plenum_tpu.storage.kv_file import KvFile
+        rec_dir = os.path.join(base_dir, name, "recorder")
+        attach_recorder(node, Recorder(KvFile(rec_dir),
+                                       now=timer.get_current_time))
 
     def sync_registry_from_pool():
         """Pool-ledger NODE txns drive the transport allowlist + dialing
@@ -89,10 +101,12 @@ def main(argv=None):
     ap.add_argument("--base-dir", required=True)
     ap.add_argument("--backend", default="cpu", choices=["cpu", "jax"])
     ap.add_argument("--kv", default="file", choices=["file", "memory"])
+    ap.add_argument("--record", action="store_true",
+                    help="record all ingress for offline replay")
     args = ap.parse_args(argv)
 
     prodable, node, _ = build_node(args.name, args.base_dir, args.backend,
-                                   args.kv)
+                                   args.kv, record=args.record)
     looper = Looper()
     looper.add(prodable)
 

@@ -249,19 +249,27 @@ def test_observer_catches_up_across_a_gap():
     # the observer only sees the LAST push: gap -> refused
     assert not observer.process_batch(pushes[-1])
 
-    # pull the gap from the (trusted) pool ledger, GET_TXN-style
     live = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
 
     def fetch(ledger_id, seq_no):
         ledger = node.c.db.get_ledger(ledger_id)
-        return ledger.get_by_seq_no(seq_no) if seq_no <= ledger.size - 1 \
-            else None          # last txn withheld: the push covers it
+        return ledger.get_by_seq_no(seq_no) if seq_no <= ledger.size \
+            else None
 
-    n = observer.catch_up(DOMAIN_LEDGER_ID, fetch)
-    assert n == 2              # genesis nym is already there; pulled 2
+    # a LYING fetcher is detected by the batch-root check and everything
+    # staged is discarded (nothing unverified ever commits)
+    import copy
 
-    # now the pushed batch applies cleanly on top of the pulled history
-    assert observer.process_batch(pushes[-1])
+    def lying_fetch(ledger_id, seq_no):
+        txn = copy.deepcopy(fetch(ledger_id, seq_no))
+        txn["txn"]["data"]["dest"] = "FORGED"
+        return txn
+
+    assert not observer.catch_up(pushes[-1], lying_fetch)
+    assert observer.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 1
+
+    # the honest fetcher fills the gap and the push applies atomically
+    assert observer.catch_up(pushes[-1], fetch)
     obs_ledger = observer.c.db.get_ledger(DOMAIN_LEDGER_ID)
     assert obs_ledger.size == live.size == 4
     assert obs_ledger.root_hash == live.root_hash

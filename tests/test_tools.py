@@ -114,10 +114,12 @@ def test_four_process_pool_orders_nym(tmp_path):
     procs = []
     try:
         for name in names:
+            cmd = [sys.executable, "-m", "plenum_tpu.tools.start_node",
+                   "--name", name, "--base-dir", base, "--kv", "memory"]
+            if name == "Node1":
+                cmd.append("--record")     # exercised by the replay below
             procs.append(subprocess.Popen(
-                [sys.executable, "-m", "plenum_tpu.tools.start_node",
-                 "--name", name, "--base-dir", base, "--kv", "memory"],
-                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT))
         # wait for every process to report "started"
         for p in procs:
@@ -145,6 +147,23 @@ def test_four_process_pool_orders_nym(tmp_path):
         txn = reply["result"]
         assert txn["txn"]["data"]["dest"] == user_did
         assert txn["txnMetadata"]["seqNo"] == 2
+
+        # offline replay of the recorded node reproduces its ledger state
+        # (STACK_COMPANION story: record in production, debug offline)
+        procs[0].send_signal(signal.SIGTERM)
+        procs[0].wait(timeout=5)
+        out = subprocess.run(
+            [sys.executable, "-m", "plenum_tpu.tools.replay",
+             "--name", "Node1", "--base-dir", base],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        replayed = json.loads(out.stdout.strip().splitlines()[-1])
+        from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+        dom = replayed["ledgers"][str(DOMAIN_LEDGER_ID)] \
+            if str(DOMAIN_LEDGER_ID) in replayed["ledgers"] \
+            else replayed["ledgers"][DOMAIN_LEDGER_ID]
+        assert dom["size"] == 2            # genesis NYM + the ordered one
+        assert replayed["last_ordered_3pc"][1] >= 1
     finally:
         for p in procs:
             p.send_signal(signal.SIGTERM)
