@@ -183,6 +183,8 @@ def message_from_dict(d: dict) -> MessageBase:
     if not isinstance(d, dict) or "op" not in d:
         raise MessageValidationError(f"not a message: {d!r:.100}")
     op = d["op"]
+    if not isinstance(op, str):     # unhashable/odd types must not TypeError
+        raise MessageValidationError(f"bad op type: {type(op).__name__}")
     cls = _REGISTRY.get(op)
     if cls is None:
         raise MessageValidationError(f"unknown message op {op!r}")

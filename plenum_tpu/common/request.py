@@ -58,6 +58,15 @@ class Request:
 
     @classmethod
     def from_dict(cls, d: dict) -> "Request":
+        # shape-validate the attacker-controlled fields HERE: every later
+        # accessor (txn_type, digests) assumes these types, and a malformed
+        # request must fail at parse (-> NACK), never inside the prod loop
+        if not isinstance(d.get("operation"), dict):
+            raise ValueError("operation must be a dict")
+        if not isinstance(d.get("identifier"), str):
+            raise ValueError("identifier must be a string")
+        if not isinstance(d.get("reqId"), int):
+            raise ValueError("reqId must be an int")
         return cls(identifier=d["identifier"],
                    req_id=d["reqId"],
                    operation=d["operation"],

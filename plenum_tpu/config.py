@@ -57,6 +57,9 @@ class Config:
 
     # --- metrics (ref config.py METRICS_COLLECTOR_TYPE/flush) ---
     METRICS_FLUSH_INTERVAL: float = 10.0
+
+    # --- blacklisting (TTL: self-isolation must heal; see blacklister.py) ---
+    BLACKLIST_TTL: float = 120.0
     CatchupTransactionsTimeout: float = 6.0
     ConsistencyProofsTimeout: float = 5.0
 

@@ -102,7 +102,8 @@ class Node:
 
         # suspicions → blacklist; enforced at bus ingress so no service ever
         # sees traffic from a blacklisted peer (ref server/blacklister.py)
-        self.blacklister = Blacklister()
+        self.blacklister = Blacklister(
+            ttl=self.config.BLACKLIST_TTL, now=timer.get_current_time)
         self.node_bus.set_incoming_filter(
             lambda frm: not self.blacklister.is_blacklisted(frm))
 

@@ -1,0 +1,112 @@
+"""Wire-protocol robustness fuzz: malformed, truncated, and random ingress
+must never crash a node — malformed node traffic is dropped, malformed
+client traffic is NACKed.
+
+Reference test model: the message-validation suites over
+messages/fields.py + validateNodeMsg (SURVEY.md §4 message validation).
+"""
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from plenum_tpu.common.message_base import (MessageValidationError,
+                                            message_from_dict)
+from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID, PrePrepare
+from plenum_tpu.common.serialization import pack, unpack
+from plenum_tpu.crypto.ed25519 import Ed25519Signer
+
+from test_pool import Pool, signed_nym
+
+N_CASES = 300
+
+
+def _mutate(rng: random.Random, d):
+    """Randomly corrupt a wire dict."""
+    d = dict(d)
+    op = rng.randrange(5)
+    keys = list(d)
+    if op == 0 and keys:
+        del d[rng.choice(keys)]
+    elif op == 1 and keys:
+        d[rng.choice(keys)] = rng.choice(
+            [None, -1, 2**70, "x" * 50, [], {}, float("nan"), b"\xff"])
+    elif op == 2:
+        d["op"] = rng.choice(["", "NOPE", 42, None])
+    elif op == 3 and keys:
+        k = rng.choice(keys)
+        d[str(k) + "_extra"] = d.pop(k)
+    else:
+        d[rng.choice(["view_no", "pp_seq_no", "inst_id"])] = rng.choice(
+            [-(2**40), "str", [1, 2], None])
+    return d
+
+
+def test_message_from_dict_never_crashes_on_garbage():
+    rng = random.Random(1234)
+    base = PrePrepare(inst_id=0, view_no=0, pp_seq_no=1, pp_time=1.0,
+                      req_idr=("d",), discarded=(), digest="x",
+                      ledger_id=DOMAIN_LEDGER_ID, state_root="", txn_root="",
+                      audit_txn_root="").to_dict()
+    ok = 0
+    for _ in range(N_CASES):
+        d = _mutate(rng, base)
+        try:
+            message_from_dict(d)
+            ok += 1
+        except MessageValidationError:
+            pass                     # the ONLY acceptable failure mode
+    # some mutations still validate (extra-field tolerance etc.); most fail
+    assert ok < N_CASES
+
+
+def test_node_survives_garbage_node_traffic():
+    rng = random.Random(99)
+    pool = Pool()
+    node = pool.nodes["Alpha"]
+    base = PrePrepare(inst_id=0, view_no=0, pp_seq_no=1, pp_time=1.0,
+                      req_idr=("d",), discarded=(), digest="x",
+                      ledger_id=DOMAIN_LEDGER_ID, state_root="", txn_root="",
+                      audit_txn_root="").to_dict()
+    for i in range(N_CASES):
+        d = _mutate(rng, base)
+        try:
+            msg = message_from_dict(unpack(pack(d)))
+        except (MessageValidationError, Exception):
+            continue                 # wire layer already dropped it
+        # decodable-but-weird messages reach the bus like real traffic
+        node.node_bus.process_incoming(msg, rng.choice(pool.names[1:]))
+        node.prod()
+    # the storm (forged non-primary pre-prepares "from" every peer) gets
+    # them all blacklisted — and the TTL must self-heal the isolation
+    assert node.blacklister.blacklisted
+    pool.timer.advance(130.0)            # past BLACKLIST_TTL
+    user = Ed25519Signer(seed=b"fuzz-after".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(10.0)
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.names}
+    assert sizes == {2}, sizes
+
+
+def test_node_nacks_garbage_client_traffic():
+    rng = random.Random(7)
+    pool = Pool()
+    node = pool.nodes["Alpha"]
+    for i in range(100):
+        junk = rng.choice([
+            {}, {"op": "x"}, {"identifier": 1}, {"reqId": None},
+            {"identifier": "A", "reqId": i, "operation": "notadict"},
+            {"identifier": "A", "reqId": i, "operation": {"type": None}},
+            {"identifier": None, "reqId": [], "operation": {}},
+        ])
+        node.handle_client_message(dict(junk), f"cli{i}")
+        node.prod()
+    from plenum_tpu.common.node_messages import RequestNack
+    assert pool.replies("Alpha", RequestNack)
+    # and the node still serves real traffic
+    user = Ed25519Signer(seed=b"fuzz-client".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(5.0)
+    assert pool.nodes["Alpha"].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2
