@@ -15,33 +15,75 @@ receive quotas) so XLA compiles a single program; the Merkle hasher stays
 on hashlib below its batch threshold (device dispatch on a tunneled TPU
 only pays off at catchup-scale batches).
 
+The jax pool runs in a WATCHDOGGED SUBPROCESS: a wedged device tunnel (the
+backend can hang during init with no in-process timeout) must degrade this
+benchmark to cpu-only numbers, never hang it.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+
+JAX_POOL_TIMEOUT_S = int(os.environ.get("BENCH_JAX_TIMEOUT", "1500"))
+# compile (~minutes on a tunneled TPU) + run; env override for testing
+
+
+def _run_jax_pool_subprocess():
+    """-> stats dict or {'error': ...}."""
+    code = (
+        "import json\n"
+        "from plenum_tpu.tools.local_pool import run_load\n"
+        "print(json.dumps(run_load(n_nodes=4, n_txns=300, backend='jax',"
+        " timeout=240.0)))\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=JAX_POOL_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return {"error": "jax pool timed out (device tunnel wedged?)"}
+    for line in reversed(out.stdout.strip().splitlines() or [""]):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return {"error": (out.stderr or "no output").strip()[-300:]}
 
 
 def main():
     from plenum_tpu.tools.local_pool import run_load
 
     cpu = run_load(n_nodes=4, n_txns=300, backend="cpu")
-    jax_stats = run_load(n_nodes=4, n_txns=300, backend="jax",
-                         timeout=240.0)
+    jax_stats = _run_jax_pool_subprocess()
 
     cpu_tps = cpu["tps"] or 1e-9
-    print(json.dumps({
+    jax_ok = "tps" in jax_stats
+    result = {
         "metric": "pool_write_tps_4node",
-        "value": jax_stats["tps"],
+        "value": jax_stats["tps"] if jax_ok else cpu["tps"],
         "unit": "txns/s",
-        "vs_baseline": round(jax_stats["tps"] / cpu_tps, 3),
+        "vs_baseline": round(jax_stats["tps"] / cpu_tps, 3) if jax_ok
+        else 1.0,
         "cpu_tps": cpu["tps"],
         "cpu_p50_ms": cpu["p50_latency_ms"],
-        "jax_p50_ms": jax_stats["p50_latency_ms"],
-        "jax_ordered": jax_stats["txns_ordered"],
-        "ledgers_agree": bool(cpu["ledger_sizes_agree"]
-                              and jax_stats["ledger_sizes_agree"]),
-    }))
+    }
+    if jax_ok:
+        result.update({
+            "jax_p50_ms": jax_stats["p50_latency_ms"],
+            "jax_ordered": jax_stats["txns_ordered"],
+            "ledgers_agree": bool(cpu["ledger_sizes_agree"]
+                                  and jax_stats["ledger_sizes_agree"]),
+        })
+    else:
+        result["jax_error"] = jax_stats.get("error", "unknown")
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

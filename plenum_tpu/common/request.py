@@ -67,6 +67,15 @@ class Request:
             raise ValueError("identifier must be a string")
         if not isinstance(d.get("reqId"), int):
             raise ValueError("reqId must be an int")
+        if d.get("endorser") is not None and \
+                not isinstance(d["endorser"], str):
+            raise ValueError("endorser must be a string")
+        sigs = d.get("signatures")
+        if sigs is not None and (
+                not isinstance(sigs, dict)
+                or not all(isinstance(k, str) and isinstance(v, str)
+                           for k, v in sigs.items())):
+            raise ValueError("signatures must map str identifiers to str sigs")
         return cls(identifier=d["identifier"],
                    req_id=d["reqId"],
                    operation=d["operation"],

@@ -52,6 +52,13 @@ class NymHandler(WriteRequestHandler):
         author = self._read(request.identifier)
         target = self._read(op["dest"])
         author_role = author.get("role") if author else None
+        # an endorser's role counts toward authorization (indy endorsement
+        # semantics); client authN already REQUIRED the endorser's signature
+        # whenever the field names one
+        roles = {author_role}
+        if request.endorser is not None:
+            erec = self._read(request.endorser)
+            roles.add(erec.get("role") if erec else None)
         if target is None:
             # Creation: trustees and stewards may author; a totally empty
             # state (bootstrap before genesis DIDs) accepts anything so pools
@@ -59,17 +66,17 @@ class NymHandler(WriteRequestHandler):
             if author is None and self.state.head_hash == self.state.committed_head_hash \
                     and not self._any_nym_exists():
                 return
-            if author_role not in (TRUSTEE, STEWARD):
+            if not roles & {TRUSTEE, STEWARD}:
                 raise UnauthorizedClientRequest(
                     request.identifier, request.req_id,
                     "only trustee/steward may create a DID")
         else:
             is_owner = request.identifier == op["dest"]
-            if not is_owner and author_role != TRUSTEE:
+            if not is_owner and TRUSTEE not in roles:
                 raise UnauthorizedClientRequest(
                     request.identifier, request.req_id,
                     "only the owner or a trustee may modify a DID")
-            if op.get("role") is not None and author_role != TRUSTEE:
+            if op.get("role") is not None and TRUSTEE not in roles:
                 raise UnauthorizedClientRequest(
                     request.identifier, request.req_id,
                     "role changes require a trustee")

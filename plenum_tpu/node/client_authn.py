@@ -73,6 +73,11 @@ class CoreAuthNr:
         sigs = request.all_signatures()
         if not sigs:
             raise MissingSignature(f"request {request.req_id} is unsigned")
+        # a named endorser MUST be a signer: authorization will count the
+        # endorser's role, so an unsigned endorsement would let anyone
+        # borrow a trustee's permissions by just naming them
+        if request.endorser is not None and request.endorser not in sigs:
+            return None
         msg = request.signing_bytes()
         items = []
         for idr, sig_b58 in sigs.items():

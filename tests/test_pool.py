@@ -238,3 +238,63 @@ def test_pool_jax_backend_end_to_end():
     pool.run(8.0)     # > MAX_AUTH_POLLS prods so the pipelined collect blocks
     from plenum_tpu.common.node_messages import RequestNack
     assert pool.replies("Alpha", RequestNack)
+
+
+def test_endorsed_multi_sig_request_orders():
+    """A request carrying MULTIPLE signatures (author + endorser) passes
+    only if every signer verifies (ref authenticate_multi:84), and a bad
+    endorser signature nacks the whole request."""
+    pool = Pool(seed=77)
+    author = Ed25519Signer(seed=b"ms-author".ljust(32, b"\0"))
+    # register the author (no role) so its verkey resolves from state
+    pool.submit(signed_nym(pool.trustee, author, 1))
+    pool.run(5.0)
+
+    user = Ed25519Signer(seed=b"ms-target".ljust(32, b"\0"))
+    req = Request(author.identifier, 2,
+                  {"type": NYM, "dest": user.identifier,
+                   "verkey": user.verkey_b58},
+                  endorser=pool.trustee.identifier)
+    payload = req.signing_bytes()
+    req.signatures = {author.identifier: author.sign_b58(payload),
+                      pool.trustee.identifier: pool.trustee.sign_b58(payload)}
+    pool.submit(req)
+    pool.run(5.0)
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.names}
+    assert sizes == {3}, sizes
+
+    # same shape but the endorser's signature is broken -> NACK, no txn
+    req2 = Request(author.identifier, 3,
+                   {"type": NYM, "dest": "X" + user.identifier[1:],
+                    "verkey": user.verkey_b58},
+                   endorser=pool.trustee.identifier)
+    payload2 = req2.signing_bytes()
+    sigs = {author.identifier: author.sign_b58(payload2),
+            pool.trustee.identifier: pool.trustee.sign_b58(b"wrong")}
+    req2.signatures = sigs
+    pool.submit(req2, to=["Alpha"])
+    pool.run(5.0)
+    nacks = pool.replies("Alpha", RequestNack)
+    assert any(m.req_id == 3 for m in nacks)
+    assert pool.nodes["Alpha"].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 3
+
+
+def test_named_endorser_without_signature_is_nacked():
+    """Naming a trustee as endorser WITHOUT their signature must fail
+    authentication — otherwise anyone could borrow the trustee's role."""
+    pool = Pool(seed=78)
+    author = Ed25519Signer(seed=b"imp-author".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, author, 1))
+    pool.run(5.0)
+
+    user = Ed25519Signer(seed=b"imp-target".ljust(32, b"\0"))
+    req = Request(author.identifier, 2,
+                  {"type": NYM, "dest": user.identifier,
+                   "verkey": user.verkey_b58},
+                  endorser=pool.trustee.identifier)   # named, NOT signing
+    req.signature = author.sign_b58(req.signing_bytes())
+    pool.submit(req, to=["Alpha"])
+    pool.run(5.0)
+    assert any(m.req_id == 2 for m in pool.replies("Alpha", RequestNack))
+    assert pool.nodes["Alpha"].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2

@@ -90,3 +90,23 @@ def test_connected_events():
     net.connect_all()
     assert seen == ["N1"]
     assert b0.connecteds == {"N1"}
+
+
+def test_multihost_api_single_process():
+    """init_multihost + global_mesh + shard_host_batch drive the sharded
+    crypto plane on the (virtual, 8-device) single-process job — the same
+    call sequence a multi-host deployment uses."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from plenum_tpu.parallel.multihost import (global_mesh, init_multihost,
+                                               shard_host_batch)
+
+    init_multihost()                       # single-process: no coordinator
+    mesh = global_mesh(8)
+    assert mesh.devices.size == 8 and mesh.axis_names == ("inst", "sig")
+
+    arr = np.arange(8 * 4, dtype=np.int64).reshape(8, 4)
+    garr = shard_host_batch(mesh, arr, P(("inst", "sig"), None))
+    assert garr.shape == (8, 4)
+    np.testing.assert_array_equal(np.asarray(garr), arr)
