@@ -147,25 +147,25 @@ class JaxEd25519Verifier(Ed25519Verifier):
     """Batched device verification.
 
     Host prep per item: split sig into (R, S); decompress A once per verkey
-    (cached as ready-to-ship limb rows for -A AND [2^128](-A), the split
-    point of the windowed ladder); reject non-canonical S or invalid A;
-    h = SHA512(R||A||M) mod L. R is NOT decompressed — the kernel recomputes
-    R' and compares its compressed form against the raw signature bytes
-    (ref10 semantics), so the only per-item bigint work left on host is one
-    sha512 and one mod-L reduction (plus, once per NEW verkey, 128 extended
-    doublings for the cached split point).
+    (cached as ready-to-ship limb rows for the four quarter points
+    [2^64k](-A) of the split window ladder, kept in extended coordinates so
+    the 192-doubling chain needs NO host inversions); reject non-canonical
+    S or invalid A; h = SHA512(R||A||M) mod L. R is NOT decompressed — the
+    kernel recomputes R' and compares its compressed form against the raw
+    signature bytes (ref10 semantics), so the only per-item bigint work
+    left on host is one sha512 and one mod-L reduction.
     Device: one verify_kernel dispatch over the padded batch.
     """
 
     def __init__(self, min_batch: int = 1, cache_size: int = 65536):
-        # verkeys are attacker-supplied; the cache must be bounded (FIFO evict)
-        # value: ((a0x, a0y, a0t), (a1x, a1y, a1t)) int64[10] rows for -A and
-        # [2^128](-A), or None for invalid keys
-        self._pt_cache: dict[bytes, Optional[tuple]] = {}
+        # verkeys are attacker-supplied; the cache must be bounded (FIFO
+        # evict). value: int32[4, 4, NLIMB] quarter-point rows, or None
+        # for invalid keys
+        self._pt_cache: dict[bytes, Optional[np.ndarray]] = {}
         self._cache_size = cache_size
         self._min_batch = min_batch
 
-    def _neg_a_limbs(self, vk: bytes) -> Optional[tuple]:
+    def _neg_a_limbs(self, vk: bytes) -> Optional[np.ndarray]:
         if vk in self._pt_cache:
             return self._pt_cache[vk]
         a = _ops.decompress(vk)
@@ -173,14 +173,7 @@ class JaxEd25519Verifier(Ed25519Verifier):
             rows = None
         else:
             neg = ((_ops.P - a[0]) % _ops.P, a[1])         # -A = (-x, y)
-            neg2 = _ops.mul_pow2_affine(neg, _ops.HALF_SHIFT)
-
-            def _rows(pt):
-                x, y = pt
-                return (_ops.int_to_limbs(x), _ops.int_to_limbs(y),
-                        _ops.int_to_limbs(x * y % _ops.P))
-
-            rows = (_rows(neg), _rows(neg2))
+            rows = _ops.ext_quarters(neg)
         if len(self._pt_cache) >= self._cache_size:
             self._pt_cache.pop(next(iter(self._pt_cache)))
         self._pt_cache[vk] = rows
@@ -191,8 +184,9 @@ class JaxEd25519Verifier(Ed25519Verifier):
         rows = self._neg_a_limbs(vk)
         if rows is None:
             return None
-        return ((_ops.P - _ops.limbs_to_int(rows[0][0])) % _ops.P,
-                _ops.limbs_to_int(rows[0][1]))
+        x = _ops.limbs_to_int(rows[0, 0])
+        y = _ops.limbs_to_int(rows[0, 1])
+        return ((_ops.P - x) % _ops.P, y)
 
     def _dispatch(self, items: Sequence[VerifyItem]):
         import jax.numpy as jnp
@@ -233,19 +227,17 @@ class JaxEd25519Verifier(Ed25519Verifier):
         h_vals += [h_vals[0]] * pad
         a_rows += [a_rows[0]] * pad
         r_enc += [r_enc[0]] * pad
-        half_mask = (1 << _ops.HALF_SHIFT) - 1
-        s_digits = _ops.scalar_windows(s_vals, _ops.N_COMB)
-        h0_digits = _ops.scalar_windows(
-            [h & half_mask for h in h_vals], _ops.N_WIN)
-        h1_digits = _ops.scalar_windows(
-            [h >> _ops.HALF_SHIFT for h in h_vals], _ops.N_WIN)
-        a0 = [np.stack([r[0][c] for r in a_rows]) for c in range(3)]
-        a1 = [np.stack([r[1][c] for r in a_rows]) for c in range(3)]
+        qmask = (1 << _ops.QUARTER_SHIFT) - 1
+        s_digits = _ops.scalar_windows(s_vals, _ops.N_COMB, _ops.CBITS)
+        h_digits = np.stack([
+            _ops.scalar_windows(
+                [(h >> (_ops.QUARTER_SHIFT * q)) & qmask for h in h_vals],
+                _ops.N_WIN)
+            for q in range(_ops.N_QUARTERS)], axis=1)   # [N_WIN, 4, m]
+        aq = np.stack(a_rows)                           # [m, 4, 4, NLIMB]
         ry, r_sign = _ops.r_bytes_to_limbs(r_enc)
         ok = _ops.verify_kernel(
-            jnp.asarray(s_digits), jnp.asarray(h0_digits),
-            jnp.asarray(h1_digits),
-            *(jnp.asarray(a) for a in a0), *(jnp.asarray(a) for a in a1),
+            jnp.asarray(s_digits), jnp.asarray(h_digits), jnp.asarray(aq),
             jnp.asarray(ry), jnp.asarray(r_sign))
         return _JaxToken(ok, idxs, n)
 
@@ -264,6 +256,95 @@ class JaxEd25519Verifier(Ed25519Verifier):
         for j, i in enumerate(token.idxs):
             verdict[i] = bool(ok[j])
         return verdict
+
+    def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        return self.collect_batch(self.submit_batch(items), wait=True)
+
+
+class CoalescingVerifier(Ed25519Verifier):
+    """Process-wide crypto plane for CO-HOSTED nodes: coalesces the
+    signature batches of every node sharing this host's device into ONE
+    kernel dispatch per flush.
+
+    TPU-first rationale (SURVEY.md §2.3): the verify kernel is serial-depth
+    bound, so its cost is nearly flat in batch size — four nodes dispatching
+    128-item batches pay 4x the wall-clock of one 512-item dispatch. In a
+    production pool each node runs on its own host and owns its device, but
+    a multi-replica host (or the 4-nodes-1-chip bench topology) should share
+    one plane, exactly like co-located RBFT instances share one device
+    program. Each node still verifies independently — only the DISPATCH is
+    shared; verdict spans map back per submitter.
+
+    Protocol: submit_batch stages items and returns a queued token;
+    the next collect_batch (or flush()) with the device idle dispatches
+    everything staged. One dispatch in flight at a time — while busy, new
+    submissions stage for the next flush (natural backpressure, same as
+    the per-node pipeline).
+    """
+
+    class _Token:
+        __slots__ = ("items", "verdicts", "inner")
+
+        def __init__(self, items):
+            self.items = items
+            self.verdicts = None    # np.ndarray once resolved
+            self.inner = None       # (inner_token, start) once dispatched
+
+    def __init__(self, inner: "JaxEd25519Verifier"):
+        self._inner = inner
+        self._staged: list[CoalescingVerifier._Token] = []
+        self._in_flight: Optional[tuple] = None   # (inner_token, [tokens])
+
+    def flush(self) -> bool:
+        """Dispatch everything staged if the device is idle. -> dispatched?"""
+        if self._in_flight is not None or not self._staged:
+            return False
+        batch = self._staged
+        self._staged = []
+        items: list[VerifyItem] = []
+        for tok in batch:
+            tok.inner = (None, len(items))
+            items.extend(tok.items)
+        inner_tok = self._inner.submit_batch(items)
+        self._in_flight = (inner_tok, batch)
+        return True
+
+    def _resolve_in_flight(self, wait: bool) -> bool:
+        if self._in_flight is None:
+            return True
+        inner_tok, batch = self._in_flight
+        ok = self._inner.collect_batch(inner_tok, wait=wait)
+        if ok is None:
+            return False
+        for tok in batch:
+            start = tok.inner[1]
+            tok.verdicts = ok[start:start + len(tok.items)]
+        self._in_flight = None
+        return True
+
+    def submit_batch(self, items: Sequence[VerifyItem]):
+        tok = CoalescingVerifier._Token(list(items))
+        self._staged.append(tok)
+        return tok
+
+    def collect_batch(self, token, wait: bool = True) -> Optional[np.ndarray]:
+        while token.verdicts is None:
+            if self._in_flight is not None:
+                # resolve whatever is flying (ours or an earlier flush);
+                # a not-ready async dispatch surfaces as None to the poller
+                if not self._resolve_in_flight(wait):
+                    return None
+            elif wait:
+                # blocking collect must make progress: flush the stage
+                # (our token included) and resolve it
+                self.flush()
+            else:
+                # non-blocking poll of a still-staged token does NOT flush —
+                # coalescing depends on every co-hosted node staging its
+                # cycle's batch before the shared flush() fires (a node's
+                # pipelined submit+poll would otherwise dispatch solo)
+                return None
+        return token.verdicts
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
         return self.collect_batch(self.submit_batch(items), wait=True)

@@ -72,7 +72,8 @@ class NodeBootstrap:
                  bls_seed: Optional[bytes] = None,
                  verifier_min_batch: int = 128,
                  storage_backend: str = "native",
-                 plugins=None):
+                 plugins=None,
+                 verifier=None):
         self.name = name
         self.genesis = genesis_txns or {}
         self.data_dir = data_dir
@@ -87,6 +88,10 @@ class NodeBootstrap:
         # one fixed device-program shape covering the receive quotas: novel
         # shapes recompile, which costs minutes on a tunneled TPU
         self.verifier_min_batch = verifier_min_batch
+        # explicit verifier override: co-hosted nodes pass ONE shared
+        # CoalescingVerifier so their dispatches ride a single device
+        # program per cycle (crypto/ed25519.py CoalescingVerifier)
+        self.verifier = verifier
 
     # --- storage factories -------------------------------------------------
 
@@ -180,8 +185,8 @@ class NodeBootstrap:
         # client authN over the Ed25519 provider seam (cpu | jax)
         authnr = ReqAuthenticator()
         authnr.register_authenticator(CoreAuthNr(
-            make_verifier(self.crypto_backend,
-                          min_batch=self.verifier_min_batch),
+            self.verifier or make_verifier(self.crypto_backend,
+                                           min_batch=self.verifier_min_batch),
             get_verkey=nym.get_verkey))
 
         # BLS: signer from seed; registry fed from pool state

@@ -148,6 +148,13 @@ class Node:
         # entry is freed when the request executes (durable dedup then lives
         # in the seq-no DB keyed by payload digest)
         self._seen_propagates: dict[str, set[str]] = {}
+        # digest -> entries parked while that digest's signature dispatch
+        # is in flight (client or propagate path): each node verifies a
+        # given request's signature at most once per arrival wave. Entries
+        # are ("prop", Propagate, frm) — peers' propagates that become
+        # votes on the landed verdict — or ("client", Request, frm) — the
+        # client's own copy racing a peer's dispatch. Popped at verdict.
+        self._authing: dict[str, list[tuple]] = {}
 
         # catchup: seeder answers peers; leecher drives our own sync
         # (ref ledger_manager.py:21 + server/catchup/*)
@@ -467,6 +474,17 @@ class Node:
                 self._client_send(RequestNack(
                     identifier=request.identifier, req_id=request.req_id,
                     reason=f"unknown txn type {request.txn_type!r}"), frm)
+        deduped: list[tuple[Request, str]] = []
+        for req, frm in to_auth:
+            if req.digest in self._authing:
+                # a dispatch for these very bytes is already in flight
+                # (peer propagate raced ahead): park the client copy and
+                # settle it on that verdict instead of re-verifying
+                self._authing[req.digest].append(("client", req, frm))
+            else:
+                self._authing[req.digest] = []
+                deduped.append((req, frm))
+        to_auth = deduped
         if to_auth:
             self._auth_inflight = self._submit_auth(
                 to_auth, [r for r, _ in to_auth], self._finish_client_auth)
@@ -499,39 +517,66 @@ class Node:
         """Ack + propagate statically-valid requests whose signatures the
         device accepted (ref processRequest:2000 → recordAndPropagate)."""
         for (req, frm), ok in zip(items, verdicts):
-            if not ok:
-                self._client_send(RequestNack(identifier=req.identifier,
-                                              req_id=req.req_id,
-                                              reason="signature verification failed"),
-                                  frm)
-                continue
-            if self.action_manager is not None and \
-                    self.action_manager.is_action_type(req.txn_type):
-                # actions execute on THIS node only: no propagate, no 3PC
-                try:
-                    result = self.action_manager.process(req)
-                except InvalidClientRequest as e:
-                    self._client_send(RequestNack(
-                        identifier=req.identifier, req_id=req.req_id,
-                        reason=e.reason), frm)
-                    continue
-                except UnauthorizedClientRequest as e:
-                    # well-formed but refused -> REJECT, never NACK
-                    self._client_send(Reject(
-                        identifier=req.identifier, req_id=req.req_id,
-                        reason=e.reason), frm)
-                    continue
-                self._client_send(Reply(result=result), frm)
-                continue
-            # dedup: an already-executed request gets its Reply resent
-            # (durable lookup via the seq-no DB, ref node.py:2000 seqNoMap)
-            executed = self._executed_txn(req)
-            if executed is not None:
-                self._client_send(Reply(result=executed), frm)
-                continue
-            self._client_send(RequestAck(identifier=req.identifier,
-                                         req_id=req.req_id), frm)
-            self.propagator.propagate(req, frm)
+            self._settle_client(req, frm, ok)
+            self._settle_parked(req, ok)
+
+    def _settle_parked(self, req: Request, ok: bool) -> None:
+        """Deliver a landed verdict to everything parked on that digest:
+        peer propagates become votes (same signed bytes — the digest covers
+        the signature), parked client copies get the full client settle.
+        Propagates of an already-executed request are dropped, NOT
+        processed — process_propagate would resurrect request state for a
+        committed txn (same hazard _finish_propagate_auth re-checks)."""
+        parked = self._authing.pop(req.digest, [])
+        if not parked:
+            return
+        executed = ok and req.digest not in self.propagator.requests \
+            and self._executed_txn(req) is not None
+        for entry in parked:
+            if entry[0] == "prop":
+                _, pmsg, pfrm = entry
+                if not ok:
+                    self.spylog.append(("suspicious_propagate", pfrm))
+                elif not executed:
+                    self.propagator.process_propagate(pmsg, pfrm)
+            else:
+                _, preq, pfrm = entry
+                self._settle_client(preq, pfrm, ok)
+
+    def _settle_client(self, req: Request, frm: str, ok: bool) -> None:
+        if not ok:
+            self._client_send(RequestNack(identifier=req.identifier,
+                                          req_id=req.req_id,
+                                          reason="signature verification failed"),
+                              frm)
+            return
+        if self.action_manager is not None and \
+                self.action_manager.is_action_type(req.txn_type):
+            # actions execute on THIS node only: no propagate, no 3PC
+            try:
+                result = self.action_manager.process(req)
+            except InvalidClientRequest as e:
+                self._client_send(RequestNack(
+                    identifier=req.identifier, req_id=req.req_id,
+                    reason=e.reason), frm)
+                return
+            except UnauthorizedClientRequest as e:
+                # well-formed but refused -> REJECT, never NACK
+                self._client_send(Reject(
+                    identifier=req.identifier, req_id=req.req_id,
+                    reason=e.reason), frm)
+                return
+            self._client_send(Reply(result=result), frm)
+            return
+        # dedup: an already-executed request gets its Reply resent
+        # (durable lookup via the seq-no DB, ref node.py:2000 seqNoMap)
+        executed = self._executed_txn(req)
+        if executed is not None:
+            self._client_send(Reply(result=executed), frm)
+            return
+        self._client_send(RequestAck(identifier=req.identifier,
+                                     req_id=req.req_id), frm)
+        self.propagator.propagate(req, frm)
 
     def _executed_txn(self, req: Request) -> Optional[dict]:
         """Committed txn for a request that already executed, else None."""
@@ -574,9 +619,18 @@ class Node:
             if request.digest in self.propagator.requests:
                 # signature was already verified when first seen
                 verified.append((msg, frm, request))
+            elif request.digest in self._authing:
+                # same digest = same signed bytes (digest covers the
+                # signature): a dispatch is already in flight, so park
+                # this as a vote for when that verdict lands
+                self._authing[request.digest].append(("prop", msg, frm))
             elif self._executed_txn(request) is not None:
                 continue     # late propagate of an already-executed request
             else:
+                # register BEFORE scanning the rest of the drain so later
+                # same-digest propagates in this very batch park instead
+                # of duplicating the device work
+                self._authing[request.digest] = []
                 to_auth.append((msg, frm, request))
         for msg, frm, _ in verified:
             self.propagator.process_propagate(msg, frm)
@@ -592,15 +646,20 @@ class Node:
         for (msg, frm, req), ok in zip(pending, verdicts):
             if not ok:
                 self.spylog.append(("suspicious_propagate", frm))
+                self._settle_parked(req, False)
                 continue
             # verdicts can be up to MAX_AUTH_POLLS prods stale: a catchup
             # may have committed the request meanwhile — re-check the
             # executed guard the drain applied, or a late propagate would
             # resurrect request state for an already-executed txn
+            # (_settle_parked applies the same guard: parked props drop,
+            # parked clients get their executed-Reply via _settle_client)
             if req.digest not in self.propagator.requests and \
                     self._executed_txn(req) is not None:
+                self._settle_parked(req, True)
                 continue
             self.propagator.process_propagate(msg, frm)
+            self._settle_parked(req, True)
 
     # --- pipelined device-auth plumbing -----------------------------------
 

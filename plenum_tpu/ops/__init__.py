@@ -1,0 +1,28 @@
+"""Device kernels (the TPU plane) — shared JAX runtime configuration.
+
+Importing any kernel module routes through here, which enables the JAX
+persistent compilation cache: the framework's device programs are a handful
+of FIXED shapes (one Ed25519 verify bucket per node, one SHA-256 Merkle
+bucket, the sharded crypto plane), and on a tunneled TPU a single XLA
+compile costs minutes. With the cache, only the first process ever pays it;
+every later node/bench/test process deserializes the compiled executable in
+seconds. Cache location override: PLENUM_TPU_JAX_CACHE (useful for CI).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_cache_dir = os.environ.get(
+    "PLENUM_TPU_JAX_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "plenum_tpu", "jax"))
+try:  # pragma: no cover - depends on jax version/platform
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    # cache every program (default threshold skips small/fast compiles, but
+    # on the tunneled backend even "fast" compiles cost seconds)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass

@@ -1,41 +1,48 @@
-"""Batched Ed25519 verification on device — THE north-star kernel.
+"""Batched Ed25519 verification on device — THE north-star kernel (v3).
 
 Reference behavior being replaced: stp_core/crypto/nacl_wrappers.py:62,212
 (libsodium Ed25519, one scalar verify per call, n× per request across the
 pool — SURVEY.md §3.2 "Ed25519 HOT SPOT"). Here the expensive part of
-verification — the double-scalar multiplication [S]B + [h](-A) and the compare
-against R — runs for a whole batch of signatures in ONE device dispatch.
+verification — the double-scalar multiplication [S]B + [h](-A) and the
+compare against R — runs for a whole batch of signatures in ONE device
+dispatch.
 
 Split of labor (see plenum_tpu/crypto/ed25519.py for the host side):
   host:   decode/decompress points (pure-Python bigint sqrt, cached per
-          verkey, together with [2^128](-A) for the split window ladder),
+          verkey together with [2^64k](-A) for k=1..3 — the quarter points
+          of the split window ladder, kept in extended coordinates so the
+          chain needs NO host inversions),
           h = SHA512(R||A||M) mod L (hashlib, C speed),
-          scalars -> 4-bit window digit arrays
-  device: windowed multi-scalar mult over GF(2^255-19) with 10x26-bit limbs
-          in int64 lanes; affine comparison against R
+          scalars -> window digit arrays
+  device: windowed multi-scalar mult over GF(2^255-19) with 20x13-bit limbs
+          in int32 lanes; affine comparison against R
 
-Kernel shape (v2 — windowed; the v1 shape was a 254-round 1-bit Shamir
-ladder, ~2.5x more serial field multiplies):
-  [S]B      via a 4-bit fixed-base comb: 64 precomputed constant tables
-            T[w][d] = d*16^w*B in affine "niels" form (y+x, y-x, 2d*x*y) —
-            contributes 64 mixed additions and ZERO doublings.
-  [h](-A)   split h = h0 + 2^128*h1 with A2 = [2^128](-A) cached per verkey
-            on host; two 16-entry tables are built on device (one batched
-            build for both halves), then 32 iterations of
-            (4 doublings; 2 table additions; 2 comb additions).
-  compare   one Fermat inversion (straight-line 254-squaring addition chain,
-            pow2k blocks as fori_loops) -> affine (x, y) -> byte compare
-            against the raw signature R.
+Kernel shape (v3; v2 was int64 10x26-bit limbs with a 2-way split):
+  [S]B      via an 8-bit fixed-base comb: 32 precomputed constant tables
+            T[w][d] = d*256^w*B in affine "niels" form (y+x, y-x, 2d*x*y) —
+            32 mixed additions, ZERO doublings. Table selection is a
+            one-hot f32 matmul (tables are batch-constant), so it rides
+            the MXU instead of burning VPU cycles.
+  [h](-A)   split h = h0 + 2^64*h1 + 2^128*h2 + 2^192*h3 with the quarter
+            points Qk = [2^64k](-A) cached per verkey on host; four
+            16-entry tables are built on device (one batched build), then
+            16 iterations of (4 doublings; 4 table additions; 2 comb
+            additions). The 4-way split HALVES the doubling chain of the
+            classic 2-way layout (64 vs 128 doublings).
+  compare   one Fermat inversion (254 squarings as fori_loop pow2k blocks)
+            -> affine (x, y) -> limb compare against the raw signature R.
 
 Design notes (TPU-first):
-- Field elements are [..., 10] int64 arrays, radix 2^26, LAZILY carried:
-  add/sub do not carry at all (sub adds a 40p margin to stay non-negative);
-  only f_mul carries its output. Products stay < 2^63: limbs enter mul below
-  2^28.5, the 19x fold multiplier for the 2^260 overflow is 608 = 19*2^5
-  applied to 26-bit splits.
-- No data-dependent control flow: digit-driven point selection is a one-hot
-  contraction (einsum with a 0/1 mask), constant trip counts, static shapes.
-- The whole batch advances in lockstep; the batch axis maps onto VPU lanes and
+- Field elements are [..., 20] int32 arrays, radix 2^13, SIGNED limbs:
+  TPU VPUs have no native int64, so v2's 10x26-bit int64 limbs were
+  emulated; 13-bit limbs keep every product sum inside int32. Signed
+  carried form ([-2, 2^13+3] per limb) makes subtraction margin-free —
+  f_sub is just carry(f - g).
+- Squarings (pt_double, inversion) use a symmetric schoolbook (f_sqr,
+  ~half the products of f_mul).
+- No data-dependent control flow: digit-driven point selection is a
+  one-hot contraction, constant trip counts, static shapes. The whole
+  batch advances in lockstep; the batch axis maps onto VPU lanes and
   shards cleanly across a device mesh (see plenum_tpu/parallel/).
 """
 from __future__ import annotations
@@ -45,13 +52,6 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-# The limb arithmetic REQUIRES 64-bit integers; without x64 JAX silently
-# truncates to int32 and every verdict is garbage. This is a deliberate
-# framework-wide setting (import side effect): all plenum_tpu kernels are
-# explicit about dtypes, and a guard in verify_kernel rejects int32 inputs in
-# case another library flips the flag back.
-jax.config.update("jax_enable_x64", True)
 
 # --- curve constants (RFC 8032) ------------------------------------------
 
@@ -63,65 +63,66 @@ SQRT_M1 = pow(2, (P - 1) // 4, P)
 BX = 15112221349535400772501151409588531511454012693041857206046113283949847762202
 BY = 46316835694926478169428394003475163141307993866256225615783033603165251855960
 
-NLIMB = 10
-RADIX = 26
+NLIMB = 20
+RADIX = 13
 MASK = (1 << RADIX) - 1
 FOLD = 19 * 32          # 2^260 = 2^5 * 2^255 ≡ 19 * 32 (mod p)
 
-WBITS = 4               # window/comb digit width
-N_COMB = 64             # comb positions for the 256-bit S
-N_WIN = 32              # windows per 128-bit half of h
-HALF_SHIFT = 128        # h = h0 + 2^HALF_SHIFT * h1
+WBITS = 4               # window width for the variable point A
+N_WIN = 16              # windows per 64-bit quarter of h
+N_QUARTERS = 4
+QUARTER_SHIFT = 64      # h = sum_k 2^(64k) * h_k
+CBITS = 8               # comb digit width for the fixed base B
+N_COMB = 32             # comb positions for the 256-bit S
+
+_I32 = jnp.int32
 
 
 def int_to_limbs(x: int) -> np.ndarray:
     return np.array([(x >> (RADIX * i)) & MASK for i in range(NLIMB)],
-                    dtype=np.int64)
+                    dtype=np.int32)
 
 
 def limbs_to_int(l) -> int:
-    l = np.asarray(l)
-    return sum(int(l[i]) << (RADIX * i) for i in range(NLIMB)) % P
+    arr = np.asarray(l)
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(arr))
 
 
-# K = 40p decomposed with every limb in [2^26, 2^27) so (f - g + K) is
-# non-negative limbwise for carried f, g. (40p because the top limb must keep
-# its 2^26 floor after borrowing: 40p >> 234 = 40*2^21 > 2^26.)
 def _margin_limbs() -> np.ndarray:
+    """40p as NLIMB limbs, each with a 2^13 floor — added before strict
+    normalization so transiently-negative carried limbs (and values) lift
+    to nonnegative without changing the residue mod p."""
     mult = 40
-    k = [int((mult * P) >> (RADIX * i)) & MASK for i in range(11)]
-    k[9] += k[10] << RADIX
-    # borrow so limbs 0..8 get a +2^26 floor
-    for i in range(9):
+    k = [int((mult * P) >> (RADIX * i)) & MASK for i in range(NLIMB + 1)]
+    k[NLIMB - 1] += k[NLIMB] << RADIX
+    for i in range(NLIMB - 1):
         k[i] += 1 << RADIX
         k[i + 1] -= 1
-    assert sum(v << (RADIX * i) for i, v in enumerate(k[:10])) == mult * P
-    assert all((1 << RADIX) <= v < (1 << 27) for v in k[:10])
-    return np.array(k[:10], dtype=np.int64)
+    assert sum(v << (RADIX * i) for i, v in enumerate(k[:NLIMB])) == mult * P
+    assert all((1 << RADIX) <= v < (1 << 16) for v in k[:NLIMB])
+    return np.array(k[:NLIMB], dtype=np.int32)
 
 
-_K_SUB = _margin_limbs()
+_K_MARGIN = _margin_limbs()
 
 
 # --- field ops ------------------------------------------------------------
 #
-# Bound discipline: "carried" means limbs < 2^26 + 1 (the output of _carry);
-# add_nc/sub_nc outputs are < 2^28.3 limbwise when their inputs obey the
-# rules in the point formulas below, which keeps every f_mul product sum
-# under 2^60 — far inside int64.
+# Bound discipline: "carried" means signed limbs in [-2, 2^13 + 3] (the
+# output of _carry). f_mul/f_sqr REQUIRE carried inputs: products are then
+# < 2^26.01, and a 20-term accumulation plus the fold contributions stays
+# below 2^30.6 — inside int32. Unlike v2 there is NO lazy add/sub level:
+# f_add/f_sub carry their output (3 cheap vector passes) so every operand
+# everywhere is carried.
 
 def _carry(c):
     """Three vectorized carry passes with the 2^260 -> FOLD wraparound.
 
-    Each pass is whole-limb-axis arithmetic (mask/shift/roll) — no per-limb
-    Python loop, so a pass is ~6 XLA ops instead of ~30 and the serial
-    dependency depth is 3, not 20. Pass math: c = (c & MASK) + shift(c >> 26)
-    with the top limb's carry folding to limb 0 via FOLD. Handles transiently
-    negative limbs (arithmetic >> floors, so value is preserved exactly).
-
-    Bounds: |input limbs| < 2^60 -> pass1 < 2^43.4 -> pass2 < 2^27.4 ->
-    pass3 in [-2, 2^26 + 2] ("carried" form; the stray +-2 is absorbed by
-    the 40p margin in sub_nc and by f_canon's margin pre-add).
+    Pass math: c = (c & MASK) + shift(c >> 13), the top limb's carry
+    folding to limb 0 via FOLD. Arithmetic >> floors, so transiently
+    negative limbs are preserved exactly. |input| < 2^30.6 -> pass1
+    < 2^27 (limb 0; others < 2^17.7) -> pass2 < 2^14.6 -> pass3 in
+    [-2, 2^13 + 3] ("carried" form).
     """
     for _ in range(3):
         lo = c & MASK
@@ -131,35 +132,18 @@ def _carry(c):
     return c
 
 
-def add_nc(f, g):
-    """Lazy addition: no carry. Inputs must keep the sum below 2^28.3."""
-    return f + g
-
-
-def sub_nc(f, g):
-    """Lazy subtraction: f - g + 40p, no carry. g must be CARRIED (the 40p
-    margin limbs floor at 2^26, which dominates carried limbs only)."""
-    return f - g + jnp.asarray(_K_SUB)
-
-
 def f_add(f, g):
     return _carry(f + g)
 
 
 def f_sub(f, g):
-    return _carry(f - g + jnp.asarray(_K_SUB))
+    return _carry(f - g)
 
 
-def f_mul(f, g):
-    # schoolbook convolution: 19 coefficients
-    c = [jnp.zeros(jnp.broadcast_shapes(f.shape[:-1], g.shape[:-1]), jnp.int64)
-         for _ in range(2 * NLIMB - 1)]
-    for i in range(NLIMB):
-        fi = f[..., i]
-        for j in range(NLIMB):
-            c[i + j] = c[i + j] + fi * g[..., j]
-    # fold coefficients 10..18 down with weight 2^260 ≡ FOLD, splitting into
-    # 26-bit halves so the x608 products stay far below 2^63
+def _fold_coeffs(c: list):
+    """Schoolbook coefficient list [2*NLIMB-1] -> NLIMB limbs via the
+    2^260 ≡ FOLD wrap, splitting each high coefficient into 13-bit halves
+    so the x608 products stay inside int32."""
     for k in range(2 * NLIMB - 2, NLIMB - 1, -1):
         lo = c[k] & MASK
         hi = c[k] >> RADIX
@@ -168,25 +152,48 @@ def f_mul(f, g):
     return _carry(jnp.stack(c[:NLIMB], axis=-1))
 
 
+def f_mul(f, g):
+    # schoolbook convolution: 39 coefficients, 400 int32 products
+    c = [jnp.zeros(jnp.broadcast_shapes(f.shape[:-1], g.shape[:-1]), _I32)
+         for _ in range(2 * NLIMB - 1)]
+    for i in range(NLIMB):
+        fi = f[..., i]
+        for j in range(NLIMB):
+            c[i + j] = c[i + j] + fi * g[..., j]
+    return _fold_coeffs(c)
+
+
+def f_sqr(f):
+    """Squaring: symmetric schoolbook, 210 products (~0.55x f_mul)."""
+    f2 = f + f                      # limbs < 2^14.01, products < 2^27.02
+    c = [jnp.zeros(f.shape[:-1], _I32) for _ in range(2 * NLIMB - 1)]
+    for i in range(NLIMB):
+        fi = f[..., i]
+        c[2 * i] = c[2 * i] + fi * fi
+        f2i = f2[..., i]
+        for j in range(i + 1, NLIMB):
+            c[i + j] = c[i + j] + f2i * f[..., j]
+    return _fold_coeffs(c)
+
+
 def _pow2k(z, k: int):
     """z^(2^k) as a k-iteration squaring loop."""
-    return jax.lax.fori_loop(0, k, lambda i, v: f_mul(v, v), z)
+    return jax.lax.fori_loop(0, k, lambda i, v: f_sqr(v), z)
 
 
 def f_inv(z):
-    """z^(p-2) (Fermat inversion) via the standard curve25519 addition chain:
-    254 squarings (grouped into pow2k fori_loops so the compiled graph stays
-    small) + 11 multiplies — half the multiplies of a square-and-multiply
-    ladder.
+    """z^(p-2) (Fermat inversion) via the standard curve25519 addition
+    chain: 254 squarings (grouped into pow2k fori_loops so the compiled
+    graph stays small) + 11 multiplies.
 
-    Needed to compress the recomputed R' on device (affine y = Y/Z), which is
-    what lets verification compare raw signature bytes instead of paying a
-    pure-Python modular sqrt per signature on host to decompress R.
+    Needed to compress the recomputed R' on device (affine y = Y/Z), which
+    is what lets verification compare raw signature bytes instead of paying
+    a pure-Python modular sqrt per signature on host to decompress R.
     """
-    z2 = f_mul(z, z)                                  # 2
+    z2 = f_sqr(z)                                     # 2
     z9 = f_mul(_pow2k(z2, 2), z)                      # 9
     z11 = f_mul(z9, z2)                               # 11
-    z_5 = f_mul(f_mul(z11, z11), z9)                  # 2^5 - 1
+    z_5 = f_mul(f_sqr(z11), z9)                       # 2^5 - 1
     z_10 = f_mul(_pow2k(z_5, 5), z_5)                 # 2^10 - 1
     z_20 = f_mul(_pow2k(z_10, 10), z_10)              # 2^20 - 1
     z_40 = f_mul(_pow2k(z_20, 20), z_20)              # 2^40 - 1
@@ -198,9 +205,9 @@ def f_inv(z):
 
 
 def _carry_strict(c):
-    """Fully normalized limbs in [0, 2^26) via _carry + two sequential
+    """Fully normalized limbs in [0, 2^13) via _carry + two sequential
     signed borrow passes (arithmetic >> floors, so borrows propagate).
-    Only used on the cold path (f_canon) — the sequential pass is 10 deep."""
+    Only used on the cold path (f_canon)."""
     c = _carry(c)
     for _ in range(2):
         out = []
@@ -213,19 +220,21 @@ def _carry_strict(c):
     return c
 
 
+_TOP_BITS = 255 - (NLIMB - 1) * RADIX    # bits of limb 19 below 2^255 (= 8)
+
+
 def f_canon(f):
     """Canonical form in [0, p).
 
-    Carried limb form encodes values up to 2^260 ≈ 32p, so conditional
-    subtraction alone is NOT enough: first fold the bits at and above 2^255
-    (limb 9 bits >= 21) down with weight 19, bringing the value below
-    2^255 + 19*32 < 2p; then subtract p up to two times. The 40p margin
-    added up front restores limbwise positivity (carried limbs can dip to
-    -2) and is folded away with the other >= 2^255 content.
+    Carried limb form encodes values up to ~2^260 ≈ 32p (and transiently
+    negative ones), so conditional subtraction alone is NOT enough: add a
+    40p margin (limb floors restore positivity), fold the bits at and
+    above 2^255 down with weight 19, then subtract p up to two times.
     """
-    f = _carry_strict(f + jnp.asarray(_K_SUB))
-    top = f[..., 9] >> jnp.int64(255 - 9 * RADIX)
-    f = f.at[..., 9].set(f[..., 9] & jnp.int64((1 << (255 - 9 * RADIX)) - 1))
+    f = _carry_strict(f + jnp.asarray(_K_MARGIN))
+    top = f[..., NLIMB - 1] >> _I32(_TOP_BITS)
+    f = f.at[..., NLIMB - 1].set(
+        f[..., NLIMB - 1] & _I32((1 << _TOP_BITS) - 1))
     f = f.at[..., 0].add(top * 19)
     f = _carry_strict(f)
     p_limbs = jnp.asarray(int_to_limbs(P))
@@ -242,62 +251,73 @@ def f_canon(f):
 
 
 # --- point ops: extended twisted Edwards (X:Y:Z:T), a = -1 ----------------
-# Identity is (0, 1, 1, 0).
-#
-# All formulas below take CARRIED coordinates (every coordinate a caller can
-# pass is an f_mul output or a canonical host constant) and produce CARRIED
-# coordinates; the lazy add_nc/sub_nc intermediates never feed another
-# add/sub, only f_mul.
+# Identity is (0, 1, 1, 0). Every coordinate in and out is CARRIED.
 
 def pt_add(p1, p2):
     """Unified addition (add-2008-hwcd-3): complete, handles identity & P+P."""
     x1, y1, z1, t1 = p1
     x2, y2, z2, t2 = p2
-    a = f_mul(sub_nc(y1, x1), sub_nc(y2, x2))
-    b = f_mul(add_nc(y1, x1), add_nc(y2, x2))
+    a = f_mul(f_sub(y1, x1), f_sub(y2, x2))
+    b = f_mul(f_add(y1, x1), f_add(y2, x2))
     c = f_mul(f_mul(t1, t2), jnp.asarray(int_to_limbs(D2)))
     zz = f_mul(z1, z2)
-    d = add_nc(zz, zz)
-    e = sub_nc(b, a)
-    f_ = sub_nc(d, c)
-    g = add_nc(d, c)
-    h = add_nc(b, a)
+    d = f_add(zz, zz)
+    e = f_sub(b, a)
+    f_ = f_sub(d, c)
+    g = f_add(d, c)
+    h = f_add(b, a)
     return (f_mul(e, f_), f_mul(g, h), f_mul(f_, g), f_mul(e, h))
 
 
 def pt_add_t2d(p1, q):
     """Addition where the second operand carries a precomputed 2d*T
-    coordinate: q = (X2, Y2, Z2, T2D2) — saves the d2 multiply (8M)."""
+    coordinate: q = (X2, Y2, Z2, T2D2) — saves the d2 multiply."""
     x1, y1, z1, t1 = p1
     x2, y2, z2, t2d2 = q
-    a = f_mul(sub_nc(y1, x1), sub_nc(y2, x2))
-    b = f_mul(add_nc(y1, x1), add_nc(y2, x2))
+    a = f_mul(f_sub(y1, x1), f_sub(y2, x2))
+    b = f_mul(f_add(y1, x1), f_add(y2, x2))
     c = f_mul(t1, t2d2)
     zz = f_mul(z1, z2)
-    d = add_nc(zz, zz)
-    e = sub_nc(b, a)
-    f_ = sub_nc(d, c)
-    g = add_nc(d, c)
-    h = add_nc(b, a)
+    d = f_add(zz, zz)
+    e = f_sub(b, a)
+    f_ = f_sub(d, c)
+    g = f_add(d, c)
+    h = f_add(b, a)
+    return (f_mul(e, f_), f_mul(g, h), f_mul(f_, g), f_mul(e, h))
+
+
+def pt_madd(p1, ypx, ymx, t2d):
+    """Mixed addition with an affine niels point (y+x, y-x, 2d*x*y),
+    Z = 1 implied — the fixed-base comb form (7 multiplies).
+    The niels identity is (1, 1, 0)."""
+    x1, y1, z1, t1 = p1
+    a = f_mul(f_sub(y1, x1), ymx)
+    b = f_mul(f_add(y1, x1), ypx)
+    c = f_mul(t1, t2d)
+    d = f_add(z1, z1)
+    e = f_sub(b, a)
+    f_ = f_sub(d, c)
+    g = f_add(d, c)
+    h = f_add(b, a)
     return (f_mul(e, f_), f_mul(g, h), f_mul(f_, g), f_mul(e, h))
 
 
 def pt_double(p1):
-    """dbl-2008-hwcd for a = -1 (ref10 sign convention)."""
+    """dbl-2008-hwcd for a = -1 (ref10 sign convention): 4 squarings +
+    4 multiplies."""
     x1, y1, z1, _ = p1
-    a = f_mul(x1, x1)
-    b = f_mul(y1, y1)
-    zz = f_mul(z1, z1)
-    c = add_nc(zz, zz)
-    h = add_nc(a, b)
-    xy = add_nc(x1, y1)
-    e = sub_nc(h, f_mul(xy, xy))
-    g = sub_nc(a, b)
-    f_ = add_nc(c, g)
+    a = f_sqr(x1)
+    b = f_sqr(y1)
+    zz = f_sqr(z1)
+    c = f_add(zz, zz)
+    h = f_add(a, b)
+    e = f_sub(h, f_sqr(f_add(x1, y1)))
+    g = f_sub(a, b)
+    f_ = f_add(c, g)
     return (f_mul(e, f_), f_mul(g, h), f_mul(f_, g), f_mul(e, h))
 
 
-# --- fixed-base comb table (host-built, Python ints, one batch inversion) --
+# --- host-side extended-coordinate helpers (Python ints) ------------------
 
 def _ext_add_int(p, q):
     x1, y1, z1, t1 = p
@@ -322,35 +342,46 @@ def _ext_dbl_int(p):
     return (e * f % P, g * h % P, f * g % P, e * h % P)
 
 
-_B_COMB: tuple | None = None     # (x, y, t2d) each np.int64[2, 16, NLIMB]
+def ext_quarters(pt: tuple[int, int]) -> np.ndarray:
+    """Affine host point -> int32[4, 4, NLIMB]: the four quarter points
+    [2^(64k)]pt for k = 0..3 in extended coordinates (X:Y:Z:T). The chain
+    is 192 extended doublings with NO modular inversions (T is tracked
+    through _ext_dbl_int), which keeps the per-new-verkey host cost low."""
+    x, y = pt
+    p = (x, y, 1, x * y % P)
+    out = np.zeros((N_QUARTERS, 4, NLIMB), np.int32)
+    for k in range(N_QUARTERS):
+        for c in range(4):
+            out[k, c] = int_to_limbs(p[c])
+        if k != N_QUARTERS - 1:
+            for _ in range(QUARTER_SHIFT):
+                p = _ext_dbl_int(p)
+    return out
 
 
-def b_comb_table() -> tuple:
-    """Two 16-entry window tables for the fixed base:
-    T[0][d] = d*B and T[1][d] = d*[2^128]B, as affine (x, y, 2d*x*y) rows
-    (Z = 1 implied; entry 0 is the identity (0, 1, 0)).
+# --- fixed-base comb table (host-built, one batch inversion) --------------
 
-    S is split like h: S = s_lo + 2^128*s_hi. At main-loop iteration i
-    (processing window t = N_WIN-1-i) an added point gets scaled by the
-    remaining doublings, i.e. by 16^t — so adding T[0][digit_t(s_lo)] and
-    T[1][digit_t(s_hi)] contributes digit*16^t*B resp. digit*16^t*2^128*B,
-    exactly the windowed decomposition of [S]B, with zero extra doublings.
-    """
+_B_COMB: np.ndarray | None = None   # float32[N_COMB, 256, 3*NLIMB]
+
+
+def b_comb_table() -> np.ndarray:
+    """32 position tables for the fixed base B: T[w][d] = d*256^w*B as
+    affine niels rows (y+x, y-x, 2d*x*y), entry 0 the niels identity
+    (1, 1, 0). Stored as float32 so selection is ONE one-hot matmul per
+    position (values < 2^13 are exact in f32) riding the MXU."""
     global _B_COMB
     if _B_COMB is not None:
         return _B_COMB
-    bases = [(BX, BY, 1, BX * BY % P)]
-    b2 = bases[0]
-    for _ in range(HALF_SHIFT):
-        b2 = _ext_dbl_int(b2)
-    bases.append(b2)
+    base = (BX, BY, 1, BX * BY % P)
     ext: list[list[tuple]] = []
-    for base in bases:
+    for w in range(N_COMB):
         row = [base]
-        for _ in range(2, 16):
+        for _ in range(2, 256):
             row.append(_ext_add_int(row[-1], base))
         ext.append(row)
-    # batch-invert all Z's (Montgomery's trick: one modular inversion total)
+        if w != N_COMB - 1:
+            for _ in range(CBITS):
+                base = _ext_dbl_int(base)
     zs = [p[2] for row in ext for p in row]
     prefix = [1]
     for z in zs:
@@ -360,63 +391,43 @@ def b_comb_table() -> tuple:
     for i in range(len(zs) - 1, -1, -1):
         zinv[i] = prefix[i] * inv_all % P
         inv_all = inv_all * zs[i] % P
-    tx = np.zeros((2, 16, NLIMB), np.int64)
-    ty = np.zeros((2, 16, NLIMB), np.int64)
-    t2d = np.zeros((2, 16, NLIMB), np.int64)
-    for w in range(2):
-        ty[w, 0] = int_to_limbs(1)             # digit 0: identity (0, 1, 0)
-        for d in range(1, 16):
+    tab = np.zeros((N_COMB, 256, 3, NLIMB), np.float32)
+    for w in range(N_COMB):
+        tab[w, 0, 0] = int_to_limbs(1)      # identity niels: (1, 1, 0)
+        tab[w, 0, 1] = int_to_limbs(1)
+        for d in range(1, 256):
             x, y, _, _ = ext[w][d - 1]
-            zi = zinv[w * 15 + d - 1]
+            zi = zinv[w * 255 + d - 1]
             xa, ya = x * zi % P, y * zi % P
-            tx[w, d] = int_to_limbs(xa)
-            ty[w, d] = int_to_limbs(ya)
-            t2d[w, d] = int_to_limbs(D2 * xa * ya % P)
-    _B_COMB = (tx, ty, t2d)
+            tab[w, d, 0] = int_to_limbs((ya + xa) % P)
+            tab[w, d, 1] = int_to_limbs((ya - xa) % P)
+            tab[w, d, 2] = int_to_limbs(D2 * xa * ya % P)
+    _B_COMB = tab.reshape(N_COMB, 256, 3 * NLIMB)
     return _B_COMB
-
-
-def mul_pow2_affine(pt: tuple[int, int], k: int) -> tuple[int, int]:
-    """[2^k] * pt for an affine host point — extended-coordinate doublings
-    (no per-step inversion) + one final inversion. Used to cache
-    A2 = [2^128](-A) per verkey."""
-    x, y = pt
-    p = (x, y, 1, x * y % P)
-    for _ in range(k):
-        p = _ext_dbl_int(p)
-    zi = pow(p[2], P - 2, P)
-    return (p[0] * zi % P, p[1] * zi % P)
 
 
 # --- the kernel -----------------------------------------------------------
 
-def _onehot(digits):
-    """int64[..., T] digit array -> int64[..., T, 16] one-hot mask."""
-    return (digits[..., None] == jnp.arange(16, dtype=digits.dtype)
-            ).astype(jnp.int64)
+def _build_a_tables(qx, qy, qz, qt):
+    """16-entry window tables for all four quarters in one batched build.
 
-
-def _build_a_tables(qx, qy, qt, n_half: int):
-    """16-entry window tables for BOTH halves in one batched build.
-
-    q* are [2*n_half, NLIMB]: rows [:n_half] = -A, rows [n_half:] = [2^128](-A)
-    (affine, Z = 1, T = X*Y). Returns 4 arrays [16, 2*n_half, NLIMB]
-    (x, y, z, t2d) — entry d = [d]q, entry 0 = identity.
+    q* are [4*n, NLIMB] int32: the stacked quarter points (extended,
+    PROJECTIVE — Z need not be 1, which is what lets the host skip
+    inversions). Returns 4 arrays [16, 4*n, NLIMB] (x, y, z, t2d) —
+    entry d = [d]q, entry 0 = identity.
 
     Built as a 7-step fori_loop (tab[2k] = dbl(tab[k]);
     tab[2k+1] = tab[2k] + q) so the compiled graph stays small.
     """
     m = qx.shape[0]
     ones = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), (m, NLIMB))
-    zeros = jnp.zeros((m, NLIMB), jnp.int64)
-    tx = jnp.zeros((16, m, NLIMB), jnp.int64).at[1].set(qx)
-    ty = jnp.zeros((16, m, NLIMB), jnp.int64).at[0].set(ones).at[1].set(qy)
-    tz = jnp.zeros((16, m, NLIMB), jnp.int64).at[0].set(ones).at[1].set(ones)
-    tt = jnp.zeros((16, m, NLIMB), jnp.int64).at[1].set(qt)
-    q = (qx, qy, ones, qt)
+    tx = jnp.zeros((16, m, NLIMB), _I32).at[1].set(qx)
+    ty = jnp.zeros((16, m, NLIMB), _I32).at[0].set(ones).at[1].set(qy)
+    tz = jnp.zeros((16, m, NLIMB), _I32).at[0].set(ones).at[1].set(qz)
+    tt = jnp.zeros((16, m, NLIMB), _I32).at[1].set(qt)
+    q = (qx, qy, qz, qt)
 
     def body(k, tabs):
-        tx, ty, tz, tt = tabs
         pk = tuple(t[k] for t in tabs)
         dbl = pt_double(pk)
         odd = pt_add(dbl, q)
@@ -434,89 +445,80 @@ def _build_a_tables(qx, qy, qt, n_half: int):
 
 
 @jax.jit
-def verify_kernel(s_digits, h0_digits, h1_digits,
-                  a0x, a0y, a0t, a1x, a1y, a1t, ry, r_sign):
-    """Batched check compress([S]B + [h0]A' + [h1]A2') == R-bytes.
+def verify_kernel(s_digits, h_digits, aq, ry, r_sign):
+    """Batched check compress([S]B + [h](-A)) == R-bytes.
 
-    A' = -A and A2' = [2^128](-A) are host-prepped affine points (Z = 1,
-    T = X*Y); h = h0 + 2^128*h1. This is the ref10/OpenSSL verification
-    shape: recompute R' = [S]B - [h]A, compress it, and compare against the
-    first 32 signature bytes — so the host never decompresses R (no
-    per-signature modular sqrt; non-canonical or off-curve R encodings simply
-    fail the compare, same verdict OpenSSL gives).
+    This is the ref10/OpenSSL verification shape: recompute
+    R' = [S]B - [h]A, compress it, and compare against the first 32
+    signature bytes — the host never decompresses R (no per-signature
+    modular sqrt; non-canonical or off-curve R encodings simply fail the
+    compare, the same verdict OpenSSL gives).
 
-    s_digits:  int64[N_COMB, N] little-endian 4-bit comb digits of S.
-    h0/h1_digits: int64[N_WIN, N] little-endian 4-bit windows of the halves.
-    a0*/a1*:   int64[N, 10] affine limbs of A' resp. A2'.
-    ry:        int64[N, 10] limbs of the low 255 bits of the R encoding.
-    r_sign:    int64[N] top bit of the R encoding (x parity).
+    s_digits: int32[N_COMB, N] little-endian 8-bit comb digits of S.
+    h_digits: int32[N_WIN, N_QUARTERS, N] little-endian 4-bit windows of
+              the 64-bit quarters of h.
+    aq:       int32[N, 4, 4, NLIMB] extended quarter points [2^64k](-A)
+              (host-prepped; projective — Z need not be 1).
+    ry:       int32[N, NLIMB] limbs of the low 255 bits of the R encoding.
+    r_sign:   int32[N] top bit of the R encoding (x parity).
     Returns bool[N].
     """
-    if s_digits.dtype != jnp.int64:
-        raise TypeError("verify_kernel needs int64 inputs — jax x64 mode is off")
-    n = a0x.shape[0]
+    if s_digits.dtype != jnp.int32:
+        raise TypeError("verify_kernel v3 takes int32 inputs")
+    n = aq.shape[0]
     ones = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), (n, NLIMB))
-    zeros = jnp.zeros((n, NLIMB), jnp.int64)
+    zeros = jnp.zeros((n, NLIMB), _I32)
 
+    # quarter-major stacking: row k*n + i is quarter k of signature i
+    qrows = jnp.moveaxis(aq, 0, 1)                     # [4, N, 4, NLIMB]
     tx, ty, tz, t2d = _build_a_tables(
-        jnp.concatenate([a0x, a1x]), jnp.concatenate([a0y, a1y]),
-        jnp.concatenate([a0t, a1t]), n)
+        qrows[:, :, 0].reshape(-1, NLIMB), qrows[:, :, 1].reshape(-1, NLIMB),
+        qrows[:, :, 2].reshape(-1, NLIMB), qrows[:, :, 3].reshape(-1, NLIMB))
 
-    # ---- operand banks: ALL table selections precomputed outside the loop
-    # (selections depend only on digits, never on the accumulator). This
-    # keeps the fori_loop body tiny — compile time on the TPU backend is
-    # dominated by loop-body HLO size, and int64 lowering multiplies it.
-    # Selection is masked multiply + reduce (NOT einsum/dot_general: the TPU
-    # X64 rewriter has no int64 dot_general lowering).
+    # ---- operand banks: table selections precomputed outside the loop
+    # (they depend only on digits, never on the accumulator).
+    # A-tables vary per signature -> f32 one-hot einsum on the VPU
+    # (exact: carried limbs < 2^14 << 2^24). B comb tables are batch
+    # constants -> one-hot MATMUL on the MXU.
+    tab = jnp.stack([tx, ty, tz, t2d])                 # [4c, 16, 4N, L]
+    tab = tab.reshape(4, 16, N_QUARTERS, n, NLIMB)
+    tabf = jnp.transpose(tab, (2, 3, 1, 0, 4)).astype(jnp.float32)
+    tabf = tabf.reshape(N_QUARTERS, n, 16, 4 * NLIMB)  # [q, N, d, 4L]
+    oh_h = (h_digits[..., None] == jnp.arange(16, dtype=_I32)
+            ).astype(jnp.float32)                      # [W, q, N, 16]
+    bank_a = jnp.einsum('wqnd,qndl->wqnl', oh_h, tabf,
+                        precision=jax.lax.Precision.HIGHEST)
+    bank_a = bank_a.astype(_I32)                       # [W, q, N, 4L]
 
-    def sel_a(tab, oh):
-        """[16, N, 10] table x one-hot [W, N, 16] -> [W, N, 10]."""
-        return jnp.sum(oh[:, :, :, None] * jnp.transpose(tab, (1, 0, 2))[None],
-                       axis=2)
-
-    def sel_b(cb, oh):
-        """[16, 10] const table x one-hot [W, N, 16] -> [W, N, 10]."""
-        return jnp.sum(oh[:, :, :, None] * cb[None, None], axis=2)
-
-    oh_h0 = _onehot(h0_digits)             # [N_WIN, N, 16]
-    oh_h1 = _onehot(h1_digits)
-    oh_s0 = _onehot(s_digits[:N_WIN])      # low half of S's 64 digits
-    oh_s1 = _onehot(s_digits[N_WIN:])
-    cb_x, cb_y, cb_t2d = (jnp.asarray(t) for t in b_comb_table())
-
-    ta0 = tuple(t[:, :n] for t in (tx, ty, tz, t2d))
-    ta1 = tuple(t[:, n:] for t in (tx, ty, tz, t2d))
-    ones_w = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)),
-                              (N_WIN, n, NLIMB))
-    # per-window add operands, stacked [N_WIN, 4, N, 10] per coordinate:
-    # j=0: [h0]win of A', j=1: [h1]win of A2', j=2/3: fixed-base windows
-    # (S = s_lo + 2^128*s_hi; window t of each half aligns with the
-    # remaining-doubling scale 16^t — see b_comb_table)
-    bank = []
-    for coord, a_idx, cb in ((0, 0, cb_x), (1, 1, cb_y), (2, 2, None),
-                             (3, 3, cb_t2d)):
-        j0 = sel_a(ta0[a_idx], oh_h0)
-        j1 = sel_a(ta1[a_idx], oh_h1)
-        if cb is None:                     # B entries are affine: Z = 1
-            j2 = j3 = ones_w
-        else:
-            j2 = sel_b(cb[0], oh_s0)
-            j3 = sel_b(cb[1], oh_s1)
-        bank.append(jnp.stack([j0, j1, j2, j3], axis=1))
-    ox, oy, oz, ot = bank                  # each [N_WIN, 4, N, 10]
+    oh_s = (s_digits[..., None] == jnp.arange(256, dtype=_I32)
+            ).astype(jnp.float32)                      # [N_COMB, N, 256]
+    cb = jnp.asarray(b_comb_table())                   # [N_COMB, 256, 3L]
+    bank_b = jnp.einsum('wnd,wdl->wnl', oh_s, cb,
+                        precision=jax.lax.Precision.HIGHEST)
+    bank_b = bank_b.astype(_I32)                       # [N_COMB, N, 3L]
 
     def win_body(i, acc):
         t = N_WIN - 1 - i                  # MSB-first windows
         acc = jax.lax.fori_loop(0, WBITS, lambda _, a: pt_double(a), acc)
-        qx = jax.lax.dynamic_index_in_dim(ox, t, 0, keepdims=False)
-        qy = jax.lax.dynamic_index_in_dim(oy, t, 0, keepdims=False)
-        qz = jax.lax.dynamic_index_in_dim(oz, t, 0, keepdims=False)
-        qt = jax.lax.dynamic_index_in_dim(ot, t, 0, keepdims=False)
-        return jax.lax.fori_loop(
-            0, 4, lambda j, a: pt_add_t2d(a, (qx[j], qy[j], qz[j], qt[j])),
-            acc)
+        qsel = jax.lax.dynamic_index_in_dim(bank_a, t, 0, keepdims=False)
+
+        def add_q(k, a):
+            row = qsel[k].reshape(n, 4, NLIMB)
+            return pt_add_t2d(a, (row[:, 0], row[:, 1], row[:, 2],
+                                  row[:, 3]))
+
+        return jax.lax.fori_loop(0, N_QUARTERS, add_q, acc)
 
     acc = jax.lax.fori_loop(0, N_WIN, win_body, (zeros, ones, ones, zeros))
+
+    def add_comb(w, a):
+        # comb entries carry ABSOLUTE scale 256^w, so they must be added
+        # after the doubling ladder has finished (zero remaining doublings)
+        row = jax.lax.dynamic_index_in_dim(
+            bank_b, w, 0, keepdims=False).reshape(n, 3, NLIMB)
+        return pt_madd(a, row[:, 0], row[:, 1], row[:, 2])
+
+    acc = jax.lax.fori_loop(0, N_COMB, add_comb, acc)
     px, py, pz, _ = acc
     # compress on device: affine (x, y) via one shared inversion of Z
     # (complete Edwards formulas keep Z != 0 for all valid inputs)
@@ -524,11 +526,11 @@ def verify_kernel(s_digits, h0_digits, h1_digits,
     x_aff = f_canon(f_mul(px, zinv))
     y_aff = f_canon(f_mul(py, zinv))
     ok_y = jnp.all(y_aff == ry, axis=-1)
-    ok_sign = (x_aff[..., 0] & jnp.int64(1)) == r_sign
+    ok_sign = (x_aff[..., 0] & _I32(1)) == r_sign
     return ok_y & ok_sign
 
 
-# --- host-side helpers ----------------------------------------------------
+# --- host-side affine helpers (shared with tests & tools) -----------------
 
 def edwards_add(p1: tuple[int, int], p2: tuple[int, int]) -> tuple[int, int]:
     """Affine Edwards addition over Python ints (host-side, no deps)."""
@@ -541,13 +543,14 @@ def edwards_add(p1: tuple[int, int], p2: tuple[int, int]) -> tuple[int, int]:
 
 
 def edwards_mul(k: int, pt: tuple[int, int]) -> tuple[int, int]:
-    acc = (0, 1)
+    acc = None
+    add = pt
     while k:
         if k & 1:
-            acc = edwards_add(acc, pt)
-        pt = edwards_add(pt, pt)
+            acc = add if acc is None else edwards_add(acc, add)
+        add = edwards_add(add, add)
         k >>= 1
-    return acc
+    return acc if acc is not None else (0, 1)
 
 
 def compress(pt: tuple[int, int]) -> bytes:
@@ -556,26 +559,27 @@ def compress(pt: tuple[int, int]) -> bytes:
 
 
 def pure_python_sign(seed: bytes, msg: bytes) -> tuple[bytes, bytes]:
-    """RFC 8032 signing with no external deps -> (sig64, verkey32).
-
-    Slow (pure-int scalar mults); for benches/examples where the
-    `cryptography` package may be absent, NOT for production signing.
-    """
-    import hashlib as _hl
-    hd = _hl.sha512(seed).digest()
-    a = int.from_bytes(hd[:32], "little")
-    a = (a & ((1 << 254) - 8)) | (1 << 254)
-    B = (BX, BY)
-    vk = compress(edwards_mul(a, B))
-    r = int.from_bytes(_hl.sha512(hd[32:] + msg).digest(), "little") % L
-    r_c = compress(edwards_mul(r, B))
-    h = int.from_bytes(_hl.sha512(r_c + vk + msg).digest(), "little") % L
-    s = (r + h * a) % L
-    return r_c + s.to_bytes(32, "little"), vk
+    """RFC 8032 signing without external deps -> (signature, verkey).
+    For tools/tests/the graft entry in environments without `cryptography`."""
+    import hashlib
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    prefix = h[32:]
+    A = edwards_mul(a, (BX, BY))
+    vk = compress(A)
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
+    R = edwards_mul(r, (BX, BY))
+    r_enc = compress(R)
+    k = int.from_bytes(hashlib.sha512(r_enc + vk + msg).digest(),
+                       "little") % L
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little"), vk
 
 
 def decompress(comp: bytes):
-    """32-byte compressed Edwards point -> (x, y) ints, or None if invalid."""
+    """Verkey/R bytes -> affine point, or None if not on curve."""
     if len(comp) != 32:
         return None
     y = int.from_bytes(comp, "little")
@@ -586,12 +590,15 @@ def decompress(comp: bytes):
     y2 = y * y % P
     u = (y2 - 1) % P
     v = (D * y2 + 1) % P
-    # x = u/v ^ ((p+3)/8) candidate (RFC 8032 §5.1.3)
-    x = (u * pow(v, 3, P)) * pow(u * pow(v, 7, P), (P - 5) // 8, P) % P
-    if (v * x * x - u) % P != 0:
+    # sqrt(u/v) for p = 5 mod 8 (RFC 8032 §5.1.3)
+    x = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    vxx = v * x * x % P
+    if vxx == u % P:
+        pass
+    elif vxx == (P - u) % P:
         x = x * SQRT_M1 % P
-        if (v * x * x - u) % P != 0:
-            return None
+    else:
+        return None
     if x == 0 and sign:
         return None
     if x & 1 != sign:
@@ -599,43 +606,53 @@ def decompress(comp: bytes):
     return (x, y)
 
 
-def scalar_windows(values: list[int], n_windows: int) -> np.ndarray:
-    """[N] ints -> int64[n_windows, N] little-endian 4-bit digits."""
-    nbytes = (n_windows * WBITS + 7) // 8
-    raw = b"".join(v.to_bytes(nbytes, "little") for v in values)
-    arr = np.frombuffer(raw, dtype=np.uint8).reshape(len(values), nbytes)
-    bits = np.unpackbits(arr, axis=1, bitorder="little")
-    weights = (1 << np.arange(WBITS, dtype=np.int64))
-    digits = bits[:, :n_windows * WBITS].reshape(
-        len(values), n_windows, WBITS).astype(np.int64) @ weights
-    return digits.T.copy()
+def scalar_windows(values: list[int], n_windows: int,
+                   bits: int = WBITS) -> np.ndarray:
+    """[n_windows, N] little-endian `bits`-wide digits (int32).
+
+    Vectorized: one to_bytes per value (C speed), then numpy byte/nibble
+    splitting — this runs on the per-dispatch host hot path."""
+    nbytes = (n_windows * bits + 7) // 8
+    raw = np.frombuffer(
+        b"".join(v.to_bytes(nbytes, "little") for v in values),
+        dtype=np.uint8).reshape(len(values), nbytes)
+    if bits == 8:
+        out = raw[:, :n_windows].astype(np.int32)
+    elif bits == 4:
+        nib = np.empty((len(values), 2 * nbytes), np.uint8)
+        nib[:, 0::2] = raw & 0x0F
+        nib[:, 1::2] = raw >> 4
+        out = nib[:, :n_windows].astype(np.int32)
+    else:
+        raise ValueError(f"unsupported window width {bits}")
+    return np.ascontiguousarray(out.T)
+
+
+# bit b of a 255-bit little-endian value belongs to limb b//13, weight
+# 2^(b%13); bit 255 is the sign bit (excluded)
+_BIT_TO_LIMB = np.zeros((256, NLIMB), np.int32)
+for _b in range(255):
+    _BIT_TO_LIMB[_b, _b // RADIX] = 1 << (_b % RADIX)
 
 
 def r_bytes_to_limbs(r_encodings: Sequence[bytes]) -> tuple[np.ndarray, np.ndarray]:
-    """[N] 32-byte R encodings -> (ry int64[N, 10], sign int64[N]).
-
-    Pure bit repacking (vectorized numpy) — no field math, no sqrt.
-    """
-    n = len(r_encodings)
-    arr = np.frombuffer(b"".join(r_encodings), dtype=np.uint8).reshape(n, 32)
-    bits = np.unpackbits(arr, axis=1, bitorder="little")        # [N, 256]
-    sign = bits[:, 255].astype(np.int64)
-    padded = np.concatenate(
-        [bits[:, :255], np.zeros((n, NLIMB * RADIX - 255), np.uint8)], axis=1)
-    weights = (1 << np.arange(RADIX, dtype=np.int64))
-    ry = padded.reshape(n, NLIMB, RADIX).astype(np.int64) @ weights
-    return ry, sign
+    """Raw 32-byte R encodings -> (y limbs int32[N, NLIMB], sign int32[N]).
+    Vectorized: unpack bits little-endian, matmul against the bit->limb
+    weight matrix (per-dispatch host hot path)."""
+    raw = np.frombuffer(b"".join(bytes(e) for e in r_encodings),
+                        dtype=np.uint8).reshape(len(r_encodings), 32)
+    bits = np.unpackbits(raw, axis=1, bitorder="little")   # [N, 256]
+    ry = bits.astype(np.int32) @ _BIT_TO_LIMB
+    return ry, bits[:, 255].astype(np.int32)
 
 
 def points_to_limbs(points: list[tuple[int, int]]) -> tuple[np.ndarray, ...]:
-    """Affine points -> (X, Y, Z=1, T=XY) limb arrays [N, 10]."""
+    """Affine points -> (x, y, z=1, t=x*y) limb arrays int32[N, NLIMB]."""
     n = len(points)
-    xs = np.zeros((n, NLIMB), np.int64)
-    ys = np.zeros((n, NLIMB), np.int64)
-    ts = np.zeros((n, NLIMB), np.int64)
+    arrs = tuple(np.zeros((n, NLIMB), np.int32) for _ in range(4))
     for i, (x, y) in enumerate(points):
-        xs[i] = int_to_limbs(x)
-        ys[i] = int_to_limbs(y)
-        ts[i] = int_to_limbs(x * y % P)
-    ones = np.tile(int_to_limbs(1), (n, 1))
-    return xs, ys, ones, ts
+        arrs[0][i] = int_to_limbs(x)
+        arrs[1][i] = int_to_limbs(y)
+        arrs[2][i] = int_to_limbs(1)
+        arrs[3][i] = int_to_limbs(x * y % P)
+    return arrs

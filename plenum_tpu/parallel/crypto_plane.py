@@ -47,17 +47,15 @@ def _reduce_roots(roots: jax.Array) -> jax.Array:
     return sha_ops.merkle_reduce_pow2(roots)
 
 
-def _local_step(s_dig, h0_dig, h1_dig, a0x, a0y, a0t, a1x, a1y, a1t,
-                ry, r_sign, leaves):
+def _local_step(s_dig, h_dig, aq, ry, r_sign, leaves):
     """Per-shard body. Signature grid arrives as [I_loc, N_loc, ...]; the
     local grid flattens into one kernel batch. leaves: uint32[L_loc, 8]."""
-    i_loc, n_loc = a0x.shape[0], a0x.shape[1]
+    i_loc, n_loc = aq.shape[0], aq.shape[1]
     m = i_loc * n_loc
     ok = ed_ops.verify_kernel(
-        s_dig.reshape(ed_ops.N_COMB, m), h0_dig.reshape(ed_ops.N_WIN, m),
-        h1_dig.reshape(ed_ops.N_WIN, m),
-        a0x.reshape(m, -1), a0y.reshape(m, -1), a0t.reshape(m, -1),
-        a1x.reshape(m, -1), a1y.reshape(m, -1), a1t.reshape(m, -1),
+        s_dig.reshape(ed_ops.N_COMB, m),
+        h_dig.reshape(ed_ops.N_WIN, ed_ops.N_QUARTERS, m),
+        aq.reshape(m, 4, 4, ed_ops.NLIMB),
         ry.reshape(m, -1), r_sign.reshape(m))
     ok = ok.reshape(i_loc, n_loc)
 
@@ -79,24 +77,24 @@ class ShardedCryptoPlane:
 
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
-        spec_dig = P(None, "inst", "sig")          # digit arrays [T, I, N]
-        spec_pt = P("inst", "sig", None)           # limb tensors [I, N, 10]
-        spec_scalar = P("inst", "sig")             # r_sign       [I, N]
-        spec_leaf = P(("inst", "sig"), None)       # leaves       [L, 8]
+        spec_s = P(None, "inst", "sig")            # s digits [N_COMB, I, N]
+        spec_h = P(None, None, "inst", "sig")      # h digits [W, 4, I, N]
+        spec_aq = P("inst", "sig", None, None, None)   # [I, N, 4, 4, L]
+        spec_ry = P("inst", "sig", None)           # ry       [I, N, L]
+        spec_scalar = P("inst", "sig")             # r_sign   [I, N]
+        spec_leaf = P(("inst", "sig"), None)       # leaves   [L, 8]
         # check_vma off: verify_kernel seeds its fori_loop carry with
         # device-invariant constants (the identity point), which the varying-
         # manual-axes checker flags even though the computation is replicated-
         # safe.
         self._step = jax.jit(_shard_map(
             _local_step, mesh=mesh,
-            in_specs=(spec_dig, spec_dig, spec_dig, spec_pt, spec_pt, spec_pt,
-                      spec_pt, spec_pt, spec_pt, spec_pt, spec_scalar,
+            in_specs=(spec_s, spec_h, spec_aq, spec_ry, spec_scalar,
                       spec_leaf),
             out_specs=(P("inst", "sig"), P(), P()),
             check_vma=False))
 
-    def step(self, s_dig, h0_dig, h1_dig, a0x, a0y, a0t, a1x, a1y, a1t,
-             ry, r_sign, leaves):
+    def step(self, s_dig, h_dig, aq, ry, r_sign, leaves):
         """-> (ok[I, N] bool, root uint32[8], n_ok int32).
 
         Shape contract: I divides mesh 'inst' size exactly; N divides 'sig';
@@ -104,5 +102,4 @@ class ShardedCryptoPlane:
         power of two (host pads; padding is duplicate leaves whose root the
         host discards if it padded).
         """
-        return self._step(s_dig, h0_dig, h1_dig, a0x, a0y, a0t,
-                          a1x, a1y, a1t, ry, r_sign, leaves)
+        return self._step(s_dig, h_dig, aq, ry, r_sign, leaves)

@@ -40,7 +40,9 @@ def test_field_ops_match_bigint(op, pyop):
     ys = [_rand_fe(rng) for _ in range(8)] + [ops.P - 1, 0, ops.P - 1, 19]
     a = jnp.asarray(np.stack([ops.int_to_limbs(x) for x in xs]))
     b = jnp.asarray(np.stack([ops.int_to_limbs(y) for y in ys]))
-    out = fn(a, b)
+    # outputs are in CARRIED form (congruent mod p, limbs possibly signed);
+    # canonicalize before comparing against the bigint reference
+    out = ops.f_canon(fn(a, b))
     for i, (x, y) in enumerate(zip(xs, ys)):
         assert ops.limbs_to_int(np.asarray(out)[i]) == pyop(x, y), (op, i)
 
@@ -227,3 +229,44 @@ def test_pt_cache_bounded():
     for i in range(10):
         v._decompress_cached(i.to_bytes(32, "little"))
     assert len(v._pt_cache) == 4
+
+
+# --- coalescing crypto plane (co-hosted nodes, one dispatch) --------------
+
+def test_coalescing_verifier_merges_batches():
+    from plenum_tpu.crypto.ed25519 import CoalescingVerifier
+    inner = JaxEd25519Verifier(min_batch=8)
+    plane = CoalescingVerifier(inner)
+    signers = [Ed25519Signer(bytes([i + 1]) * 32) for i in range(3)]
+    batches, expects = [], []
+    for k, s in enumerate(signers):   # three "nodes" stage batches
+        items, expect = [], []
+        for i in range(2 + k):
+            m = b"node%d-msg%d" % (k, i)
+            good = (i + k) % 3 != 0
+            sig = s.sign(m) if good else b"\x01" * 64
+            items.append((m, sig, s.verkey))
+            expect.append(good)
+        batches.append(plane.submit_batch(items))
+        expects.append(expect)
+    # nothing dispatched yet; a flush sends ONE combined dispatch
+    assert plane._in_flight is None
+    assert plane.flush()
+    for tok, expect in zip(batches, expects):
+        got = plane.collect_batch(tok, wait=True)
+        assert list(got) == expect
+    # collect without explicit flush also works (self-dispatching)
+    tok = plane.submit_batch([(b"x", signers[0].sign(b"x"), signers[0].verkey)])
+    assert list(plane.collect_batch(tok, wait=True)) == [True]
+
+
+def test_coalescing_verifier_staged_while_in_flight():
+    from plenum_tpu.crypto.ed25519 import CoalescingVerifier
+    plane = CoalescingVerifier(JaxEd25519Verifier(min_batch=4))
+    s = Ed25519Signer(b"\x21" * 32)
+    t1 = plane.submit_batch([(b"a", s.sign(b"a"), s.verkey)])
+    plane.flush()
+    # second submitter stages while the first dispatch is in flight
+    t2 = plane.submit_batch([(b"b", s.sign(b"b"), s.verkey)])
+    assert list(plane.collect_batch(t1, wait=True)) == [True]
+    assert list(plane.collect_batch(t2, wait=True)) == [True]
