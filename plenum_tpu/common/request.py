@@ -31,6 +31,13 @@ class Request:
         self.protocol_version = protocol_version
         self.taa_acceptance = taa_acceptance
         self.endorser = endorser
+        # digest cache, invalidated when the signature fields change (the
+        # one post-construction mutation the test/tool pattern performs).
+        # The digest is re-derived ~100x per request across the node
+        # pipeline (propagator keys, stash keys, seq-no map, 3PC batches) —
+        # recomputing the canonical-JSON sha256 each time dominated the
+        # profile. Mutating `operation` in place is NOT tracked.
+        self._digest_cache: Optional[tuple] = None
 
     # --- serialization ---------------------------------------------------
 
@@ -87,13 +94,33 @@ class Request:
 
     # --- digests (ref request.py:87,90) ----------------------------------
 
+    def _digests(self) -> tuple:
+        # 'is not None' (not truthiness): to_dict() serializes an EMPTY
+        # signatures dict, so {} and None must produce different keys
+        sigs = tuple(sorted(self.signatures.items())) \
+            if self.signatures is not None else None
+        key = (self.signature, sigs)
+        c = self._digest_cache
+        if c is None or c[0] != key:
+            payload = self.signing_bytes()
+            d = self.signing_payload()
+            if self.signature is not None:
+                d["signature"] = self.signature
+            if self.signatures is not None:
+                d["signatures"] = self.signatures
+            self._digest_cache = c = (
+                key,
+                hashlib.sha256(signing_serialize(d)).hexdigest(),
+                hashlib.sha256(payload).hexdigest())
+        return c
+
     @property
     def digest(self) -> str:
-        return hashlib.sha256(signing_serialize(self.to_dict())).hexdigest()
+        return self._digests()[1]
 
     @property
     def payload_digest(self) -> str:
-        return hashlib.sha256(self.signing_bytes()).hexdigest()
+        return self._digests()[2]
 
     @property
     def key(self) -> str:

@@ -73,7 +73,13 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1):
     if backend == "jax":
         from plenum_tpu.crypto.ed25519 import (CoalescingVerifier,
                                                JaxEd25519Verifier)
-        plane = CoalescingVerifier(JaxEd25519Verifier(min_batch=128))
+        # one shape covering the coalesced steady state: every node can
+        # stage up to a full listener quota per cycle, so pad every
+        # dispatch to the next power of two >= n_nodes * quota
+        bucket = 1
+        while bucket < n_nodes * config.LISTENER_MESSAGE_QUOTA:
+            bucket *= 2
+        plane = CoalescingVerifier(JaxEd25519Verifier(min_batch=bucket))
     for name in names:
         bus = net.create_peer(name)
         components = NodeBootstrap(name, genesis_txns=genesis,

@@ -15,13 +15,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
-jax.config.update("jax_enable_x64", True)
+# NOTE: x64 deliberately NOT enabled — the kernels are int32 (radix-13
+# limbs) and production runs with default dtypes; tests must match.
 
 import pytest  # noqa: E402
 

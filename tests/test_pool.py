@@ -298,3 +298,64 @@ def test_named_endorser_without_signature_is_nacked():
     pool.run(5.0)
     assert any(m.req_id == 2 for m in pool.replies("Alpha", RequestNack))
     assert pool.nodes["Alpha"].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2
+
+
+class DeferredVerifier:
+    """Ed25519Verifier test double: verdicts computed at submit (C library)
+    but withheld from collect until release() — makes the async device
+    pipeline's in-flight window controllable from a test."""
+
+    def __init__(self):
+        from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+        self._inner = CpuEd25519Verifier()
+        self.released = False
+        self.submits = []               # item batches, for dispatch counting
+
+    def submit_batch(self, items):
+        self.submits.append(list(items))
+        return self._inner.verify_batch(items)
+
+    def collect_batch(self, token, wait=True):
+        if not (self.released or wait):
+            return None
+        return token
+
+    def verify_batch(self, items):
+        return self.submit_batch(items)
+
+
+def test_client_copy_parks_on_inflight_propagate_dispatch():
+    """A client request arriving while a peer's PROPAGATE of the same bytes
+    is already being verified must NOT start a second device dispatch: it
+    parks on the digest and settles on the in-flight verdict."""
+    pool = Pool()
+    beta = pool.nodes["Beta"]
+    deferred = DeferredVerifier()
+    beta.c.authenticator.core_authenticator.verifier = deferred
+
+    user = Ed25519Signer(seed=b"parked-user".ljust(32, b"\0"))
+    req = signed_nym(pool.trustee, user, req_id=77)
+
+    # Alpha sees the request first and propagates; Beta's propagate-path
+    # dispatch goes in flight and stays there (verdict withheld)
+    pool.submit(req, to=["Alpha"])
+    pool.run(2.0)           # < MAX_AUTH_POLLS prods: Beta must not block
+    assert len(deferred.submits) == 1
+    assert req.digest in beta._authing
+
+    # now the client's own copy reaches Beta: parked, not re-dispatched
+    pool.submit(req, to=["Beta"], client="cli-beta")
+    pool.run(1.0)
+    assert len(deferred.submits) == 1, "client copy must not re-dispatch"
+    assert any(kind == "client" for kind, *_ in beta._authing[req.digest])
+
+    # release the verdict: parked client gets ACKed, request orders
+    deferred.released = True
+    pool.run(6.0)
+    assert len(deferred.submits) == 1
+    assert any(isinstance(m, RequestAck) and c == "cli-beta"
+               for m, c in pool.client_msgs["Beta"])
+    assert any(isinstance(m, Reply) for m, _ in pool.client_msgs["Beta"])
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.names}
+    assert sizes == {2}
