@@ -65,9 +65,16 @@ def hex_prefix_decode(data: bytes) -> tuple[list[int], bool]:
 
 
 class Trie:
+    # hashed refs are content-addressed, so a decoded node can be cached
+    # forever; the upper levels of the trie repeat on every key's path and
+    # their RLP decode dominated the pool write profile. Bounded: drop the
+    # oldest half when full (insertion order ~ recency for trie walks).
+    _DECODE_CACHE_MAX = 1 << 16
+
     def __init__(self, db: Optional[KeyValueStorage] = None,
                  root_hash: bytes = BLANK_ROOT):
         self.db = db if db is not None else KvMemory()
+        self._decoded: dict[bytes, object] = {}
         self.root_node = self._decode_ref_root(root_hash)
 
     # --- refs -------------------------------------------------------------
@@ -81,17 +88,31 @@ class Trie:
             return node
         h = sha3(enc)
         self.db.put(h, enc)
+        # freshly-stored nodes are read right back on the next key's walk;
+        # callers never mutate a node after storing it (copy-on-write)
+        self._cache_put(h, node)
         return h
 
     def _load(self, ref):
         if ref == b"" or ref == BLANK_NODE:
             return BLANK_NODE
         if isinstance(ref, bytes) and len(ref) == 32:
+            node = self._decoded.get(ref)
+            if node is not None:
+                return node
             enc = self.db.try_get(ref)
             if enc is None:
                 raise KeyError(f"missing trie node {ref.hex()}")
-            return rlp.decode(enc)
+            node = rlp.decode(enc)
+            self._cache_put(ref, node)
+            return node
         return ref          # inline node (list)
+
+    def _cache_put(self, h: bytes, node) -> None:
+        if len(self._decoded) >= self._DECODE_CACHE_MAX:
+            for k in list(self._decoded)[:self._DECODE_CACHE_MAX // 2]:
+                del self._decoded[k]
+        self._decoded[h] = node
 
     def _decode_ref_root(self, root_hash: bytes):
         if root_hash == BLANK_ROOT:

@@ -1,0 +1,258 @@
+"""Real-transport pool benchmark: N OS processes over TCP under write load.
+
+The in-process benchmark (tools/local_pool.py) measures the consensus
+pipeline over the deterministic sim fabric; THIS tool stands up the same
+pool the way an operator would — keygen + genesis + one start_node process
+per validator, authenticated-encrypted TCP between them (network/tcp_stack)
+— and drives pre-signed NYM writes through the client ports with a
+pipelined streaming client, reporting wall-clock TPS and commit latency.
+This is the framework's analog of benchmarking the reference's
+scripts/start_plenum_node x4 localhost pool.
+
+    python -m plenum_tpu.tools.tcp_pool --nodes 4 --txns 200 [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import socket
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def setup_pool_dir(base: str, names: list[str], trustee_seed: bytes):
+    """keygen + genesis files for a localhost pool -> port specs."""
+    from plenum_tpu.tools import genesis as gen
+    from plenum_tpu.tools import keygen
+
+    ports = _free_ports(2 * len(names))
+    specs = []
+    for i, name in enumerate(names):
+        keygen.save_keys(keygen.generate_keys(
+            name, seed=(b"tcppool%d" % i).ljust(32, b"\0")), base)
+        specs.append((name, "127.0.0.1", ports[2 * i], ports[2 * i + 1]))
+    gen.build_genesis_files(base, specs, trustee_seed)
+    return specs
+
+
+def _wait_all_started(procs, deadline_s: float) -> None:
+    """Wait (bounded!) for every child to print its "started" line — a
+    wedged child must fail the bench, never hang it."""
+    import selectors
+    deadline = time.perf_counter() + deadline_s
+    sel = selectors.DefaultSelector()
+    pending = {}
+    for p in procs:
+        os.set_blocking(p.stdout.fileno(), False)
+        sel.register(p.stdout, selectors.EVENT_READ, p)
+        pending[p.stdout.fileno()] = b""
+    try:
+        while pending:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                raise RuntimeError(
+                    f"{len(pending)} node(s) never reported 'started'")
+            for key, _ in sel.select(timeout=remaining):
+                fd = key.fileobj.fileno()
+                chunk = key.fileobj.read() or b""
+                buf = pending[fd] + chunk
+                if b"started" in buf:
+                    sel.unregister(key.fileobj)
+                    del pending[fd]
+                elif key.data.poll() is not None:
+                    raise RuntimeError(
+                        f"node exited before starting: {buf!r}")
+                else:
+                    pending[fd] = buf
+    finally:
+        sel.close()
+        for p in procs:
+            if p.poll() is None:
+                os.set_blocking(p.stdout.fileno(), True)
+
+
+class LoadClient:
+    """Pipelined streaming client: one connection per node, one reader task
+    per node; a request completes when f+1 DISTINCT nodes REPLY for its
+    (identifier, reqId). Unlike PoolClient.submit (one in-flight request),
+    this keeps a whole window of requests on the wire — the client side of
+    a throughput benchmark must never be the bottleneck."""
+
+    def __init__(self, addrs: dict[str, tuple[str, int]], f: int):
+        self.addrs = addrs
+        self.f = f
+        self.conns: dict[str, tuple] = {}
+        self.votes: dict[tuple, set] = {}
+        self.done: dict[tuple, float] = {}
+        self.done_evt = asyncio.Event()
+
+    async def connect(self):
+        for name, (host, port) in self.addrs.items():
+            self.conns[name] = await asyncio.open_connection(host, port)
+
+    async def close(self):
+        for _, writer in self.conns.values():
+            writer.close()
+
+    async def reader(self, name: str):
+        from plenum_tpu.common.serialization import unpack
+        reader, _ = self.conns[name]
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                frame = await reader.readexactly(int.from_bytes(hdr, "big"))
+                msg = unpack(frame)
+                if not isinstance(msg, dict) or msg.get("op") != "REPLY":
+                    continue
+                meta = msg.get("result", {}).get("txn", {}).get("metadata", {})
+                key = (meta.get("from"), meta.get("reqId"))
+                seen = self.votes.setdefault(key, set())
+                seen.add(name)
+                if len(seen) >= self.f + 1 and key not in self.done:
+                    self.done[key] = time.perf_counter()
+                    self.done_evt.set()
+        except (asyncio.IncompleteReadError, OSError):
+            return
+
+    async def send(self, payload: bytes):
+        for _, writer in self.conns.values():
+            writer.write(len(payload).to_bytes(4, "big") + payload)
+        for _, writer in self.conns.values():
+            await writer.drain()
+
+
+async def drive_load(addrs, f, requests, window: int, timeout: float):
+    """-> (done {key: t_done}, submit_times {key: t_sent})."""
+    from plenum_tpu.common.serialization import pack
+
+    client = LoadClient(addrs, f)
+    await client.connect()
+    readers = [asyncio.create_task(client.reader(n)) for n in addrs]
+    submit_times: dict[tuple, float] = {}
+    deadline = time.perf_counter() + timeout
+    try:
+        i = 0
+        while len(client.done) < len(requests):
+            if time.perf_counter() > deadline:
+                break
+            while i < len(requests) and i - len(client.done) < window:
+                req = requests[i]
+                key = (req.identifier, req.req_id)
+                submit_times[key] = time.perf_counter()
+                await client.send(pack(req.to_dict()))
+                i += 1
+            client.done_evt.clear()
+            try:
+                await asyncio.wait_for(client.done_evt.wait(), 0.25)
+            except asyncio.TimeoutError:
+                pass
+    finally:
+        for t in readers:
+            t.cancel()
+        await client.close()
+    return dict(client.done), submit_times
+
+
+def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
+                 base_dir: str | None = None, timeout: float = 120.0) -> dict:
+    from plenum_tpu.client.wallet import Wallet
+    from plenum_tpu.execution.txn import NYM
+
+    names = [f"Node{i + 1}" for i in range(n_nodes)]
+    f = (n_nodes - 1) // 3
+    tmp = base_dir or tempfile.mkdtemp(prefix="plenum_tcp_pool_")
+    trustee_seed = b"tcp-pool-trustee".ljust(32, b"\0")
+    specs = setup_pool_dir(tmp, names, trustee_seed)
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        for name in names:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "plenum_tpu.tools.start_node",
+                 "--name", name, "--base-dir", tmp, "--kv", "memory",
+                 "--backend", backend],
+                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT))
+        _wait_all_started(procs, deadline_s=60.0)
+
+        wallet = Wallet("bench")
+        trustee_did = wallet.add_identifier(seed=trustee_seed)
+        requests = []
+        for i in range(n_txns):
+            user = wallet.add_identifier(
+                seed=(b"tcpu%d" % i).ljust(32, b"\0")[:32])
+            requests.append(wallet.sign_request(
+                {"type": NYM, "dest": user,
+                 "verkey": wallet.verkey_of(user)}, identifier=trustee_did))
+
+        addrs = {name: ("127.0.0.1", spec[3])
+                 for name, spec in zip(names, specs)}
+        t0 = time.perf_counter()
+        done, submit_times = asyncio.run(
+            drive_load(addrs, f, requests, window=100, timeout=timeout))
+        t_total = (max(done.values()) - t0) if done else 0.0
+        lat = sorted(done[k] - submit_times[k] for k in done)
+        return {
+            "transport": "tcp", "nodes": n_nodes, "backend": backend,
+            "txns_ordered": len(done), "txns_requested": n_txns,
+            "seconds": round(t_total, 3),
+            "tps": round(len(done) / t_total, 1) if t_total > 0 else 0.0,
+            "p50_latency_ms": round(
+                statistics.median(lat) * 1000, 1) if lat else None,
+            "p99_latency_ms": round(
+                lat[int(len(lat) * 0.99)] * 1000, 1) if lat else None,
+        }
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if base_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--txns", type=int, default=200)
+    ap.add_argument("--backend", default="cpu", choices=["cpu", "jax"])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    stats = run_tcp_pool(args.nodes, args.txns, args.backend)
+    if args.json:
+        print(json.dumps(stats))
+    else:
+        print(f"{stats['txns_ordered']}/{stats['txns_requested']} txns in "
+              f"{stats['seconds']}s over TCP -> {stats['tps']} TPS "
+              f"(p50 {stats['p50_latency_ms']} ms, "
+              f"p99 {stats['p99_latency_ms']} ms)")
+
+
+if __name__ == "__main__":
+    main()

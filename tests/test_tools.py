@@ -11,7 +11,6 @@ import asyncio
 import json
 import os
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -80,35 +79,17 @@ def test_keygen_and_genesis_files(tmp_path):
 
 # --- 4 OS processes over real sockets -------------------------------------
 
-def _free_ports(n: int) -> list[int]:
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
 
 @pytest.mark.slow
 def test_four_process_pool_orders_nym(tmp_path):
     from plenum_tpu.client import PoolClient, Wallet
     from plenum_tpu.execution.txn import NYM
-    from plenum_tpu.tools import genesis as gen
-    from plenum_tpu.tools import keygen
+    from plenum_tpu.tools.tcp_pool import setup_pool_dir
 
     base = str(tmp_path)
     names = ["Node1", "Node2", "Node3", "Node4"]
-    ports = _free_ports(8)
-    specs = []
-    for i, name in enumerate(names):
-        keygen.save_keys(keygen.generate_keys(
-            name, seed=(b"proc%d" % i).ljust(32, b"\0")), base)
-        specs.append((name, "127.0.0.1", ports[2 * i], ports[2 * i + 1]))
     trustee_seed = b"proc-trustee".ljust(32, b"\0")
-    gen.build_genesis_files(base, specs, trustee_seed)
+    specs = setup_pool_dir(base, names, trustee_seed)
 
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     procs = []
@@ -172,3 +153,14 @@ def test_four_process_pool_orders_nym(tmp_path):
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.mark.slow
+def test_tcp_pool_bench_orders_load():
+    """The real-transport benchmark drives a 4-process TCP pool end to end:
+    every request reaches an f+1 REPLY quorum over the wire."""
+    from plenum_tpu.tools.tcp_pool import run_tcp_pool
+    stats = run_tcp_pool(n_nodes=4, n_txns=30, timeout=90.0)
+    assert stats["txns_ordered"] == 30, stats
+    assert stats["tps"] > 0
+    assert stats["p50_latency_ms"] is not None
