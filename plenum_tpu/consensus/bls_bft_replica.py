@@ -69,13 +69,24 @@ class BlsBftReplica:
                  bls_verifier: BlsCryptoVerifier,
                  key_register: BlsKeyRegister,
                  bls_store: Optional[BlsStore] = None,
-                 quorums: Optional[Quorums] = None):
+                 quorums: Optional[Quorums] = None,
+                 node_reg_at: Optional[Callable[[str], Optional[list]]] = None,
+                 key_at: Optional[Callable[[str, str],
+                                           Optional[str]]] = None):
         self._node_name = node_name
         self._signer = bls_signer
         self._verifier = bls_verifier
         self._register = key_register
         self._store = bls_store
         self._quorums = quorums or Quorums(4)
+        # pool-state-root -> node registry at that root (audit-ledger
+        # lookup, wired by the node): a multi-sig is judged by the quorum
+        # rules of the pool size it was CREATED under, not today's
+        self._node_reg_at = node_reg_at
+        # (name, pool_root_hex) -> BLS verkey at that pool state (historic
+        # MPT read): after a key ROTATION the embedded sig from just before
+        # the rotation batch verifies only against the OLD key
+        self._key_at = key_at
         # (view_no, pp_seq_no) -> {node_name: sig}
         self._sigs: dict[tuple[int, int], dict[str, str]] = {}
         # state_root -> MultiSignature for recently ordered batches
@@ -128,13 +139,36 @@ class BlsBftReplica:
         # self-aggregation).
         if len(set(ms.participants)) != len(ms.participants):
             return self.PPR_BLS_MULTISIG_WRONG
-        verkeys = [self._register.get_key_by_name(n) for n in ms.participants]
+        # A multi-sig we aggregated (or fully verified) OURSELVES passed the
+        # quorum rules in force when it was created. This shortcut must come
+        # BEFORE the current-quorum check: the first PRE-PREPARE after a pool
+        # membership change legitimately embeds the previous batch's sig,
+        # whose participant count satisfies the OLD n - f, not the new one —
+        # re-judging it with the new quorums would mark every honest primary
+        # suspicious and storm view changes on every pool growth.
+        if self._ms_key(ms) in self._verified_ms_keys:
+            return None
+        # verkeys AS OF the sig's cited pool state when resolvable (key
+        # rotation: the sig predates the new key), else the current register
+        verkeys = []
+        for n in ms.participants:
+            vk = self._key_at(n, ms.value.pool_state_root_hash) \
+                if self._key_at is not None else None
+            verkeys.append(vk or self._register.get_key_by_name(n))
         if any(v is None for v in verkeys):
             return self.PPR_BLS_MULTISIG_WRONG
-        if not self._quorums.bls_signatures.is_reached(len(ms.participants)):
+        # quorum of the pool AS OF the sig's cited pool state (each node's
+        # aggregate can pick a different participant subset, so the
+        # self-verified shortcut alone cannot cover membership changes)
+        quorums = self._quorums
+        if self._node_reg_at is not None:
+            reg = self._node_reg_at(ms.value.pool_state_root_hash)
+            if reg:
+                if not set(ms.participants) <= set(reg):
+                    return self.PPR_BLS_MULTISIG_WRONG
+                quorums = Quorums(len(reg))
+        if not quorums.bls_signatures.is_reached(len(ms.participants)):
             return self.PPR_BLS_MULTISIG_WRONG
-        if self._ms_key(ms) in self._verified_ms_keys:
-            return None          # we aggregated this exact multi-sig ourselves
         if not self._verifier.verify_multi_sig(ms.signature,
                                                ms.value.as_single_value(),
                                                verkeys):

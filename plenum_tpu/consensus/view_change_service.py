@@ -181,6 +181,12 @@ class ViewChangeService:
 
         bus.subscribe(NeedViewChange, self.process_need_view_change)
 
+    def set_instance_count(self, n: int) -> None:
+        """Pool membership changed f: the NEXT view change selects this
+        many primaries (ref adjustReplicas node.py:1260 — the instance
+        count follows f, not the view)."""
+        self._instance_count = n
+
     # --- starting a view change ------------------------------------------
 
     def process_need_view_change(self, msg: NeedViewChange) -> None:

@@ -81,6 +81,37 @@ def resolve_ledger_root(audit_ledger, audit_txn: dict, ledger_id: int) -> Option
     return None
 
 
+def iter_audit_newest_first(audit_ledger, limit: int = 600):
+    """Audit txns newest-first: staged (uncommitted) first, then committed
+    by descending seq_no, bounded — the one shared walk every audit-trail
+    recovery path uses (3PC restore, primaries resolution, BLS epochs)."""
+    n = 0
+    for txn in reversed(list(audit_ledger.uncommitted_txns)):
+        if n >= limit:
+            return
+        n += 1
+        yield txn
+    for seq in range(audit_ledger.size, 0, -1):
+        if n >= limit:
+            return
+        n += 1
+        yield audit_ledger.get_by_seq_no(seq)
+
+
+def node_reg_at_pool_root(audit_ledger, pool_root_hex: str,
+                          max_scan: int = 600) -> Optional[list]:
+    """Node registry in force at a given POOL state root, from the audit
+    trail. Used to judge an embedded BLS multi-sig by the quorum rules of
+    the pool size it was created under — the first PRE-PREPARE after a
+    membership change legitimately carries a sig whose participant count
+    satisfies the OLD n - f (see bls_bft_replica.validate_pre_prepare)."""
+    for txn in iter_audit_newest_first(audit_ledger, max_scan):
+        data = txn_lib.txn_data(txn)
+        if data.get("stateRoot", {}).get("0") == pool_root_hex:
+            return data.get("nodeReg")
+    return None
+
+
 def last_audit_txn(audit_ledger) -> Optional[dict]:
     if audit_ledger.size == 0:
         return None

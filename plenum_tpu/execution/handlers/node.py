@@ -59,7 +59,8 @@ class NodeHandler(WriteRequestHandler):
         if data.get("blskey") and data.get("blskey_pop") and \
                 self._bls_verifier is not None:
             self._require(
-                self._bls_verifier.verify_pop(data["blskey_pop"], data["blskey"]),
+                self._bls_verifier.verify_key_proof_of_possession(
+                    data["blskey_pop"], data["blskey"]),
                 request, "BLS proof-of-possession check failed")
 
     def _read(self, dest: str) -> Optional[dict]:
@@ -92,6 +93,25 @@ class NodeHandler(WriteRequestHandler):
                 raise UnauthorizedClientRequest(
                     request.identifier, request.req_id,
                     "only the owning steward (or trustee demotion) may edit")
+
+    def bls_key_at_root(self, alias: str,
+                        pool_root: bytes) -> Optional[str]:
+        """BLS verkey a node had when the pool state was at `pool_root`
+        (historic MPT read) — the key that actually signed multi-sigs of
+        that epoch. Key ROTATION means the current register's key cannot
+        verify sigs embedded from just before the rotation batch
+        (ref BlsKeyRegisterPoolManager.get_key_by_name(pool_state_root))."""
+        for dest, rec in self.all_nodes().items():
+            if rec.get("alias") == alias:
+                try:
+                    raw = self.state.get_for_root(node_state_key(dest),
+                                                  pool_root)
+                except Exception:
+                    return None
+                if raw is None:
+                    return None
+                return unpack(raw).get("blskey")
+        return None
 
     def _steward_has_node(self, steward: str) -> bool:
         for _, rec in self.all_nodes().items():

@@ -213,12 +213,9 @@ class WriteRequestManager:
         batches after a view change; audit roots must be reproducible)."""
         audit = self.db.get_ledger(AUDIT_LEDGER_ID)
         if audit is not None:
-            staged = list(audit.uncommitted_txns)
-            newest_first = list(reversed(staged))
-            lo = max(1, audit.size - 400)          # bounded scan (LOG_SIZE)
-            for seq in range(audit.size, lo - 1, -1):
-                newest_first.append(audit.get_by_seq_no(seq))
-            for txn in newest_first:
+            from plenum_tpu.execution.handlers.audit import \
+                iter_audit_newest_first
+            for txn in iter_audit_newest_first(audit, limit=600):
                 data = txn_lib.txn_data(txn)
                 v = data.get("viewNo", 0)
                 if v > view_no:

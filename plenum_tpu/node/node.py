@@ -100,12 +100,16 @@ class Node:
         self.validators = self.pool_manager.node_names or [name]
         self.quorums = self.pool_manager.quorums
 
-        # suspicions → blacklist; enforced at bus ingress so no service ever
-        # sees traffic from a blacklisted peer (ref server/blacklister.py)
+        # suspicions → blacklist, and sender-is-a-validator, both enforced
+        # at bus ingress so no service ever sees traffic from a blacklisted
+        # or non-member peer — otherwise a demoted/unknown sender's votes
+        # would still count toward 3PC/checkpoint/propagate quorums
+        # (ref server/blacklister.py + validateNodeMsg sender checks)
         self.blacklister = Blacklister(
             ttl=self.config.BLACKLIST_TTL, now=timer.get_current_time)
         self.node_bus.set_incoming_filter(
-            lambda frm: not self.blacklister.is_blacklisted(frm))
+            lambda frm: frm in self.validators
+            and not self.blacklister.is_blacklisted(frm))
 
         self.propagator = Propagator(
             name, self.quorums,
@@ -264,12 +268,42 @@ class Node:
     # --- wiring -----------------------------------------------------------
 
     def _make_replica(self, inst_id: int) -> Replica:
-        bls = BlsBftReplica(
-            node_name=self.name, bls_signer=self.c.bls_signer,
-            bls_verifier=BlsCryptoVerifier(),
-            key_register=self.c.bls_register,
-            bls_store=self.c.bls_store if inst_id == 0 else None)
+        from plenum_tpu.execution.handlers import audit as audit_lib
         audit = self.c.db.get_ledger(AUDIT_LEDGER_ID)
+        reg_memo: dict[str, Optional[list]] = {}
+
+        def node_reg_at(pool_root: str) -> Optional[list]:
+            got = reg_memo.get(pool_root)
+            if got is None:
+                # misses are NOT memoized: a root absent now can appear
+                # later (staged audit txns revert and re-apply around view
+                # changes), and a stale None would mis-judge the sig
+                got = audit_lib.node_reg_at_pool_root(audit, pool_root)
+                if got is not None:
+                    if len(reg_memo) > 64:
+                        reg_memo.clear()
+                    reg_memo[pool_root] = got
+            return got
+
+        def key_at(name: str, pool_root_hex: str):
+            try:
+                return self.c.node_handler.bls_key_at_root(
+                    name, bytes.fromhex(pool_root_hex))
+            except (ValueError, KeyError):
+                return None
+
+        # BLS multi-signatures are a MASTER-instance concern: only master
+        # batches carry state roots worth certifying. Backups signing over
+        # empty roots would be wasted pairings AND their root-less sigs
+        # cannot cite a pool-state epoch for rotation-aware validation.
+        bls = None
+        if inst_id == 0:
+            bls = BlsBftReplica(
+                node_name=self.name, bls_signer=self.c.bls_signer,
+                bls_verifier=BlsCryptoVerifier(),
+                key_register=self.c.bls_register,
+                bls_store=self.c.bls_store,
+                node_reg_at=node_reg_at, key_at=key_at)
         replica = Replica(
             node_name=self.name, inst_id=inst_id,
             validators=self.validators, timer=self.timer,
@@ -280,11 +314,12 @@ class Node:
             checkpoint_digest_provider=(
                 lambda seq: audit.uncommitted_root_hash.hex()),
             instance_count=max(1, self.pool_manager.quorums.f + 1))
-        bls.report_bad_signature = lambda sender, r=replica: \
-            r.internal_bus.send(RaisedSuspicion(
-                inst_id=inst_id, code=Suspicions.CM_BLS_WRONG.code,
-                reason="bad COMMIT BLS signature (order-time bisection)",
-                sender=sender))
+        if bls is not None:
+            bls.report_bad_signature = lambda sender, r=replica: \
+                r.internal_bus.send(RaisedSuspicion(
+                    inst_id=inst_id, code=Suspicions.CM_BLS_WRONG.code,
+                    reason="bad COMMIT BLS signature (order-time bisection)",
+                    sender=sender))
         replica.internal_bus.subscribe(Ordered, self._on_ordered)
         replica.internal_bus.subscribe(RaisedSuspicion, self._on_suspicion)
         # lambdas: message_req is constructed after the replicas
@@ -400,12 +435,67 @@ class Node:
         self.propagator.set_quorums(self.quorums)
         for replica in self.replicas:
             replica.set_validators(self.validators)
+        self._adjust_replicas()
         for n in self.pool_manager.node_names:
             self.c.bls_register.set_key(n, self.pool_manager.bls_key_of(n))
         # transport reacts too (TCP runner syncs its NodeRegistry + dials
         # new members here; ref kit_zstack connectToMissing)
         for cb in self.on_pool_changed_callbacks:
             cb()
+
+    def _adjust_replicas(self) -> None:
+        """Follow f across membership changes: RBFT runs f+1 protocol
+        instances, so growing the pool past a 3f+1 boundary adds a backup
+        instance and shrinking removes one (ref adjustReplicas
+        node.py:1260). Existing primary ranks are kept mid-view; NEW ranks
+        extend deterministically — round-robin on the CURRENT view over
+        the committed validator list — so every honest node derives the
+        same assignment from the same pool txn. The full set is reselected
+        at the next view change (set_instance_count)."""
+        n_inst = max(1, self.quorums.f + 1)
+        master = self.replicas.master
+        if master.view_changer is not None:
+            master.view_changer.set_instance_count(n_inst)
+        old = len(self.replicas)
+        if n_inst == old:
+            return
+        if n_inst < old:
+            self.replicas.shrink_to(n_inst)
+            return
+        # Deterministic extension: base the assignment on the COMMITTED
+        # audit trail (view + primaries of the batch that changed
+        # membership), never on master.data — a node mid-view-change has
+        # proposal-scoped primaries that would diverge across the pool.
+        # New ranks take the next round-robin validators not already
+        # holding a rank (one faulty node must not control 2 instances).
+        from plenum_tpu.execution.handlers import audit as audit_lib
+        audit = self.c.db.get_ledger(AUDIT_LEDGER_ID)
+        view, _, primaries = audit_lib.last_audited_view(audit)
+        primaries = list(primaries) or list(master.data.primaries)
+        used = set(primaries)
+        for rank in range(len(primaries), n_inst):
+            n = len(self.validators)
+            for j in range(n):
+                cand = self.validators[(view + rank + j) % n]
+                if cand not in used:
+                    break
+            primaries.append(cand)
+            used.add(cand)
+        self.replicas.grow_to(n_inst)
+        # EVERY instance (master included) takes the extended canonical
+        # list: the audit provider snapshots master.data.primaries, so a
+        # short master list would be recorded durably and a restarted node
+        # would restore one entry short (instance with no primary). The
+        # list is derived purely from committed audit state, so a node
+        # mid-view-change assigns the same value as everyone else — and
+        # the view change's own completion re-selects it anyway.
+        for rank, replica in enumerate(self.replicas):
+            replica.data.primaries = list(primaries)
+            if rank >= old:
+                replica.set_validators(self.validators)
+                # fresh backups join the audited view with a clean 3PC log
+                replica.data.view_no = view
+        self.spylog.append(("replicas_adjusted", (old, n_inst)))
 
     # --- ingress ----------------------------------------------------------
 

@@ -23,7 +23,11 @@ class PruningState:
     def __init__(self, db: Optional[KeyValueStorage] = None):
         self._db = db if db is not None else KvMemory()
         root = self._db.try_get(b"__committed_head__") or BLANK_ROOT
-        self._trie = Trie(self._db, root)
+        # one decoded-node cache shared by the head trie AND every
+        # throwaway Trie built for committed/historic reads below —
+        # content-addressed nodes make sharing across roots safe
+        self._node_cache: dict = {}
+        self._trie = Trie(self._db, root, cache=self._node_cache)
         self._committed_root = root
 
     # --- writes (uncommitted head) ----------------------------------------
@@ -38,15 +42,17 @@ class PruningState:
 
     def get(self, key: bytes, committed: bool = True) -> Optional[bytes]:
         if committed:
-            return Trie(self._db, self._committed_root).get(key)
+            return Trie(self._db, self._committed_root,
+                        cache=self._node_cache).get(key)
         return self._trie.get(key)
 
     def get_for_root(self, key: bytes, root_hash: bytes) -> Optional[bytes]:
         """Historic read at any stored root (ts-store reads)."""
-        return Trie(self._db, root_hash).get(key)
+        return Trie(self._db, root_hash, cache=self._node_cache).get(key)
 
     def as_dict(self, committed: bool = False) -> dict:
-        trie = Trie(self._db, self._committed_root) if committed else self._trie
+        trie = Trie(self._db, self._committed_root,
+                    cache=self._node_cache) if committed else self._trie
         return trie.to_dict()
 
     # --- heads ------------------------------------------------------------
@@ -82,7 +88,7 @@ class PruningState:
     def generate_state_proof(self, key: bytes, root_hash: Optional[bytes] = None,
                              serialize: bool = False):
         trie = Trie(self._db, root_hash if root_hash is not None
-                    else self._committed_root)
+                    else self._committed_root, cache=self._node_cache)
         proof = trie.produce_proof(key)
         if serialize:
             from . import rlp

@@ -72,9 +72,14 @@ class Trie:
     _DECODE_CACHE_MAX = 1 << 16
 
     def __init__(self, db: Optional[KeyValueStorage] = None,
-                 root_hash: bytes = BLANK_ROOT):
+                 root_hash: bytes = BLANK_ROOT,
+                 cache: Optional[dict] = None):
         self.db = db if db is not None else KvMemory()
-        self._decoded: dict[bytes, object] = {}
+        # content-addressed, so safe to SHARE across Trie instances over
+        # the same db (PruningState passes one cache into the throwaway
+        # Tries it builds per committed/historic read)
+        self._decoded: dict[bytes, object] = cache if cache is not None \
+            else {}
         self.root_node = self._decode_ref_root(root_hash)
 
     # --- refs -------------------------------------------------------------
@@ -117,10 +122,15 @@ class Trie:
     def _decode_ref_root(self, root_hash: bytes):
         if root_hash == BLANK_ROOT:
             return BLANK_NODE
+        node = self._decoded.get(root_hash)
+        if node is not None:
+            return node
         enc = self.db.try_get(root_hash)
         if enc is None:
             raise KeyError(f"unknown state root {root_hash.hex()}")
-        return rlp.decode(enc)
+        node = rlp.decode(enc)
+        self._cache_put(root_hash, node)
+        return node
 
     @property
     def root_hash(self) -> bytes:

@@ -24,18 +24,25 @@ from plenum_tpu.state.pruning_state import PruningState
 NODES = ["Alpha", "Beta", "Gamma", "Delta"]
 
 
-def make_genesis(names):
-    """Pool NODE txns (with real BLS verkeys) + a trustee NYM."""
+def make_genesis(names, validator_names=None):
+    """Pool NODE txns (with real BLS verkeys) + a trustee NYM.
+    validator_names: subset with services=[VALIDATOR]; the rest start as
+    known-but-demoted nodes (services=[]) awaiting promotion."""
     trustee = Ed25519Signer(seed=b"trustee-seed".ljust(32, b"\0"))
     pool_txns = []
     for i, name in enumerate(names):
         bls_pk = BlsCryptoSigner(seed=name.encode().ljust(32, b"\0")[:32]).pk
+        services = ["VALIDATOR"] if (validator_names is None
+                                     or name in validator_names) else []
         txn = txn_lib.new_txn(NODE, {
             "dest": f"{name}Dest",
-            "data": {"alias": name, "services": ["VALIDATOR"],
+            "data": {"alias": name, "services": services,
                      "blskey": bls_pk,
                      "node_ip": "127.0.0.1", "node_port": 9700 + 2 * i,
                      "client_ip": "127.0.0.1", "client_port": 9701 + 2 * i}})
+        # genesis nodes are steward-owned by the trustee so owner-only
+        # edits (key rotation) are exercisable in tests
+        txn["txn"].setdefault("metadata", {})["from"] = trustee.identifier
         txn_lib.set_seq_no(txn, i + 1)
         pool_txns.append(txn)
     nym = txn_lib.new_txn(NYM, {"dest": trustee.identifier,
@@ -46,13 +53,14 @@ def make_genesis(names):
 
 
 class Pool:
-    def __init__(self, names=NODES, seed=42, config=None, data_dir=None):
+    def __init__(self, names=NODES, seed=42, config=None, data_dir=None,
+                 validator_names=None):
         self.names = list(names)
         self.timer = MockTimer()
         self.net = SimNetwork(self.timer, SimRandom(seed))
         self.config = config or Config(Max3PCBatchWait=0.05)
         self.data_dir = data_dir          # per-node durable storage root
-        self.genesis, self.trustee = make_genesis(self.names)
+        self.genesis, self.trustee = make_genesis(self.names, validator_names)
         self.client_msgs: dict[str, list] = {n: [] for n in self.names}
         self.nodes: dict[str, Node] = {}
         for name in self.names:
