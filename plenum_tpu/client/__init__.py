@@ -1,4 +1,5 @@
 from .wallet import Wallet
 from .client import PoolClient
+from .pipelined import PipelinedPoolClient
 
-__all__ = ["Wallet", "PoolClient"]
+__all__ = ["Wallet", "PoolClient", "PipelinedPoolClient"]

@@ -1,0 +1,119 @@
+"""Pipelined pool client: a whole window of requests on the wire at once.
+
+PoolClient.submit (client.py) is one-request-at-a-time — send, await an
+f+1 reply quorum, return. Throughput-oriented callers (bulk issuers,
+migration tooling, the tcp_pool benchmark) need many requests in flight;
+this client keeps one connection per node, one reader task per node, and
+counts a request done when f+1 DISTINCT nodes have replied for its
+(identifier, reqId) key.
+
+    client = PipelinedPoolClient(addrs, f=1)
+    done, submit_times = await client.drive(requests, window=100,
+                                            timeout=60.0)
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.serialization import pack, unpack
+
+
+class PipelinedPoolClient:
+    def __init__(self, addrs: dict[str, tuple[str, int]], f: int):
+        self.addrs = dict(addrs)
+        self.f = f
+        self.conns: dict[str, tuple] = {}
+        self.votes: dict[tuple, set] = {}
+        self.done: dict[tuple, float] = {}
+        self.done_evt = asyncio.Event()
+
+    async def connect(self) -> None:
+        """Dial every node; unreachable nodes are skipped (the f+1 reply
+        quorum covers them) but fewer than f+1 reachable is a hard error."""
+        for name, (host, port) in self.addrs.items():
+            try:
+                self.conns[name] = await asyncio.open_connection(host, port)
+            except OSError:
+                continue
+        if len(self.conns) < self.f + 1:
+            await self.close()
+            raise ConnectionError(
+                f"only {len(self.conns)} of {len(self.addrs)} nodes "
+                f"reachable; need at least f+1 = {self.f + 1}")
+
+    async def close(self) -> None:
+        for _, writer in self.conns.values():
+            try:
+                writer.close()
+            except Exception:
+                pass
+        self.conns.clear()
+
+    async def _reader(self, name: str) -> None:
+        reader, _ = self.conns[name]
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                frame = await reader.readexactly(int.from_bytes(hdr, "big"))
+                msg = unpack(frame)
+                if not isinstance(msg, dict) or msg.get("op") != "REPLY":
+                    continue
+                meta = msg.get("result", {}).get("txn", {}).get("metadata", {})
+                key = (meta.get("from"), meta.get("reqId"))
+                seen = self.votes.setdefault(key, set())
+                seen.add(name)
+                if len(seen) >= self.f + 1 and key not in self.done:
+                    self.done[key] = time.perf_counter()
+                    self.done_evt.set()
+        except (asyncio.IncompleteReadError, OSError):
+            return
+
+    async def _send(self, payload: bytes) -> None:
+        """Broadcast to every live connection; a node dying mid-run is
+        dropped, not fatal — the reply quorum covers it (same contract as
+        PoolClient._send_one)."""
+        frame = len(payload).to_bytes(4, "big") + payload
+        for name, (_, writer) in list(self.conns.items()):
+            try:
+                writer.write(frame)
+                await writer.drain()
+            except OSError:
+                self.conns.pop(name, None)
+
+    async def drive(self, requests: list[Request], window: int = 100,
+                    timeout: float = 120.0) -> tuple[dict, dict]:
+        """Submit all requests keeping <= window unresolved in flight.
+        -> ({req_key: t_done}, {req_key: t_sent}); missing keys timed out.
+        Reusable: every call starts from a clean slate."""
+        self.votes.clear()
+        self.done.clear()
+        self.done_evt = asyncio.Event()
+        readers: list[asyncio.Task] = []
+        submit_times: dict[tuple, float] = {}
+        deadline = time.perf_counter() + timeout
+        try:
+            await self.connect()
+            readers = [asyncio.create_task(self._reader(n))
+                       for n in self.conns]
+            i = 0
+            while len(self.done) < len(requests):
+                if time.perf_counter() > deadline:
+                    break
+                while i < len(requests) and i - len(self.done) < window:
+                    req = requests[i]
+                    submit_times[(req.identifier, req.req_id)] = \
+                        time.perf_counter()
+                    await self._send(pack(req.to_dict()))
+                    i += 1
+                self.done_evt.clear()
+                try:
+                    await asyncio.wait_for(self.done_evt.wait(), 0.25)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            for t in readers:
+                t.cancel()
+            await self.close()
+        return dict(self.done), submit_times

@@ -150,7 +150,7 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     while done < n_txns and time.perf_counter() < deadline:
         # feed in chunks so the propagate pipeline stays busy but inboxes
         # don't balloon
-        while next_submit < n_txns and next_submit - done < 100:
+        while next_submit < n_txns and next_submit - done < 256:
             req = requests[next_submit]
             submit_times[req.digest] = time.perf_counter()
             for n in names:

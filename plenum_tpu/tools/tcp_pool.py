@@ -93,86 +93,11 @@ def _wait_all_started(procs, deadline_s: float) -> None:
                 os.set_blocking(p.stdout.fileno(), True)
 
 
-class LoadClient:
-    """Pipelined streaming client: one connection per node, one reader task
-    per node; a request completes when f+1 DISTINCT nodes REPLY for its
-    (identifier, reqId). Unlike PoolClient.submit (one in-flight request),
-    this keeps a whole window of requests on the wire — the client side of
-    a throughput benchmark must never be the bottleneck."""
-
-    def __init__(self, addrs: dict[str, tuple[str, int]], f: int):
-        self.addrs = addrs
-        self.f = f
-        self.conns: dict[str, tuple] = {}
-        self.votes: dict[tuple, set] = {}
-        self.done: dict[tuple, float] = {}
-        self.done_evt = asyncio.Event()
-
-    async def connect(self):
-        for name, (host, port) in self.addrs.items():
-            self.conns[name] = await asyncio.open_connection(host, port)
-
-    async def close(self):
-        for _, writer in self.conns.values():
-            writer.close()
-
-    async def reader(self, name: str):
-        from plenum_tpu.common.serialization import unpack
-        reader, _ = self.conns[name]
-        try:
-            while True:
-                hdr = await reader.readexactly(4)
-                frame = await reader.readexactly(int.from_bytes(hdr, "big"))
-                msg = unpack(frame)
-                if not isinstance(msg, dict) or msg.get("op") != "REPLY":
-                    continue
-                meta = msg.get("result", {}).get("txn", {}).get("metadata", {})
-                key = (meta.get("from"), meta.get("reqId"))
-                seen = self.votes.setdefault(key, set())
-                seen.add(name)
-                if len(seen) >= self.f + 1 and key not in self.done:
-                    self.done[key] = time.perf_counter()
-                    self.done_evt.set()
-        except (asyncio.IncompleteReadError, OSError):
-            return
-
-    async def send(self, payload: bytes):
-        for _, writer in self.conns.values():
-            writer.write(len(payload).to_bytes(4, "big") + payload)
-        for _, writer in self.conns.values():
-            await writer.drain()
-
-
 async def drive_load(addrs, f, requests, window: int, timeout: float):
     """-> (done {key: t_done}, submit_times {key: t_sent})."""
-    from plenum_tpu.common.serialization import pack
-
-    client = LoadClient(addrs, f)
-    await client.connect()
-    readers = [asyncio.create_task(client.reader(n)) for n in addrs]
-    submit_times: dict[tuple, float] = {}
-    deadline = time.perf_counter() + timeout
-    try:
-        i = 0
-        while len(client.done) < len(requests):
-            if time.perf_counter() > deadline:
-                break
-            while i < len(requests) and i - len(client.done) < window:
-                req = requests[i]
-                key = (req.identifier, req.req_id)
-                submit_times[key] = time.perf_counter()
-                await client.send(pack(req.to_dict()))
-                i += 1
-            client.done_evt.clear()
-            try:
-                await asyncio.wait_for(client.done_evt.wait(), 0.25)
-            except asyncio.TimeoutError:
-                pass
-    finally:
-        for t in readers:
-            t.cancel()
-        await client.close()
-    return dict(client.done), submit_times
+    from plenum_tpu.client.pipelined import PipelinedPoolClient
+    client = PipelinedPoolClient(addrs, f)
+    return await client.drive(requests, window=window, timeout=timeout)
 
 
 def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",

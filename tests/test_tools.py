@@ -164,3 +164,47 @@ def test_tcp_pool_bench_orders_load():
     assert stats["txns_ordered"] == 30, stats
     assert stats["tps"] > 0
     assert stats["p50_latency_ms"] is not None
+
+
+def test_pipelined_client_survives_dead_node_and_reuse():
+    """The pipelined client must tolerate an unreachable node (quorum
+    covers it) and be reusable across drive() calls with a clean slate."""
+    from plenum_tpu.client import PipelinedPoolClient
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.common.serialization import pack, unpack
+
+    async def main():
+        async def serve(reader, writer):
+            try:
+                while True:
+                    hdr = await reader.readexactly(4)
+                    frame = await reader.readexactly(
+                        int.from_bytes(hdr, "big"))
+                    req = unpack(frame)
+                    reply = pack({"op": "REPLY", "result": {"txn": {
+                        "metadata": {"from": req["identifier"],
+                                     "reqId": req["reqId"]}}}})
+                    writer.write(len(reply).to_bytes(4, "big") + reply)
+                    await writer.drain()
+            except (asyncio.IncompleteReadError, OSError):
+                return
+
+        servers = [await asyncio.start_server(serve, "127.0.0.1", 0)
+                   for _ in range(3)]
+        addrs = {f"N{i}": ("127.0.0.1", s.sockets[0].getsockname()[1])
+                 for i, s in enumerate(servers)}
+        addrs["Ndead"] = ("127.0.0.1", 1)      # nothing listens there
+
+        client = PipelinedPoolClient(addrs, f=1)
+        reqs = [Request("idr", i, {"type": "1"}) for i in range(5)]
+        done, _ = await client.drive(reqs, window=3, timeout=10.0)
+        assert len(done) == 5
+
+        # reuse: a smaller second batch must NOT be satisfied by stale state
+        reqs2 = [Request("idr", 100 + i, {"type": "1"}) for i in range(2)]
+        done2, _ = await client.drive(reqs2, window=2, timeout=10.0)
+        assert set(done2) == {("idr", 100), ("idr", 101)}
+        for s in servers:
+            s.close()
+
+    asyncio.run(main())
