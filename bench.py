@@ -57,10 +57,21 @@ def _run_jax_pool_subprocess():
     return {"error": (out.stderr or "no output").strip()[-300:]}
 
 
+def _run_tcp_pool():
+    """Real-transport color for the bench line (guarded: a broken spawn
+    environment must degrade to the in-process numbers, never fail)."""
+    try:
+        from plenum_tpu.tools.tcp_pool import run_tcp_pool
+        return run_tcp_pool(n_nodes=4, n_txns=200, timeout=90.0)
+    except Exception:
+        return None
+
+
 def main():
     from plenum_tpu.tools.local_pool import run_load
 
     cpu = run_load(n_nodes=4, n_txns=300, backend="cpu")
+    tcp = _run_tcp_pool()
     jax_stats = _run_jax_pool_subprocess()
 
     cpu_tps = cpu["tps"] or 1e-9
@@ -74,6 +85,8 @@ def main():
         "cpu_tps": cpu["tps"],
         "cpu_p50_ms": cpu["p50_latency_ms"],
     }
+    if tcp and tcp.get("txns_ordered"):
+        result["tcp_tps"] = tcp["tps"]          # 4 OS processes, real TCP
     if jax_ok:
         result.update({
             "jax_p50_ms": jax_stats["p50_latency_ms"],

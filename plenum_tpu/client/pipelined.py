@@ -29,13 +29,20 @@ class PipelinedPoolClient:
         self.done: dict[tuple, float] = {}
         self.done_evt = asyncio.Event()
 
+    CONNECT_TIMEOUT = 5.0       # per node; a SYN-dropping host must not
+    DRAIN_TIMEOUT = 10.0        # stall the whole drive (kernel retries
+                                # run ~130s), nor a connected-but-not-
+                                # reading node wedge a drain forever
+
     async def connect(self) -> None:
         """Dial every node; unreachable nodes are skipped (the f+1 reply
         quorum covers them) but fewer than f+1 reachable is a hard error."""
         for name, (host, port) in self.addrs.items():
             try:
-                self.conns[name] = await asyncio.open_connection(host, port)
-            except OSError:
+                self.conns[name] = await asyncio.wait_for(
+                    asyncio.open_connection(host, port),
+                    self.CONNECT_TIMEOUT)
+            except (OSError, asyncio.TimeoutError):
                 continue
         if len(self.conns) < self.f + 1:
             await self.close()
@@ -68,18 +75,29 @@ class PipelinedPoolClient:
                     self.done[key] = time.perf_counter()
                     self.done_evt.set()
         except (asyncio.IncompleteReadError, OSError):
-            return
+            self.conns.pop(name, None)
+        except Exception:
+            # a corrupt frame means the stream is desynced: drop the
+            # connection rather than dying silently with the node still
+            # counted as live
+            self.conns.pop(name, None)
 
     async def _send(self, payload: bytes) -> None:
-        """Broadcast to every live connection; a node dying mid-run is
-        dropped, not fatal — the reply quorum covers it (same contract as
-        PoolClient._send_one)."""
+        """Broadcast: write to ALL live connections first, then drain all
+        (overlapping the TCP flushes); a node dying mid-run is dropped,
+        not fatal — the reply quorum covers it (same contract as
+        PoolClient._send_one). Drains are bounded so a connected-but-
+        stuck peer cannot wedge the pipeline."""
         frame = len(payload).to_bytes(4, "big") + payload
         for name, (_, writer) in list(self.conns.items()):
             try:
                 writer.write(frame)
-                await writer.drain()
             except OSError:
+                self.conns.pop(name, None)
+        for name, (_, writer) in list(self.conns.items()):
+            try:
+                await asyncio.wait_for(writer.drain(), self.DRAIN_TIMEOUT)
+            except (OSError, asyncio.TimeoutError):
                 self.conns.pop(name, None)
 
     async def drive(self, requests: list[Request], window: int = 100,
@@ -101,6 +119,8 @@ class PipelinedPoolClient:
             while len(self.done) < len(requests):
                 if time.perf_counter() > deadline:
                     break
+                if len(self.conns) < self.f + 1:
+                    break   # quorum provably unreachable: stop early
                 while i < len(requests) and i - len(self.done) < window:
                     req = requests[i]
                     submit_times[(req.identifier, req.req_id)] = \

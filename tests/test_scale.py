@@ -185,3 +185,28 @@ def test_bls_key_rotation_keeps_pool_live():
     # Gamma's NEW key participates in fresh aggregates
     ms = pool.nodes["Alpha"].replicas.master.bls._recent_multi_sigs
     assert any("Gamma" in m.participants for m in ms.values())
+
+
+def test_replicas_shrink_when_pool_demotes_below_f_boundary():
+    """Demoting 3 of 7 validators moves f back from 2 to 1: instances
+    shrink to 2 on every remaining node and the pool keeps ordering at
+    the narrower quorum."""
+    seven = ["Alpha", "Beta", "Gamma", "Delta", "Eps", "Zeta", "Eta"]
+    pool = Pool(names=seven, config=Config(
+        Max3PCBatchWait=0.05, STATE_FRESHNESS_UPDATE_INTERVAL=600.0))
+    assert len(pool.nodes["Alpha"].replicas) == 3
+    for i, alias in enumerate(seven[4:]):
+        pool.submit(signed_node_services(pool.trustee, alias, [], 60 + i))
+        pool.run(4.0)
+    for name in seven[:4]:
+        node = pool.nodes[name]
+        assert len(node.validators) == 4, name
+        assert node.f == 1
+        assert len(node.replicas) == 2, name
+        assert node.replicas.master.view_changer._instance_count == 2
+    user = Ed25519Signer(seed=b"shrunk-pool-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 99))
+    pool.run(6.0)
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in seven[:4]}
+    assert sizes == {2}, sizes
