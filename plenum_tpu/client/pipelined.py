@@ -37,26 +37,37 @@ class PipelinedPoolClient:
     async def connect(self) -> None:
         """Dial every node; unreachable nodes are skipped (the f+1 reply
         quorum covers them) but fewer than f+1 reachable is a hard error."""
-        for name, (host, port) in self.addrs.items():
+        async def dial(name, host, port):
             try:
                 self.conns[name] = await asyncio.wait_for(
                     asyncio.open_connection(host, port),
                     self.CONNECT_TIMEOUT)
             except (OSError, asyncio.TimeoutError):
-                continue
+                pass
+
+        # parallel dialing: the connect phase is bounded by ONE timeout,
+        # not timeout x n_unreachable
+        await asyncio.gather(*(dial(n, h, p)
+                               for n, (h, p) in self.addrs.items()))
         if len(self.conns) < self.f + 1:
             await self.close()
             raise ConnectionError(
                 f"only {len(self.conns)} of {len(self.addrs)} nodes "
                 f"reachable; need at least f+1 = {self.f + 1}")
 
-    async def close(self) -> None:
-        for _, writer in self.conns.values():
+    def _drop(self, name: str) -> None:
+        """Remove AND close a connection — dropped sockets must not leak
+        FDs for the process lifetime (bulk issuers reuse this client)."""
+        conn = self.conns.pop(name, None)
+        if conn is not None:
             try:
-                writer.close()
+                conn[1].close()
             except Exception:
                 pass
-        self.conns.clear()
+
+    async def close(self) -> None:
+        for name in list(self.conns):
+            self._drop(name)
 
     async def _reader(self, name: str) -> None:
         reader, _ = self.conns[name]
@@ -64,7 +75,14 @@ class PipelinedPoolClient:
             while True:
                 hdr = await reader.readexactly(4)
                 frame = await reader.readexactly(int.from_bytes(hdr, "big"))
-                msg = unpack(frame)
+                try:
+                    msg = unpack(frame)
+                except Exception:
+                    # corrupt frame = desynced stream: drop the connection
+                    # (narrow scope: a bug in the vote accounting below
+                    # must surface as a task exception, not a silent drop)
+                    self._drop(name)
+                    return
                 if not isinstance(msg, dict) or msg.get("op") != "REPLY":
                     continue
                 meta = msg.get("result", {}).get("txn", {}).get("metadata", {})
@@ -75,12 +93,7 @@ class PipelinedPoolClient:
                     self.done[key] = time.perf_counter()
                     self.done_evt.set()
         except (asyncio.IncompleteReadError, OSError):
-            self.conns.pop(name, None)
-        except Exception:
-            # a corrupt frame means the stream is desynced: drop the
-            # connection rather than dying silently with the node still
-            # counted as live
-            self.conns.pop(name, None)
+            self._drop(name)
 
     async def _send(self, payload: bytes) -> None:
         """Broadcast: write to ALL live connections first, then drain all
@@ -93,12 +106,12 @@ class PipelinedPoolClient:
             try:
                 writer.write(frame)
             except OSError:
-                self.conns.pop(name, None)
+                self._drop(name)
         for name, (_, writer) in list(self.conns.items()):
             try:
                 await asyncio.wait_for(writer.drain(), self.DRAIN_TIMEOUT)
             except (OSError, asyncio.TimeoutError):
-                self.conns.pop(name, None)
+                self._drop(name)
 
     async def drive(self, requests: list[Request], window: int = 100,
                     timeout: float = 120.0) -> tuple[dict, dict]:
@@ -119,8 +132,11 @@ class PipelinedPoolClient:
             while len(self.done) < len(requests):
                 if time.perf_counter() > deadline:
                     break
-                if len(self.conns) < self.f + 1:
-                    break   # quorum provably unreachable: stop early
+                if not self.conns:
+                    break   # every connection is gone: nothing can arrive
+                    # (NOT "< f+1": votes already collected from since-
+                    # dropped nodes can still combine with in-flight
+                    # replies from the survivors)
                 while i < len(requests) and i - len(self.done) < window:
                     req = requests[i]
                     submit_times[(req.identifier, req.req_id)] = \

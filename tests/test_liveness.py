@@ -209,3 +209,44 @@ def test_own_node_never_blacklisted():
         inst_id=0, code=Suspicions.PPR_FRM_NON_PRIMARY.code,
         reason="", sender="Beta"))
     assert not beta.blacklister.is_blacklisted("Beta")
+
+
+def test_equivocating_primary_detected():
+    """A primary sending TWO different PRE-PREPAREs for the same (view,
+    seq) is detected (DUPLICATE_PPR_SENT): honest nodes keep the first,
+    suspect the primary, and the pool's ledgers never diverge."""
+    from plenum_tpu.common.node_messages import PrePrepare
+
+    pool = fast_pool(seed=23)
+    user = Ed25519Signer(seed=b"equivocate".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, req_id=1))
+    # advance just past the batch cut so the PP is IN FLIGHT, not ordered
+    # (a duplicate of an already-ordered seq is discarded as stale, which
+    # is correct but not what this test is about)
+    for _ in range(4):
+        pool.run(0.06)
+        if pool.nodes["Beta"].master_replica.ordering.prePrepares:
+            break
+
+    beta = pool.nodes["Beta"]
+    honest = beta.master_replica.ordering.prePrepares
+    assert honest, "no pre-prepare in flight yet"
+    (view_no, pp_seq_no), pp = next(iter(honest.items()))
+    twin = PrePrepare(**{**{f: getattr(pp, f) for f in pp.__dataclass_fields__},
+                         "digest": "ff" * 16})
+    for victim in ("Beta", "Gamma"):
+        pool.nodes[victim].master_replica.ordering.process_preprepare(
+            twin, "Alpha")
+    pool.run(5.0)
+
+    suspicions = [e for n in ("Beta", "Gamma")
+                  for e in pool.nodes[n].spylog
+                  if e[0] == "suspicion"
+                  and e[1][0] == Suspicions.DUPLICATE_PPR_SENT.code]
+    assert suspicions, "equivocation not suspected"
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.names}
+    assert sizes == {2}
+    roots = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+             for n in pool.names}
+    assert len(roots) == 1, "pool diverged under equivocation"
