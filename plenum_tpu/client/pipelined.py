@@ -4,8 +4,10 @@ PoolClient.submit (client.py) is one-request-at-a-time — send, await an
 f+1 reply quorum, return. Throughput-oriented callers (bulk issuers,
 migration tooling, the tcp_pool benchmark) need many requests in flight;
 this client keeps one connection per node, one reader task per node, and
-counts a request done when f+1 DISTINCT nodes have replied for its
-(identifier, reqId) key.
+counts a request done when f+1 DISTINCT nodes have sent CONTENT-IDENTICAL
+replies for its (identifier, reqId) key — same matching-reply quorum as
+PoolClient; f non-matching (Byzantine) replies can never complete a
+request on their own.
 
     client = PipelinedPoolClient(addrs, f=1)
     done, submit_times = await client.drive(requests, window=100,
@@ -14,10 +16,11 @@ counts a request done when f+1 DISTINCT nodes have replied for its
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import time
 
 from plenum_tpu.common.request import Request
-from plenum_tpu.common.serialization import pack, unpack
+from plenum_tpu.common.serialization import pack, signing_serialize, unpack
 
 
 class PipelinedPoolClient:
@@ -85,9 +88,19 @@ class PipelinedPoolClient:
                     return
                 if not isinstance(msg, dict) or msg.get("op") != "REPLY":
                     continue
-                meta = msg.get("result", {}).get("txn", {}).get("metadata", {})
+                result = msg.get("result", {})
+                meta = result.get("txn", {}).get("metadata", {})
                 key = (meta.get("from"), meta.get("reqId"))
-                seen = self.votes.setdefault(key, set())
+                # quorum on f+1 EQUAL replies: the vote bucket is keyed by
+                # the canonical digest of the whole result, so a Byzantine
+                # node's fabricated REPLY lands in its own bucket and can
+                # never combine with honest votes
+                try:
+                    content = hashlib.sha256(
+                        signing_serialize(result)).hexdigest()
+                except (TypeError, ValueError):
+                    continue    # unserializable result: not a valid reply
+                seen = self.votes.setdefault((key, content), set())
                 seen.add(name)
                 if len(seen) >= self.f + 1 and key not in self.done:
                     self.done[key] = time.perf_counter()

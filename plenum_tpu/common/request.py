@@ -13,6 +13,32 @@ from typing import Any, Optional
 from .serialization import signing_serialize
 
 
+class _FrozenDict(dict):
+    """A dict that refuses in-place mutation. Still a real `dict`, so
+    msgpack/canonical-JSON serialize it unchanged. Guards the digest
+    cache below: a mutated operation must raise loudly, never yield a
+    stale digest."""
+
+    def _immutable(self, *a, **k):
+        raise TypeError("Request payload fields are immutable once "
+                        "constructed; build a new Request instead of "
+                        "mutating in place")
+
+    __setitem__ = __delitem__ = __ior__ = _immutable
+    update = pop = popitem = clear = setdefault = _immutable
+
+
+def _freeze(v):
+    """Deep-freeze a payload value: dicts -> _FrozenDict, lists -> tuples
+    (both serialize identically — msgpack packs tuples as arrays, the
+    canonical JSON serializer treats list and tuple alike)."""
+    if isinstance(v, dict):
+        return _FrozenDict({k: _freeze(x) for k, x in v.items()})
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
 class Request:
     def __init__(self,
                  identifier: str,
@@ -25,19 +51,33 @@ class Request:
                  endorser: Optional[str] = None):
         self.identifier = identifier
         self.req_id = req_id
-        self.operation = operation
+        self._operation = _freeze(operation)
         self.signature = signature
         self.signatures = signatures
         self.protocol_version = protocol_version
-        self.taa_acceptance = taa_acceptance
+        self._taa_acceptance = _freeze(taa_acceptance) \
+            if taa_acceptance is not None else None
         self.endorser = endorser
-        # digest cache, invalidated when the signature fields change (the
-        # one post-construction mutation the test/tool pattern performs).
-        # The digest is re-derived ~100x per request across the node
-        # pipeline (propagator keys, stash keys, seq-no map, 3PC batches) —
-        # recomputing the canonical-JSON sha256 each time dominated the
-        # profile. Mutating `operation` in place is NOT tracked.
+        # digest cache, invalidated when the signature/identity fields
+        # change (the one post-construction mutation the test/tool pattern
+        # performs). The digest is re-derived ~100x per request across the
+        # node pipeline (propagator keys, stash keys, seq-no map, 3PC
+        # batches) — recomputing the canonical-JSON sha256 each time
+        # dominated the profile. `operation` is frozen at construction, so
+        # every mutable input to the digest is either in the cache key or
+        # immutable.
         self._digest_cache: Optional[tuple] = None
+
+    # operation/taa_acceptance are deep-frozen AND unreassignable (no
+    # setter): every digest input is either in the cache key below or
+    # immutable, so the cache can never serve a stale digest
+    @property
+    def operation(self) -> dict:
+        return self._operation
+
+    @property
+    def taa_acceptance(self) -> Optional[dict]:
+        return self._taa_acceptance
 
     # --- serialization ---------------------------------------------------
 
@@ -99,7 +139,8 @@ class Request:
         # signatures dict, so {} and None must produce different keys
         sigs = tuple(sorted(self.signatures.items())) \
             if self.signatures is not None else None
-        key = (self.signature, sigs)
+        key = (self.identifier, self.req_id, self.signature, sigs,
+               self.protocol_version, self.endorser)
         c = self._digest_cache
         if c is None or c[0] != key:
             payload = self.signing_bytes()

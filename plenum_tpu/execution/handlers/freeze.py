@@ -29,7 +29,7 @@ class LedgersFreezeHandler(_ConfigWriteHandler):
     def static_validation(self, request: Request) -> None:
         op = request.operation
         lids = op.get("ledgers_ids")
-        self._require(isinstance(lids, list) and
+        self._require(isinstance(lids, (list, tuple)) and
                       all(isinstance(i, int) for i in lids), request,
                       "LEDGERS_FREEZE needs a list of ledger ids")
         self._require(not any(i in _PROTECTED for i in lids), request,

@@ -48,7 +48,7 @@ class NodeHandler(WriteRequestHandler):
         data = op.get("data")
         self._require(isinstance(data, dict), request, "NODE needs data")
         if "services" in data:
-            self._require(isinstance(data["services"], list) and
+            self._require(isinstance(data["services"], (list, tuple)) and
                           all(s == VALIDATOR for s in data["services"]),
                           request, "services may only contain VALIDATOR")
         for port_field in ("node_port", "client_port"):

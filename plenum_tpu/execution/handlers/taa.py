@@ -40,6 +40,16 @@ def _digest_key(digest: str) -> bytes:
     return b"taa:d:" + digest.encode()
 
 
+def _historic_config_root(db, ts) -> Optional[bytes]:
+    """Config-state root committed at-or-before `ts`, via the ts-store
+    (ref storage/state_ts_store.py:38 get_equal_or_prev); None when the
+    store is absent or no config batch existed yet at that time."""
+    ts_store = db.ts_store
+    if ts_store is None:
+        return None
+    return ts_store.get_equal_or_prev(ts, CONFIG_LEDGER_ID)
+
+
 def _version_key(version: str) -> bytes:
     return b"taa:v:" + version.encode()
 
@@ -154,6 +164,12 @@ class TxnAuthorAgreementDisableHandler(_ConfigWriteHandler):
 
 
 class GetTxnAuthorAgreementHandler(ReadRequestHandler):
+    """Latest TAA, by digest/version, or AS OF A TIMESTAMP: the ts-store
+    maps the query time to the config-state root committed at-or-before it
+    and the read runs against that historic root (ref
+    request_handlers/get_txn_author_agreement_handler.py:46 +
+    storage/state_ts_store.py:38 get_equal_or_prev)."""
+
     def __init__(self, db):
         super().__init__(db, GET_TXN_AUTHOR_AGREEMENT, CONFIG_LEDGER_ID)
 
@@ -166,6 +182,13 @@ class GetTxnAuthorAgreementHandler(ReadRequestHandler):
             ptr = self.state.get(_version_key(op["version"]), committed=True)
             if ptr is not None:
                 raw = self.state.get(_digest_key(ptr.decode()), committed=True)
+        elif op.get("timestamp") is not None:
+            root = _historic_config_root(self.db, op["timestamp"])
+            if root is not None:
+                ptr = self.state.get_for_root(KEY_LATEST, root)
+                if ptr is not None:
+                    raw = self.state.get_for_root(_digest_key(ptr.decode()),
+                                                  root)
         else:
             ptr = self.state.get(KEY_LATEST, committed=True)
             if ptr is not None:
@@ -183,6 +206,12 @@ class GetTxnAuthorAgreementAmlHandler(ReadRequestHandler):
         if op.get("version"):
             raw = self.state.get(b"aml:v:" + op["version"].encode(),
                                  committed=True)
+        elif op.get("timestamp") is not None:
+            # AML as of time T (ref get_txn_author_agreement_aml_handler:36)
+            raw = None
+            root = _historic_config_root(self.db, op["timestamp"])
+            if root is not None:
+                raw = self.state.get_for_root(KEY_AML_LATEST, root)
         else:
             raw = self.state.get(KEY_AML_LATEST, committed=True)
         return {"type": GET_TXN_AUTHOR_AGREEMENT_AML,

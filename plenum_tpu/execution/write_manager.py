@@ -292,9 +292,11 @@ class WriteRequestManager:
         if audit_ledger is not None and audit_ledger.uncommitted_txns:
             audit_ledger.commit_txns(1)
 
+        # (ledger, ts) -> committed root: powers "state as of time T" reads
+        # (ref storage/state_ts_store.py:24 writes keyed by ledger too)
         ts_store = self.db.get_store(TS_STORE_LABEL)
         if ts_store is not None and state is not None:
-            ts_store.put(str(int(batch.pp_time)).encode(),
+            ts_store.set(undo.ledger_id, batch.pp_time,
                          state.committed_head_hash)
         seq_no_db = self.db.get_store(SEQ_NO_DB_LABEL)
         if seq_no_db is not None:

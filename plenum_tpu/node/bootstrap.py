@@ -35,6 +35,7 @@ from plenum_tpu.execution.handlers import (GetFrozenLedgersHandler,
                                            TxnAuthorAgreementHandler)
 from plenum_tpu.execution.txn import NODE, NYM
 from plenum_tpu.execution import txn as txn_lib
+from plenum_tpu.storage.state_ts_store import StateTsStore
 from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
 from plenum_tpu.ledger.hash_store import HashStore
 from plenum_tpu.ledger.ledger import Ledger
@@ -145,7 +146,8 @@ class NodeBootstrap:
                            PruningState(self._kv("config_state")))
         db.register_ledger(DOMAIN_LEDGER_ID, self._ledger(DOMAIN_LEDGER_ID, "domain"),
                            PruningState(self._kv("domain_state")))
-        db.register_store(TS_STORE_LABEL, self._kv("ts_store"))
+        db.register_store(TS_STORE_LABEL,
+                          StateTsStore(self._kv("ts_store")))
         db.register_store(SEQ_NO_DB_LABEL, self._kv("seq_no_db"))
         db.register_store(NODE_STATUS_DB_LABEL, self._kv("node_status_db"))
         bls_store = BlsStore(self._kv("bls_store"))

@@ -479,6 +479,13 @@ class Node:
                 cand = self.validators[(view + rank + j) % n]
                 if cand not in used:
                     break
+            else:
+                # every validator already holds a rank — impossible while
+                # n_inst = f+1 < n, but a future quorum-math change must
+                # fail loudly, not silently give one node two instances
+                raise RuntimeError(
+                    f"no unranked validator for instance {rank}: "
+                    f"{n_inst} instances over {n} validators")
             primaries.append(cand)
             used.add(cand)
         self.replicas.grow_to(n_inst)

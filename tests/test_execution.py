@@ -16,6 +16,7 @@ from plenum_tpu.execution.database_manager import SEQ_NO_DB_LABEL, TS_STORE_LABE
 from plenum_tpu.execution.exceptions import (InvalidClientRequest,
                                              UnauthorizedClientRequest)
 from plenum_tpu.execution.handlers import (GetNymHandler,
+                                           GetTxnAuthorAgreementAmlHandler,
                                            GetTxnAuthorAgreementHandler,
                                            GetTxnHandler, NodeHandler,
                                            NymHandler,
@@ -29,6 +30,7 @@ from plenum_tpu.execution.txn import (NYM, STEWARD, TRUSTEE,
 from plenum_tpu.ledger.ledger import Ledger
 from plenum_tpu.state.pruning_state import PruningState
 from plenum_tpu.storage.kv_memory import KvMemory
+from plenum_tpu.storage.state_ts_store import StateTsStore
 
 
 TRUSTEE_DID = "trusteeTrusteeTrustee1"
@@ -42,7 +44,7 @@ def make_db():
                 AUDIT_LEDGER_ID):
         state = None if lid == AUDIT_LEDGER_ID else PruningState()
         db.register_ledger(lid, Ledger(), state)
-    db.register_store(TS_STORE_LABEL, KvMemory())
+    db.register_store(TS_STORE_LABEL, StateTsStore(KvMemory()))
     db.register_store(SEQ_NO_DB_LABEL, KvMemory())
     return db
 
@@ -63,6 +65,7 @@ def make_managers(db):
     rm.register_handler(GetNymHandler(db))
     rm.register_handler(GetTxnHandler(db))
     rm.register_handler(GetTxnAuthorAgreementHandler(db))
+    rm.register_handler(GetTxnAuthorAgreementAmlHandler(db))
     return wm, rm
 
 
@@ -136,7 +139,8 @@ class TestWriteLifecycle:
         ledger = db.get_ledger(DOMAIN_LEDGER_ID)
         assert ledger.size == 1
         assert db.get_ledger(AUDIT_LEDGER_ID).size == 1
-        assert db.get_store(TS_STORE_LABEL).get(b"1000") is not None
+        assert db.get_store(TS_STORE_LABEL).get(
+            DOMAIN_LEDGER_ID, 1000) is not None
 
     def test_revert_is_exact_inverse(self, db):
         wm, _ = make_managers(db)
@@ -224,6 +228,39 @@ class TestTaa:
         # read it back
         res = rm.get_result(Request("x", 9, {"type": "6"}))
         assert res["data"]["version"] == "1"
+
+    def test_historic_taa_read_at_timestamp(self, db):
+        """State-as-of-time-T: after TAA v1 (t=1001) and a v2 update
+        (t=2000), GET_TAA at timestamp 1500 must return v1, at 2500 v2,
+        and before any config batch None (ref
+        get_txn_author_agreement_handler.py:46 + state_ts_store.py:38)."""
+        wm, rm = make_managers(db)
+        self._setup_taa(wm)
+        taa2 = Request(TRUSTEE_DID, 7,
+                       {"type": TXN_AUTHOR_AGREEMENT, "version": "2",
+                        "text": "agree harder", "ratification_ts": 1900},
+                       signature="s")
+        ok, rej, roots = wm.apply_batch(CONFIG_LEDGER_ID, [taa2],
+                                        2000.0, 0, 3)
+        assert len(ok) == 1, rej
+        wm.commit_batch(ThreePcBatch(
+            CONFIG_LEDGER_ID, 0, 3, 2000.0, (),
+            bytes.fromhex(roots["state_root"]), b"", b""))
+        q = lambda ts: rm.get_result(
+            Request("x", 9, {"type": "6", "timestamp": ts}))["data"]
+        assert q(1500)["version"] == "1"
+        assert q(2500)["version"] == "2"
+        assert q(2000)["version"] == "2"    # equal-or-prev: equal hits
+        assert q(500) is None               # before any config batch
+        # latest (no timestamp) still reads the committed head
+        res = rm.get_result(Request("x", 10, {"type": "6"}))
+        assert res["data"]["version"] == "2"
+        # AML as of time T rides the same root resolution
+        aml = rm.get_result(
+            Request("x", 11, {"type": "7", "timestamp": 1500}))["data"]
+        assert aml is not None and aml["version"] == "1"
+        assert rm.get_result(
+            Request("x", 12, {"type": "7", "timestamp": 500}))["data"] is None
 
     def test_bad_mechanism_rejected(self, db):
         wm, _ = make_managers(db)
