@@ -37,6 +37,7 @@ class MetricsName:
     # consensus
     VIEW_CHANGES = "consensus.view_changes"
     SUSPICIONS = "consensus.suspicions"
+    BACKUP_INSTANCE_REMOVED = "consensus.backup_instance_removed"
     CATCHUPS = "consensus.catchups"
     MASTER_3PC_BATCH_TIME = "consensus.master_3pc_batch_time"
     # transport

@@ -256,6 +256,23 @@ class Batch(MessageBase):
 
 
 @wire_message
+class BackupInstanceFaulty(MessageBase):
+    """Vote that a BACKUP protocol instance has stalled (ref
+    server/backup_instance_faulty_processor.py + node_messages
+    BackupInstanceFaulty): f+1 distinct voters remove the instance."""
+    typename = "BACKUP_INSTANCE_FAULTY"
+    view_no: int
+    inst_id: int
+    reason: int                       # suspicion code
+
+    def validate(self) -> None:
+        self._require_non_negative("view_no", "reason")
+        self._require(self.inst_id >= 1,
+                      "only backup instances (inst_id >= 1) can be "
+                      "voted faulty")
+
+
+@wire_message
 class BatchCommitted(MessageBase):
     """Observer push of a committed batch (ref node_messages.py:496)."""
     typename = "BATCH_COMMITTED"

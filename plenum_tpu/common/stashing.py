@@ -36,6 +36,7 @@ class StashingRouter:
         self._limit = limit
         self._queues: dict[StashReason, deque] = {}
         self._handlers: dict[type, Callable] = {}
+        self._bus_unsubs: list[Callable[[], None]] = []
         self.discarded: list[tuple[Any, Any, str]] = []
 
     def subscribe(self, message_type: type, handler: Callable) -> None:
@@ -45,7 +46,14 @@ class StashingRouter:
 
     def subscribe_to(self, bus) -> None:
         for message_type in list(self._handlers):
-            bus.subscribe(message_type, self.dispatch)
+            self._bus_unsubs.append(bus.subscribe(message_type, self.dispatch))
+
+    def unsubscribe_from_buses(self) -> None:
+        """Detach from every bus this router subscribed to (replica removal:
+        a detached instance must not keep processing wire messages)."""
+        for unsub in self._bus_unsubs:
+            unsub()
+        self._bus_unsubs.clear()
 
     def dispatch(self, message: Any, *args) -> None:
         handler = None

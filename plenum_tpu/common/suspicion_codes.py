@@ -37,6 +37,7 @@ class Suspicions:
     STATE_SIGS_ARE_NOT_UPDATED = Suspicion(24, "state freshness not updated in time")
     PPR_AUDIT_TXN_ROOT_WRONG = Suspicion(25, "PRE-PREPARE audit txn root mismatch")
     CATCHUP_NEEDED = Suspicion(26, "node fell behind checkpoint quorum")
+    BACKUP_INSTANCE_STALLED = Suspicion(27, "backup instance ordering stalled")
     NEW_VIEW_INVALID = Suspicion(30, "NEW_VIEW message failed validation")
     INVALID_REQ_SIGNATURE = Suspicion(31, "client request signature invalid")
 

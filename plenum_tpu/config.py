@@ -51,6 +51,16 @@ class Config:
     #     unordered-request checks, monitor.py:425) ---
     PRIMARY_HEALTH_CHECK_FREQ: float = 5.0
     ORDERING_PROGRESS_TIMEOUT: float = 30.0
+    # vote within seconds of LOSING THE CONNECTION to the primary, without
+    # waiting out the (much longer) ordering-stall / freshness windows
+    # (ref ToleratePrimaryDisconnection config.py:184 + primary_connection_
+    # monitor_service.py)
+    PRIMARY_DISCONNECT_TIMEOUT: float = 3.0
+
+    # --- faulty backup instances (ref backup_instance_faulty_processor +
+    #     ReplicasRemovingWithDegradation config) ---
+    BACKUP_INSTANCE_FAULTY_CHECK_FREQ: float = 10.0
+    BACKUP_INSTANCE_FAULTY_TIMEOUT: float = 60.0
 
     # --- catchup (ref config.py:297) ---
     CATCHUP_BATCH_SIZE: int = 5

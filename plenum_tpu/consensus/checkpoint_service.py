@@ -41,7 +41,12 @@ class CheckpointService:
         self._own: dict[int, Checkpoint] = {}
 
         bus.subscribe(Ordered, self.process_ordered)
-        network.subscribe(Checkpoint, self.process_checkpoint)
+        self._network_unsub = network.subscribe(Checkpoint,
+                                                self.process_checkpoint)
+
+    def stop(self) -> None:
+        """Detach from the shared network bus (replica removal)."""
+        self._network_unsub()
 
     @property
     def _chk_freq(self) -> int:

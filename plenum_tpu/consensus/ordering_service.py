@@ -115,6 +115,11 @@ class OrderingService:
         # they see as their position (ref _setup_last_ordered_for_non_master)
         self._needs_last_ordered_setup = False
 
+    def stop(self) -> None:
+        """Detach from the shared network bus (replica removal): a removed
+        instance must not keep consuming 3PC messages as a zombie."""
+        self._stasher.unsubscribe_from_buses()
+
     # ------------------------------------------------------------------ #
     # request intake                                                     #
     # ------------------------------------------------------------------ #

@@ -5,19 +5,25 @@ primary disconnect (plenum/server/consensus/monitoring/
 primary_connection_monitor_service.py, node.py:511), ordering stalls on
 finalized requests (unordered-request checks via the monitor,
 monitor.py:425), and state-freshness stalls (ordering_service.py:1991 +
-suspicion STATE_SIGS_ARE_NOT_UPDATED). This service folds the
-ordering-progress and freshness checks into one watchdog on the master
-instance of every non-primary node: if there is work to order and the
-3PC position does not advance within ORDERING_PROGRESS_TIMEOUT, or nothing
-at all has been ordered for longer than the freshness interval allows,
-emit VoteForViewChange. The vote rides the normal InstanceChange f+1
-quorum, so a single slow node cannot force a view change alone.
+suspicion STATE_SIGS_ARE_NOT_UPDATED). This service folds all three into
+one watchdog on the master instance of every non-primary node:
+
+- DISCONNECT (fast path): transport Connected/Disconnected events arrive
+  on the ExternalBus; losing the primary's connection schedules a vote
+  PRIMARY_DISCONNECT_TIMEOUT later (seconds, not the 30s+ stall windows),
+  cancelled if the primary comes back first.
+- ordering stall: work to order but no 3PC progress within
+  ORDERING_PROGRESS_TIMEOUT.
+- freshness: nothing ordered at all beyond the freshness interval.
+
+Every vote rides the normal InstanceChange f+1 quorum, so a single slow
+or partitioned node cannot force a view change alone.
 """
 from __future__ import annotations
 
 from typing import Callable, Optional
 
-from plenum_tpu.common.event_bus import InternalBus
+from plenum_tpu.common.event_bus import ExternalBus, InternalBus
 from plenum_tpu.common.internal_messages import VoteForViewChange
 from plenum_tpu.common.suspicion_codes import Suspicions
 from plenum_tpu.common.timer import RepeatingTimer, TimerService
@@ -32,12 +38,14 @@ class PrimaryHealthService:
                  timer: TimerService,
                  bus: InternalBus,
                  has_pending_work: Callable[[], bool],
-                 config: Optional[Config] = None):
+                 config: Optional[Config] = None,
+                 network: Optional[ExternalBus] = None):
         self._data = data
         self._timer = timer
         self._bus = bus
         self._has_pending_work = has_pending_work
         self._config = config or Config()
+        self._network = network
 
         self._progress_marker = data.last_ordered_3pc
         self._stall_since: Optional[float] = None
@@ -45,9 +53,49 @@ class PrimaryHealthService:
         self._last_order_time = now
         self._ticker = RepeatingTimer(
             timer, self._config.PRIMARY_HEALTH_CHECK_FREQ, self.check)
+        if network is not None:
+            network.subscribe(ExternalBus.Disconnected,
+                              self._on_peer_disconnected)
+            network.subscribe(ExternalBus.Connected, self._on_peer_connected)
 
     def stop(self) -> None:
         self._ticker.stop()
+        self._timer.cancel(self._disconnect_check)
+
+    # --- primary-disconnect fast path --------------------------------- #
+
+    def _on_peer_disconnected(self, msg, frm=None) -> None:
+        if msg.name == self._data.primary_name and not self._data.is_primary:
+            self._timer.cancel(self._disconnect_check)   # no double votes
+            self._timer.schedule(self._config.PRIMARY_DISCONNECT_TIMEOUT,
+                                 self._disconnect_check)
+
+    def _on_peer_connected(self, msg, frm=None) -> None:
+        if msg.name == self._data.primary_name:
+            self._timer.cancel(self._disconnect_check)
+
+    def _disconnect_check(self) -> None:
+        """Fires PRIMARY_DISCONNECT_TIMEOUT after losing the primary; the
+        state is re-validated at fire time (a view change may have picked a
+        new primary, or the old one may be back), and the vote repeats on
+        the same cadence while the primary stays gone."""
+        primary = self._data.primary_name
+        if (self._network is None or primary is None
+                or self._data.is_primary
+                or primary in self._network.connecteds):
+            return      # resolved: reconnected, new primary, or we lead now
+        if (not self._data.is_participating
+                or self._data.waiting_for_new_view):
+            # TRANSIENT (catchup / view change in flight): re-arm rather
+            # than disarm — no new Disconnected event will fire for an
+            # already-lost connection, and the primary may still be gone
+            # when we finish syncing
+            self._timer.schedule(self._config.PRIMARY_DISCONNECT_TIMEOUT,
+                                 self._disconnect_check)
+            return
+        self._vote(Suspicions.PRIMARY_DISCONNECTED)
+        self._timer.schedule(self._config.PRIMARY_DISCONNECT_TIMEOUT,
+                             self._disconnect_check)
 
     # ------------------------------------------------------------------ #
 

@@ -88,15 +88,34 @@ class Replica:
                 network=network, config=self.config)
             self.primary_health = PrimaryHealthService(
                 data=self._data, timer=timer, bus=self.internal_bus,
-                has_pending_work=self._has_unordered_work, config=self.config)
+                has_pending_work=self.has_unordered_work, config=self.config,
+                network=network)
 
         self.internal_bus.subscribe(NewViewAccepted, self._on_new_view_accepted)
         self.internal_bus.subscribe(CheckpointStabilized, self._on_checkpoint_stable)
 
-    def _has_unordered_work(self) -> bool:
-        """Finalized requests queued, or batches pre-prepared but unordered."""
-        return (any(self.ordering.request_queues.values())
-                or bool(self._data.preprepared))
+    def stop(self) -> None:
+        """Detach this instance from the shared node buses and timers. A
+        replica removed as faulty (node._process_backup_faulty) must become
+        inert — a popped-but-subscribed instance would keep processing 3PC
+        traffic as a zombie and, once the view change re-creates the id,
+        two replicas would speak with one name."""
+        self.ordering.stop()
+        self.checkpointer.stop()
+        if self.primary_health is not None:
+            self.primary_health.stop()
+
+    def has_unordered_work(self) -> bool:
+        """Finalized requests queued, or batches pre-prepared but unordered.
+        preprepared CERTIFICATES survive ordering until checkpoint GC (they
+        back view-change proofs), so only batches BEYOND last_ordered count
+        as pending — a stabilization-lagged cert must not read as a stalled
+        primary."""
+        if any(self.ordering.request_queues.values()):
+            return True
+        last = self._data.last_ordered_3pc
+        return any((b.view_no, b.pp_seq_no) > last
+                   for b in self._data.preprepared)
 
     def adopt_new_view(self, view_no: int, primaries: list[str]) -> None:
         """Backup instance follows a master-completed view change: take the
@@ -162,32 +181,61 @@ class Replica:
 
 class Replicas:
     """The RBFT instance collection: instance 0 is the master, the rest shadow
-    (ref replicas.py:19, adjustReplicas node.py:1260)."""
+    (ref replicas.py:19, adjustReplicas node.py:1260).
+
+    Keyed by inst_id (not list position): removing a faulty backup (ref
+    backup_instance_faulty_processor) leaves a GAP, and the surviving
+    instances must keep their ids — 3PC messages carry inst_id on the wire.
+    `grow_to` fills gaps, which is also how a removed backup is re-added
+    fresh at the next view change."""
 
     def __init__(self, make_replica: Callable[[int], Replica]):
         self._make = make_replica
-        self._replicas: list[Replica] = []
+        self._replicas: dict[int, Replica] = {}
 
-    def grow_to(self, count: int) -> None:
-        while len(self._replicas) < count:
-            self._replicas.append(self._make(len(self._replicas)))
+    def grow_to(self, count: int, skip: set[int] = frozenset()) -> None:
+        """Create every missing instance below `count`, except ids in
+        `skip` (backups removed as faulty stay out until a view change
+        clears them)."""
+        for inst_id in range(count):
+            if inst_id not in self._replicas and inst_id not in skip:
+                self._replicas[inst_id] = self._make(inst_id)
 
     def shrink_to(self, count: int) -> None:
-        del self._replicas[count:]
+        for inst_id in [i for i in self._replicas if i >= count]:
+            self._replicas.pop(inst_id).stop()
+
+    def remove_instance(self, inst_id: int) -> Optional[Replica]:
+        """Drop a faulty BACKUP instance (master is never removable). The
+        dropped replica is detached (stop()) so it cannot keep processing
+        shared-bus traffic as a zombie."""
+        if inst_id == 0:
+            raise ValueError("the master instance cannot be removed")
+        removed = self._replicas.pop(inst_id, None)
+        if removed is not None:
+            removed.stop()
+        return removed
 
     @property
     def master(self) -> Replica:
         return self._replicas[0]
 
+    @property
+    def instance_ids(self) -> list[int]:
+        return sorted(self._replicas)
+
     def __iter__(self):
-        return iter(self._replicas)
+        return iter(self._replicas[i] for i in sorted(self._replicas))
 
     def __len__(self):
         return len(self._replicas)
+
+    def __contains__(self, inst_id: int) -> bool:
+        return inst_id in self._replicas
 
     def __getitem__(self, inst_id: int) -> Replica:
         return self._replicas[inst_id]
 
     def service_all(self) -> None:
-        for replica in self._replicas:
+        for replica in self:
             replica.service()

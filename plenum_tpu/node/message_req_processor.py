@@ -92,7 +92,7 @@ class MessageReqProcessor:
     def _serve_preprepare(self, params: dict) -> Optional[PrePrepare]:
         inst_id = int(params["inst_id"])
         key = (int(params["view_no"]), int(params["pp_seq_no"]))
-        if inst_id >= len(self._node.replicas):
+        if inst_id not in self._node.replicas:
             return None
         ordering = self._node.replicas[inst_id].ordering
         return ordering.prePrepares.get(key) or \
@@ -106,7 +106,7 @@ class MessageReqProcessor:
         in the cited view itself."""
         inst_id = int(params["inst_id"])
         key = (int(params["view_no"]), int(params["pp_seq_no"]))
-        if inst_id >= len(self._node.replicas):
+        if inst_id not in self._node.replicas:
             return None
         ordering = self._node.replicas[inst_id].ordering
         found = ordering.old_view_preprepares.get(key)
@@ -153,12 +153,12 @@ class MessageReqProcessor:
             # original PROPAGATE had arrived from this peer
             self._node._receive_propagate(inner, frm)
         elif msg.msg_type == PREPREPARE and isinstance(inner, PrePrepare):
-            if inner.inst_id < len(self._node.replicas):
+            if inner.inst_id in self._node.replicas:
                 self._node.replicas[inner.inst_id].ordering \
                     .process_requested_preprepare(inner)
         elif msg.msg_type == OLD_VIEW_PREPREPARE and \
                 isinstance(inner, PrePrepare):
-            if inner.inst_id < len(self._node.replicas):
+            if inner.inst_id in self._node.replicas:
                 self._node.replicas[inner.inst_id].ordering \
                     .process_requested_old_view_preprepare(inner)
         elif msg.msg_type == VIEW_CHANGE and isinstance(inner, ViewChange):

@@ -27,7 +27,9 @@ from plenum_tpu.common.internal_messages import (MissingMessage,
                                                  RequestPropagates,
                                                  VoteForViewChange)
 from plenum_tpu.common.suspicion_codes import Suspicions
-from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID, BatchCommitted,
+from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
+                                             BackupInstanceFaulty,
+                                             BatchCommitted,
                                              CatchupRep, CatchupReq,
                                              ConsistencyProof, LedgerStatus,
                                              Ordered, POOL_LEDGER_ID,
@@ -200,6 +202,20 @@ class Node:
         self._perf_check_timer = RepeatingTimer(
             timer, self.config.PerfCheckFreq, self.check_performance)
 
+        # faulty BACKUP instances: a backup that stops ordering while work
+        # is pending poisons the monitor's master-vs-backup comparison; an
+        # f+1 quorum of BackupInstanceFaulty removes it, and the next view
+        # change re-adds it fresh (ref backup_instance_faulty_processor.py
+        # + node.py:2580-2596)
+        self.node_bus.subscribe(BackupInstanceFaulty,
+                                self._process_backup_faulty)
+        self._backup_wedge_markers: dict[int, tuple[tuple, float]] = {}
+        self._backup_faulty_votes: dict[tuple[int, int], set[str]] = {}
+        self._removed_backups: set[int] = set()
+        self._backup_check_timer = RepeatingTimer(
+            timer, self.config.BACKUP_INSTANCE_FAULTY_CHECK_FREQ,
+            self._check_backup_instances)
+
         # crash-restart: a node rebuilt over durable storage resumes at the
         # audit ledger's 3PC position and primaries instead of view 0 / seq 0
         # (ref node.py:1830,1875 — the same restore catchup applies later)
@@ -243,6 +259,68 @@ class Node:
                     suspicion_code=Suspicions.PRIMARY_DEGRADED.code))
             # history is void once we've called for a new master
             self.monitor.reset()
+
+    def _check_backup_instances(self) -> None:
+        """Detect wedged BACKUP instances: queued work but no 3PC progress
+        for BACKUP_INSTANCE_FAULTY_TIMEOUT -> broadcast a
+        BackupInstanceFaulty vote (and count our own). The master has its
+        own watchdog (PrimaryHealthService) — view change, not removal."""
+        now = self.timer.get_current_time()
+        master = self.replicas.master.data
+        if (self.leecher.is_running or not master.is_participating
+                or master.waiting_for_new_view):
+            # catchup / an in-flight view change legitimately freezes every
+            # instance: restart the stall clocks instead of counting the
+            # pause as a wedge (same gate as PrimaryHealthService.check)
+            self._backup_wedge_markers.clear()
+            return
+        live = set()
+        for replica in list(self.replicas):
+            iid = replica.data.inst_id
+            if iid == 0:
+                continue
+            live.add(iid)
+            has_work = replica.has_unordered_work()
+            marker = replica.data.last_ordered_3pc
+            prev = self._backup_wedge_markers.get(iid)
+            if not has_work or prev is None or prev[0] != marker:
+                self._backup_wedge_markers[iid] = (marker, now)
+                continue
+            if now - prev[1] >= self.config.BACKUP_INSTANCE_FAULTY_TIMEOUT:
+                vote = BackupInstanceFaulty(
+                    view_no=self.replicas.master.data.view_no, inst_id=iid,
+                    reason=Suspicions.BACKUP_INSTANCE_STALLED.code)
+                self.node_bus.send(vote)                 # broadcast to peers
+                self._process_backup_faulty(vote, self.name)
+                self._backup_wedge_markers[iid] = (marker, now)  # re-vote
+        for iid in list(self._backup_wedge_markers):
+            if iid not in live:
+                del self._backup_wedge_markers[iid]
+
+    def _process_backup_faulty(self, msg: BackupInstanceFaulty,
+                               frm: str) -> None:
+        """f+1 DISTINCT voters (ref quorums backup_instance_faulty) agree a
+        backup stalled -> remove the instance. Ids are stable across the
+        gap; the instance is re-created fresh by the next view change."""
+        view = self.replicas.master.data.view_no
+        if msg.view_no != view or msg.inst_id == 0 \
+                or msg.inst_id not in self.replicas:
+            return
+        voters = self._backup_faulty_votes.setdefault(
+            (view, msg.inst_id), set())
+        voters.add(frm)
+        if not self.quorums.backup_instance_faulty.is_reached(len(voters)):
+            return
+        self.replicas.remove_instance(msg.inst_id)   # stop()s the zombie
+        self._removed_backups.add(msg.inst_id)
+        self._backup_wedge_markers.pop(msg.inst_id, None)
+        # stale votes (this instance, and anything from older views) go too
+        self._backup_faulty_votes = {
+            k: v for k, v in self._backup_faulty_votes.items()
+            if k[0] == view and k[1] != msg.inst_id}
+        self.monitor.reset()    # comparison basis changed
+        self.metrics.add_event(MetricsName.BACKUP_INSTANCE_REMOVED)
+        self.spylog.append(("backup_instance_removed", msg.inst_id))
 
     def _clean_outdated_reqs(self) -> None:
         now = self.timer.get_current_time()
@@ -343,9 +421,22 @@ class Node:
 
     def _on_master_new_view(self, msg: NewViewAccepted) -> None:
         """The master completed a view change: every backup instance follows
-        (view change is node-level; backups have no VC machinery of their own)."""
+        (view change is node-level; backups have no VC machinery of their own).
+        Backups removed as faulty are re-created fresh here (ref
+        restore_backup_replicas on view change)."""
+        n_inst = max(1, self.quorums.f + 1)
+        self._removed_backups.clear()       # a new view restores everything
+        # partial vote sets from superseded views can never complete (view
+        # is checked at receipt) — drop them or they leak one per view
+        self._backup_faulty_votes = {
+            k: v for k, v in self._backup_faulty_votes.items()
+            if k[0] >= msg.view_no}
+        fresh = [i for i in range(n_inst) if i not in self.replicas]
+        self.replicas.grow_to(n_inst)
         primaries = list(self.replicas.master.data.primaries)
         for replica in self.replicas:
+            if replica.data.inst_id in fresh:
+                replica.set_validators(self.validators)
             replica.adopt_new_view(msg.view_no, primaries)
         self.monitor.reset()
         self.metrics.add_event(MetricsName.VIEW_CHANGES)
@@ -357,7 +448,7 @@ class Node:
         sender (ref node.py:2854-2944)."""
         self.metrics.add_event(MetricsName.SUSPICIONS)
         self.spylog.append(("suspicion", (msg.code, msg.sender)))
-        if msg.inst_id >= len(self.replicas):
+        if msg.inst_id not in self.replicas:
             return
         replica = self.replicas[msg.inst_id]
         if msg.code in PRIMARY_FAULT_CODES and \
@@ -456,12 +547,17 @@ class Node:
         master = self.replicas.master
         if master.view_changer is not None:
             master.view_changer.set_instance_count(n_inst)
-        old = len(self.replicas)
-        if n_inst == old:
+        existing = set(self.replicas.instance_ids)
+        target = set(range(n_inst)) - self._removed_backups
+        if existing == target:
             return
-        if n_inst < old:
+        if max(existing) >= n_inst:
             self.replicas.shrink_to(n_inst)
-            return
+            self._removed_backups -= {i for i in self._removed_backups
+                                      if i >= n_inst}
+            if set(self.replicas.instance_ids) == target:
+                return          # pure shrink; a gap below n_inst still
+                                # falls through to be re-filled
         # Deterministic extension: base the assignment on the COMMITTED
         # audit trail (view + primaries of the batch that changed
         # membership), never on master.data — a node mid-view-change has
@@ -488,7 +584,7 @@ class Node:
                     f"{n_inst} instances over {n} validators")
             primaries.append(cand)
             used.add(cand)
-        self.replicas.grow_to(n_inst)
+        self.replicas.grow_to(n_inst, skip=self._removed_backups)
         # EVERY instance (master included) takes the extended canonical
         # list: the audit provider snapshots master.data.primaries, so a
         # short master list would be recorded durably and a restarted node
@@ -496,13 +592,14 @@ class Node:
         # list is derived purely from committed audit state, so a node
         # mid-view-change assigns the same value as everyone else — and
         # the view change's own completion re-selects it anyway.
-        for rank, replica in enumerate(self.replicas):
+        for replica in self.replicas:
             replica.data.primaries = list(primaries)
-            if rank >= old:
+            if replica.data.inst_id not in existing:
                 replica.set_validators(self.validators)
                 # fresh backups join the audited view with a clean 3PC log
                 replica.data.view_no = view
-        self.spylog.append(("replicas_adjusted", (old, n_inst)))
+        self.spylog.append(
+            ("replicas_adjusted", (sorted(existing), n_inst)))
 
     # --- ingress ----------------------------------------------------------
 

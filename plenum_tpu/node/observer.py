@@ -14,9 +14,11 @@ tolerant even though it trusts the pool's ordering.
 """
 from __future__ import annotations
 
+import hashlib
 from typing import Any, Callable, Optional
 
 from plenum_tpu.common.node_messages import BatchCommitted
+from plenum_tpu.common.serialization import signing_serialize
 
 
 class Observable:
@@ -52,16 +54,30 @@ class NodeObserver:
     below the ledger's size are ignored, a batch leaving a gap is rejected
     (the caller should catch up out of band, same as the reference's
     can_process check in observer_sync_policy_each_batch.py).
+
+    Data quorum (ref plenum/server/quorums.py:38 observer_data = f+1): a
+    batch is applied only once f+1 DISTINCT validators have pushed
+    CONTENT-IDENTICAL copies — root re-derivation alone binds the chain
+    but cannot stop a lone Byzantine validator from feeding a
+    self-consistent fabricated batch; with f+1 matching pushes at least
+    one comes from an honest validator. Each validator holds one vote per
+    (ledger, seq range) — a re-push with different content replaces its
+    earlier vote, so one peer can never grow the buffer. f=0 (the default,
+    for single-trusted-feed library use) applies on the first push.
     """
 
-    def __init__(self, components):
+    def __init__(self, components, f: int = 0):
         self.c = components
+        self.f = f
         self.last_applied: dict[int, int] = {}
+        # (ledger, seq_no_start) -> {validator: (content digest, batch)}.
+        # Keyed by START only (which the gap check pins to ledger.size+1),
+        # so the buffer holds at most one entry per ledger and one vote per
+        # validator — a Byzantine peer varying seq_no_end just replaces its
+        # own vote instead of minting new buckets
+        self._votes: dict[tuple, dict[str, tuple[str, BatchCommitted]]] = {}
 
     def process_batch(self, batch: BatchCommitted, frm: str = "") -> bool:
-        from plenum_tpu.common.request import Request
-        from plenum_tpu.execution.write_manager import ThreePcBatch
-
         ledger = self.c.db.get_ledger(batch.ledger_id)
         if ledger is None:
             return False
@@ -69,6 +85,26 @@ class NodeObserver:
             return False                            # already have it
         if batch.seq_no_start != ledger.size + 1:
             return False                            # gap: needs catchup
+
+        key = (batch.ledger_id, batch.seq_no_start)
+        digest = hashlib.sha256(
+            signing_serialize(batch.to_dict())).hexdigest()
+        votes = self._votes.setdefault(key, {})
+        votes[frm] = (digest, batch)
+        if sum(1 for d, _ in votes.values() if d == digest) < self.f + 1:
+            return False                            # buffered, no quorum yet
+        applied = self._apply_batch(batch)
+        if applied:
+            # quorum consumed; every start now at or below the ledger size
+            # is settled, so the buffer stays bounded by in-flight ranges
+            self._votes = {k: v for k, v in self._votes.items()
+                           if not (k[0] == batch.ledger_id
+                                   and k[1] <= batch.seq_no_end)}
+        return applied
+
+    def _apply_batch(self, batch: BatchCommitted) -> bool:
+        from plenum_tpu.common.request import Request
+        from plenum_tpu.execution.write_manager import ThreePcBatch
 
         # re-run the write pipeline: apply -> compare roots -> commit
         requests = [Request.from_dict(r) for r in batch.requests]

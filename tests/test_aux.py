@@ -185,6 +185,50 @@ def test_observer_refuses_tampered_batch():
     assert observer.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2
 
 
+def test_observer_f_plus_1_data_quorum():
+    """With f=1 an observer needs 2 content-identical pushes from DISTINCT
+    validators before applying (ref quorums.py:38 observer_data): a lone
+    Byzantine validator's fabricated-but-self-consistent batch is buffered
+    forever, and its re-push replaces (not adds to) its earlier vote."""
+    from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID,
+                                                 BatchCommitted)
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.node.observer import NodeObserver
+
+    pool = Pool()
+    node = pool.nodes["Alpha"]
+    node.observable.add_observer("obs")
+    user = Ed25519Signer(seed=b"quorum-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(5.0)
+    batch = next(m for m, c in pool.client_msgs["Alpha"]
+                 if isinstance(m, BatchCommitted))
+
+    import dataclasses
+    # a SELF-CONSISTENT fabrication: drop the user NYM request entirely and
+    # recompute nothing — roots won't match, but even a root-consistent
+    # fake only ever gets the Byzantine node's single vote
+    fake = dataclasses.replace(batch, requests=batch.requests[:0])
+
+    observer = NodeObserver(_observer_components(pool.names), f=1)
+    ledger = observer.c.db.get_ledger(DOMAIN_LEDGER_ID)
+    base = ledger.size
+    # Byzantine node pushes its fake — no quorum, nothing applied
+    assert not observer.process_batch(fake, frm="Byz")
+    assert not observer.process_batch(fake, frm="Byz")   # re-push: 1 vote
+    assert ledger.size == base
+    # one honest push: still below f+1
+    assert not observer.process_batch(batch, frm="Beta")
+    assert ledger.size == base
+    # second honest push with IDENTICAL content -> quorum -> applied
+    assert observer.process_batch(batch, frm="Gamma")
+    assert ledger.size == base + 1
+    # quorum state for the settled range was purged
+    assert not observer._votes
+    # late duplicate from a straggler is idempotently ignored
+    assert not observer.process_batch(batch, frm="Delta")
+
+
 # --- action requests ------------------------------------------------------
 
 def test_validator_info_action_requires_privilege():

@@ -80,6 +80,76 @@ def test_quiescent_dead_primary_detected_via_freshness():
     assert sizes == {2}
 
 
+def test_primary_disconnect_votes_within_disconnect_timeout():
+    """CONNECTION LOSS to the primary triggers the view-change vote within
+    PRIMARY_DISCONNECT_TIMEOUT — seconds — without waiting out the (here
+    deliberately enormous) ordering-stall and freshness windows (ref
+    primary_connection_monitor_service.py + ToleratePrimaryDisconnection)."""
+    pool = fast_pool(seed=19,
+                     PRIMARY_DISCONNECT_TIMEOUT=2.0,
+                     ORDERING_PROGRESS_TIMEOUT=300.0,
+                     STATE_FRESHNESS_UPDATE_INTERVAL=300.0)
+    primary = pool.nodes["Alpha"].master_replica.data.primary_name
+    assert primary == "Alpha"
+    # crash_node drops the peer from the fabric -> Disconnected events on
+    # every survivor (cut_off would only drop messages, not the connection)
+    pool.crash_node(primary)
+
+    user = Ed25519Signer(seed=b"disc-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, req_id=1),
+                to=healthy(pool, primary))
+    pool.run(10.0)      # << 300s: only the disconnect path can have fired
+
+    for n in healthy(pool, primary):
+        node = pool.nodes[n]
+        assert node.master_replica.view_no >= 1, \
+            f"{n} never voted on primary disconnect"
+        assert node.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2, \
+            f"{n} did not order after the fast view change"
+
+
+def test_wedged_backup_instance_removed_then_restored():
+    """A backup instance whose primary stops ordering is detected (queued
+    work, no 3PC progress), voted out by an f+1 BackupInstanceFaulty
+    quorum on every node, and re-created fresh by the next view change
+    (ref backup_instance_faulty_processor.py + node.py:2580-2596)."""
+    pool = fast_pool(seed=23,
+                     BACKUP_INSTANCE_FAULTY_CHECK_FREQ=0.5,
+                     BACKUP_INSTANCE_FAULTY_TIMEOUT=2.0)
+    # wedge instance 1 pool-wide by muting its primary's ordering service
+    backup_primary = None
+    for node in pool.nodes.values():
+        r1 = node.replicas[1]
+        if r1.is_primary:
+            backup_primary = node.name
+            r1.ordering.service = lambda: None
+    assert backup_primary is not None
+
+    user = Ed25519Signer(seed=b"wedge-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, req_id=1))
+    pool.run(10.0)
+
+    for name, node in pool.nodes.items():
+        assert 1 not in node.replicas, \
+            f"{name} never removed the wedged backup instance"
+        assert ("backup_instance_removed", 1) in node.spylog
+        # master kept ordering throughout
+        assert node.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2
+
+    # a view change (here: master primary goes quiet with work pending)
+    # re-creates the removed backup fresh
+    cut_off(pool, "Alpha")
+    user2 = Ed25519Signer(seed=b"wedge-user-2".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user2, req_id=2),
+                to=healthy(pool, "Alpha"))
+    pool.run(20.0)
+    for n in healthy(pool, "Alpha"):
+        node = pool.nodes[n]
+        assert node.master_replica.view_no >= 1
+        assert 1 in node.replicas, f"{n} did not restore the backup"
+        assert node.replicas[1].view_no == node.master_replica.view_no
+
+
 def test_malicious_primary_wrong_state_root():
     """The primary lies about the state root: validators' re-apply catches it
     (PPR_STATE_WRONG), the suspicion becomes a view-change vote, and the pool

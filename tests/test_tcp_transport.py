@@ -277,3 +277,73 @@ def test_pool_orders_nym_over_tcp():
             assert sizes == {2}, sizes       # genesis NYM + the new one
 
     asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_primary_crash_recovers_within_disconnect_timeout():
+    """Kill the primary (stop prodding + close its sockets): survivors see
+    the TCP disconnect, vote PRIMARY_DISCONNECTED after
+    PRIMARY_DISCONNECT_TIMEOUT, complete a view change, and order a
+    pending NYM — with the stall/freshness watchdogs configured far too
+    slow (600s) to be the cause (ref primary_connection_monitor_service)."""
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import NYM
+
+    (names, reg, looper, nodes, client_stacks,
+     setup, trustee) = _build_tcp_pool()
+
+    async def main():
+        await setup()
+        # only the disconnect fast path may fire inside this test's window
+        for node in nodes.values():
+            node.config.PRIMARY_DISCONNECT_TIMEOUT = 2.0
+            node.config.ORDERING_PROGRESS_TIMEOUT = 600.0
+            node.config.STATE_FRESHNESS_UPDATE_INTERVAL = 600.0
+        async with looper:
+            ok = await looper.run_until(
+                lambda: all(len(n.node_bus.connecteds) == 3
+                            for n in nodes.values()), timeout=10.0)
+            assert ok, "pool never meshed over TCP"
+
+            primary = nodes[names[0]].master_replica.data.primary_name
+            survivors = [n for n in names if n != primary]
+            victim = next(p for p in looper._prodables
+                          if p.node is nodes[primary])
+            victim.prod = lambda: 0          # the process is "dead"
+            await victim.stop()              # sockets close underneath peers
+
+            user = Ed25519Signer(seed=b"tcp-crash-user".ljust(32, b"\0"))
+            req = Request(trustee.identifier, 1,
+                          {"type": NYM, "dest": user.identifier,
+                           "verkey": user.verkey_b58})
+            req.signature = trustee.sign_b58(req.signing_bytes())
+
+            async def submit(name):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", client_stacks[name].port)
+                data = pack(req.to_dict())
+                writer.write(len(data).to_bytes(4, "big") + data)
+                await writer.drain()
+                writer.close()
+
+            await asyncio.gather(*(submit(n) for n in survivors))
+            t0 = time.perf_counter()
+            ok = await looper.run_until(
+                lambda: all(
+                    nodes[n].master_replica.view_no >= 1
+                    and nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2
+                    for n in survivors),
+                timeout=25.0)
+            elapsed = time.perf_counter() - t0
+            for n in survivors:
+                assert nodes[n].master_replica.view_no >= 1, \
+                    f"{n} never left view 0 (after {elapsed:.1f}s)"
+                assert nodes[n].c.db.get_ledger(
+                    DOMAIN_LEDGER_ID).size == 2, f"{n} did not order"
+            # sanity: recovery rode the 2s disconnect vote, not the 600s
+            # watchdogs (generous bound for slow CI)
+            assert elapsed < 25.0
+
+    asyncio.run(main())
