@@ -1,0 +1,56 @@
+"""Python-3.12 compatibility patches the 2021-era reference needs, applied
+BEFORE any reference import. Each patch restores a stdlib/pyzmq name the
+reference references; none changes behavior of the measured code."""
+import collections
+import collections.abc
+import sys
+
+for _n in ("Iterable", "Callable", "Hashable", "Mapping", "MutableMapping",
+           "Sequence", "Set", "MutableSet", "MutableSequence", "Iterator",
+           "ItemsView", "KeysView", "ValuesView", "Awaitable", "Coroutine"):
+    if not hasattr(collections, _n):
+        setattr(collections, _n, getattr(collections.abc, _n))
+
+import asyncio.coroutines
+if not hasattr(asyncio.coroutines, "CoroWrapper"):
+    asyncio.coroutines.CoroWrapper = object          # used as annotation only
+
+import zmq.auth.thread as _zmq_thread
+if not hasattr(_zmq_thread, "_inherit_docstrings"):
+    _zmq_thread._inherit_docstrings = lambda cls: cls   # removed in pyzmq>=25
+
+import time as _time
+if not hasattr(_time, "clock"):
+    _time.clock = _time.perf_counter                 # removed in py3.8
+
+import msgpack as _msgpack
+# msgpack>=1.0 defaults strict_map_key=True; the reference's audit-ledger
+# txns legitimately use int map keys (ledger-id -> root maps)
+_orig_unpackb = _msgpack.unpackb
+
+
+def _unpackb(*a, **k):
+    k.setdefault("strict_map_key", False)
+    return _orig_unpackb(*a, **k)
+
+
+_msgpack.unpackb = _unpackb
+
+_OrigUnpacker = _msgpack.Unpacker
+
+
+class _Unpacker(_OrigUnpacker):
+    def __init__(self, *a, **k):
+        k.setdefault("strict_map_key", False)
+        super().__init__(*a, **k)
+
+
+_msgpack.Unpacker = _Unpacker
+
+
+def add_paths():
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in (os.path.join(here, "refshims"), "/root/reference"):
+        if p not in sys.path:
+            sys.path.insert(0, p)

@@ -1,0 +1,1 @@
+from hashlib import sha256  # noqa: F401 — py3.12 dropped the _sha256 name

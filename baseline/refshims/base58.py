@@ -1,0 +1,42 @@
+"""Minimal base58 (bitcoin alphabet) shim for the reference baseline run.
+Pure-python, API-compatible subset of the `base58` package: b58encode /
+b58decode returning bytes, accepting str or bytes."""
+_ALPHABET = b"123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_INDEX = {c: i for i, c in enumerate(_ALPHABET)}
+
+
+def b58encode(v) -> bytes:
+    if isinstance(v, str):
+        v = v.encode()
+    n = int.from_bytes(v, "big")
+    out = bytearray()
+    while n:
+        n, r = divmod(n, 58)
+        out.append(_ALPHABET[r])
+    pad = 0
+    for b in v:
+        if b == 0:
+            pad += 1
+        else:
+            break
+    return bytes([_ALPHABET[0]]) * pad + bytes(reversed(out))
+
+
+def b58decode(v) -> bytes:
+    if isinstance(v, str):
+        v = v.encode()
+    n = 0
+    for c in v:
+        n = n * 58 + _INDEX[c]
+    out = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    pad = 0
+    for c in v:
+        if c == _ALPHABET[0]:
+            pad += 1
+        else:
+            break
+    return b"\0" * pad + out
+
+
+# the reference references `base58.alphabet` for validity checks
+alphabet = _ALPHABET

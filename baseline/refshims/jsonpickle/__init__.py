@@ -1,0 +1,35 @@
+"""Stub jsonpickle for the baseline run: only wallet (de)serialization uses
+it for real, which the node-side pool benchmark never touches. The subset
+here satisfies plenum.common.jsonpickle_util's import-time registration."""
+import json  # re-exported: plenum.common.script_helper does `from jsonpickle import json`
+
+
+class tags:
+    OBJECT = "py/object"
+
+
+def encode(obj, **kw):
+    raise NotImplementedError("jsonpickle stub: wallet persistence unused in baseline run")
+
+
+def decode(s, **kw):
+    raise NotImplementedError("jsonpickle stub: wallet persistence unused in baseline run")
+
+
+class JSONBackend:
+    """Subclassable stub (plenum.client.wallet defines a migration backend
+    over it; never instantiated in the node-side baseline run)."""
+
+    def decode(self, string):
+        return json.loads(string)
+
+    def encode(self, obj, **kw):
+        return json.dumps(obj)
+
+
+def set_preferred_backend(*a, **k):
+    pass
+
+
+def load_backend(*a, **k):
+    pass

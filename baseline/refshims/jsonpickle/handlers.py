@@ -1,0 +1,14 @@
+class BaseHandler:
+    def __init__(self, context=None):
+        self.context = context
+
+
+_registry = {}
+
+
+def register(cls, handler, base=False):
+    _registry[cls] = handler
+
+
+def unregister(cls):
+    _registry.pop(cls, None)

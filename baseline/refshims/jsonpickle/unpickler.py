@@ -1,0 +1,4 @@
+def loadclass(path):
+    import importlib
+    mod, _, name = path.rpartition(".")
+    return getattr(importlib.import_module(mod), name)

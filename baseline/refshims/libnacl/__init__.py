@@ -1,0 +1,139 @@
+"""ctypes libnacl shim over the system libsodium for the baseline run.
+
+The real `libnacl` package is exactly this: a ctypes binding to
+libsodium.so — so signing/verification cost measured through this shim is
+the reference's true crypto cost (stp_core/crypto/nacl_wrappers.py:62,212
+routes every node/client signature through these calls)."""
+import ctypes
+import ctypes.util
+
+_lib = None
+for cand in ("libsodium.so.23", "libsodium.so", ctypes.util.find_library("sodium")):
+    if cand:
+        try:
+            _lib = ctypes.CDLL(cand)
+            break
+        except OSError:
+            continue
+if _lib is None:
+    raise ImportError("system libsodium not found")
+if _lib.sodium_init() < 0:
+    raise ImportError("sodium_init failed")
+
+
+class CryptError(Exception):
+    pass
+
+
+crypto_sign_BYTES = _lib.crypto_sign_bytes()
+crypto_sign_SEEDBYTES = _lib.crypto_sign_seedbytes()
+crypto_sign_PUBLICKEYBYTES = _lib.crypto_sign_publickeybytes()
+crypto_sign_SECRETKEYBYTES = _lib.crypto_sign_secretkeybytes()
+crypto_box_NONCEBYTES = _lib.crypto_box_noncebytes()
+crypto_box_PUBLICKEYBYTES = _lib.crypto_box_publickeybytes()
+crypto_box_SECRETKEYBYTES = _lib.crypto_box_secretkeybytes()
+crypto_box_BEFORENMBYTES = _lib.crypto_box_beforenmbytes()
+crypto_box_ZEROBYTES = _lib.crypto_box_zerobytes()
+crypto_box_BOXZEROBYTES = _lib.crypto_box_boxzerobytes()
+crypto_secretbox_KEYBYTES = _lib.crypto_secretbox_keybytes()
+crypto_secretbox_NONCEBYTES = _lib.crypto_secretbox_noncebytes()
+crypto_secretbox_ZEROBYTES = _lib.crypto_secretbox_zerobytes()
+crypto_secretbox_BOXZEROBYTES = _lib.crypto_secretbox_boxzerobytes()
+
+
+def randombytes(size: int) -> bytes:
+    buf = ctypes.create_string_buffer(size)
+    _lib.randombytes_buf(buf, ctypes.c_size_t(size))
+    return buf.raw
+
+
+def randombytes_uniform(upper: int) -> int:
+    return _lib.randombytes_uniform(ctypes.c_uint32(upper))
+
+
+def crypto_sign_seed_keypair(seed: bytes):
+    if len(seed) != crypto_sign_SEEDBYTES:
+        raise ValueError("invalid seed length")
+    pk = ctypes.create_string_buffer(crypto_sign_PUBLICKEYBYTES)
+    sk = ctypes.create_string_buffer(crypto_sign_SECRETKEYBYTES)
+    if _lib.crypto_sign_seed_keypair(pk, sk, seed):
+        raise CryptError("crypto_sign_seed_keypair failed")
+    return pk.raw, sk.raw
+
+
+def crypto_sign_keypair():
+    return crypto_sign_seed_keypair(randombytes(crypto_sign_SEEDBYTES))
+
+
+def crypto_sign(msg: bytes, sk: bytes) -> bytes:
+    out = ctypes.create_string_buffer(len(msg) + crypto_sign_BYTES)
+    out_len = ctypes.c_ulonglong()
+    if _lib.crypto_sign(out, ctypes.byref(out_len), msg,
+                        ctypes.c_ulonglong(len(msg)), sk):
+        raise CryptError("crypto_sign failed")
+    return out.raw[:out_len.value]
+
+
+def crypto_sign_open(signed: bytes, pk: bytes) -> bytes:
+    out = ctypes.create_string_buffer(len(signed))
+    out_len = ctypes.c_ulonglong()
+    if _lib.crypto_sign_open(out, ctypes.byref(out_len), signed,
+                             ctypes.c_ulonglong(len(signed)), pk):
+        raise CryptError("signature verification failed")
+    return out.raw[:out_len.value]
+
+
+def crypto_scalarmult_base(sk: bytes) -> bytes:
+    out = ctypes.create_string_buffer(32)
+    if _lib.crypto_scalarmult_base(out, sk):
+        raise CryptError("crypto_scalarmult_base failed")
+    return out.raw
+
+
+def crypto_box_beforenm(pk: bytes, sk: bytes) -> bytes:
+    out = ctypes.create_string_buffer(crypto_box_BEFORENMBYTES)
+    if _lib.crypto_box_beforenm(out, pk, sk):
+        raise CryptError("crypto_box_beforenm failed")
+    return out.raw
+
+
+def crypto_box_afternm(msg: bytes, nonce: bytes, k: bytes) -> bytes:
+    padded = b"\x00" * crypto_box_ZEROBYTES + msg
+    out = ctypes.create_string_buffer(len(padded))
+    if _lib.crypto_box_afternm(out, padded,
+                               ctypes.c_ulonglong(len(padded)), nonce, k):
+        raise CryptError("crypto_box_afternm failed")
+    return out.raw[crypto_box_BOXZEROBYTES:]
+
+
+def crypto_box_open_afternm(ctxt: bytes, nonce: bytes, k: bytes) -> bytes:
+    padded = b"\x00" * crypto_box_BOXZEROBYTES + ctxt
+    out = ctypes.create_string_buffer(len(padded))
+    if _lib.crypto_box_open_afternm(out, padded,
+                                    ctypes.c_ulonglong(len(padded)),
+                                    nonce, k):
+        raise CryptError("crypto_box_open_afternm failed")
+    return out.raw[crypto_box_ZEROBYTES:]
+
+
+# the real libnacl exposes the raw CDLL as `libnacl.nacl`
+nacl = _lib
+
+
+def crypto_secretbox(msg: bytes, nonce: bytes, key: bytes) -> bytes:
+    padded = b"\x00" * crypto_secretbox_ZEROBYTES + msg
+    out = ctypes.create_string_buffer(len(padded))
+    if _lib.crypto_secretbox(out, padded, ctypes.c_ulonglong(len(padded)),
+                             nonce, key):
+        raise CryptError("secretbox failed")
+    return out.raw[crypto_secretbox_BOXZEROBYTES:]
+
+
+def crypto_secretbox_open(ctxt: bytes, nonce: bytes, key: bytes) -> bytes:
+    padded = b"\x00" * crypto_secretbox_BOXZEROBYTES + ctxt
+    out = ctypes.create_string_buffer(len(padded))
+    if _lib.crypto_secretbox_open(out, padded,
+                                  ctypes.c_ulonglong(len(padded)),
+                                  nonce, key):
+        raise CryptError("secretbox open failed")
+    return out.raw[crypto_secretbox_ZEROBYTES:]

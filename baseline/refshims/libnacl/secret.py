@@ -1,0 +1,35 @@
+import ctypes
+
+from . import (_lib, CryptError, randombytes, crypto_secretbox_KEYBYTES,
+               crypto_secretbox_NONCEBYTES, crypto_secretbox_ZEROBYTES,
+               crypto_secretbox_BOXZEROBYTES)
+
+
+class SecretBox:
+    def __init__(self, key: bytes = None):
+        self.sk = key if key is not None \
+            else randombytes(crypto_secretbox_KEYBYTES)
+
+    def encrypt(self, msg: bytes, nonce: bytes = None, pack_nonce=True):
+        if nonce is None:
+            nonce = randombytes(crypto_secretbox_NONCEBYTES)
+        padded = b"\x00" * crypto_secretbox_ZEROBYTES + msg
+        out = ctypes.create_string_buffer(len(padded))
+        if _lib.crypto_secretbox(out, padded,
+                                 ctypes.c_ulonglong(len(padded)),
+                                 nonce, self.sk):
+            raise CryptError("secretbox failed")
+        ctxt = out.raw[crypto_secretbox_BOXZEROBYTES:]
+        return nonce + ctxt if pack_nonce else (nonce, ctxt)
+
+    def decrypt(self, ctxt: bytes, nonce: bytes = None):
+        if nonce is None:
+            nonce, ctxt = ctxt[:crypto_secretbox_NONCEBYTES], \
+                ctxt[crypto_secretbox_NONCEBYTES:]
+        padded = b"\x00" * crypto_secretbox_BOXZEROBYTES + ctxt
+        out = ctypes.create_string_buffer(len(padded))
+        if _lib.crypto_secretbox_open(out, padded,
+                                      ctypes.c_ulonglong(len(padded)),
+                                      nonce, self.sk):
+            raise CryptError("secretbox open failed")
+        return out.raw[crypto_secretbox_ZEROBYTES:]

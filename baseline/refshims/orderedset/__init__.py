@@ -1,0 +1,1 @@
+from ._orderedset import OrderedSet  # noqa: F401

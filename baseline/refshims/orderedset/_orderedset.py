@@ -1,0 +1,69 @@
+"""OrderedSet shim: insertion-ordered set over a dict (py3.7+ dicts are
+ordered). API subset the reference uses: add/discard/remove/membership/
+iteration/len/indexing."""
+
+
+class OrderedSet:
+    def __init__(self, iterable=()):
+        self._d = dict.fromkeys(iterable)
+
+    def add(self, x):
+        self._d[x] = None
+
+    def discard(self, x):
+        self._d.pop(x, None)
+
+    def remove(self, x):
+        del self._d[x]
+
+    def pop(self, index=-1):
+        keys = list(self._d)
+        k = keys[index]
+        del self._d[k]
+        return k
+
+    def clear(self):
+        self._d.clear()
+
+    def update(self, it):
+        for x in it:
+            self.add(x)
+
+    def __contains__(self, x):
+        return x in self._d
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __len__(self):
+        return len(self._d)
+
+    def __bool__(self):
+        return bool(self._d)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return list(self._d)[i]
+        return list(self._d)[i]
+
+    def __repr__(self):
+        return f"OrderedSet({list(self._d)!r})"
+
+    def __eq__(self, other):
+        if isinstance(other, OrderedSet):
+            return list(self._d) == list(other._d)
+        if isinstance(other, (set, frozenset)):
+            return set(self._d) == other
+        return NotImplemented
+
+    def __or__(self, other):
+        out = OrderedSet(self)
+        out.update(other)
+        return out
+
+    def __sub__(self, other):
+        return OrderedSet(x for x in self if x not in set(other))
+
+    def __and__(self, other):
+        o = set(other)
+        return OrderedSet(x for x in self if x in o)

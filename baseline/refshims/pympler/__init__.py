@@ -1,0 +1,2 @@
+"""pympler stub: memory diagnostics for validator_info only."""
+from . import muppy, summary, asizeof  # noqa: F401

@@ -1,0 +1,2 @@
+def asizeof(obj, **kw):
+    return 0

@@ -1,0 +1,6 @@
+def summarize(objects):
+    return []
+
+
+def format_(rows, **kw):
+    return iter(())

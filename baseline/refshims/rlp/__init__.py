@@ -1,0 +1,68 @@
+"""Minimal RLP codec shim (API subset of rlp==0.5.x used by the reference
+state trie: encode/decode over nested lists of bytes + the three sedes
+helpers). Standard Ethereum-wire RLP."""
+from . import sedes  # noqa: F401
+
+
+class DecodingError(Exception):
+    pass
+
+
+def encode(item) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        b = bytes(item)
+        if len(b) == 1 and b[0] < 0x80:
+            return b
+        return _len_prefix(len(b), 0x80) + b
+    if isinstance(item, str):
+        return encode(item.encode())
+    if isinstance(item, int):
+        return encode(sedes.big_endian_int.serialize(item))
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(encode(x) for x in item)
+        return _len_prefix(len(payload), 0xC0) + payload
+    raise TypeError(f"cannot RLP-encode {type(item)}")
+
+
+def _len_prefix(n: int, offset: int) -> bytes:
+    if n < 56:
+        return bytes([offset + n])
+    nb = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(nb)]) + nb
+
+
+def decode(data: bytes):
+    item, rest = _decode_one(bytes(data))
+    if rest:
+        raise DecodingError("trailing bytes")
+    return item
+
+
+def _decode_one(data: bytes):
+    if not data:
+        raise DecodingError("empty input")
+    b0 = data[0]
+    if b0 < 0x80:
+        return data[:1], data[1:]
+    if b0 < 0xB8:
+        n = b0 - 0x80
+        return data[1:1 + n], data[1 + n:]
+    if b0 < 0xC0:
+        ln = b0 - 0xB7
+        n = int.from_bytes(data[1:1 + ln], "big")
+        s = 1 + ln
+        return data[s:s + n], data[s + n:]
+    if b0 < 0xF8:
+        n = b0 - 0xC0
+        payload, rest = data[1:1 + n], data[1 + n:]
+    else:
+        ln = b0 - 0xF7
+        n = int.from_bytes(data[1:1 + ln], "big")
+        s = 1 + ln
+        payload, rest = data[s:s + n], data[s + n:]
+    items = []
+    while payload:
+        item, payload = _decode_one(payload)
+        items.append(item)
+    return items, rest
+from . import codec  # noqa

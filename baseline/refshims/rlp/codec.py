@@ -1,0 +1,1 @@
+from . import encode as encode_raw  # raw nested-bytes encoding == encode

@@ -1,0 +1,35 @@
+class BigEndianInt:
+    def __init__(self, length=None):
+        self.length = length
+
+    def serialize(self, x: int) -> bytes:
+        if x == 0:
+            b = b""
+        else:
+            b = x.to_bytes((x.bit_length() + 7) // 8, "big")
+        if self.length is not None:
+            b = b"\x00" * (self.length - len(b)) + b
+        return b
+
+    def deserialize(self, b: bytes) -> int:
+        return int.from_bytes(b, "big")
+
+
+big_endian_int = BigEndianInt()
+
+
+class Binary:
+    def __init__(self, min_length=0, max_length=None, allow_empty=False):
+        self.min_length = min_length
+        self.max_length = max_length
+        self.allow_empty = allow_empty
+
+    @classmethod
+    def fixed_length(cls, length, allow_empty=False):
+        return cls(length, length, allow_empty)
+
+    def serialize(self, b: bytes) -> bytes:
+        return bytes(b)
+
+    def deserialize(self, b: bytes) -> bytes:
+        return bytes(b)

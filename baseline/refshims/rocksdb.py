@@ -1,0 +1,171 @@
+"""Pure-python in-memory `rocksdb` shim for the baseline run.
+
+The real python-rocksdb wheel cannot be installed in this image (no pip).
+This shim keeps the whole store in a dict, so the REFERENCE POOL RUNS
+FASTER than it would with the real disk-backed rocksdb — the measured
+baseline is therefore an UPPER bound on reference throughput, which makes
+any speedup we claim over it conservative. API surface mirrors what
+storage/kv_store_rocksdb*.py touches: Options/DB/WriteBatch/iterators with
+seek + seek_for_prev + custom comparator."""
+import functools
+
+
+class IComparator:
+    def compare(self, a, b):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def name(self):  # pragma: no cover - interface
+        return b"Stub"
+
+
+class LRUCache:
+    def __init__(self, *a, **k):
+        pass
+
+
+class BlockBasedTableFactory:
+    def __init__(self, *a, **k):
+        pass
+
+
+class Options:
+    def __init__(self, **kw):
+        self.create_if_missing = kw.get("create_if_missing", False)
+        self.comparator = None
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    def __setattr__(self, k, v):        # accept any tuning knob silently
+        object.__setattr__(self, k, v)
+
+
+class WriteBatch:
+    def __init__(self):
+        self.ops = []
+
+    def put(self, k, v):
+        self.ops.append(("put", k, v))
+
+    def delete(self, k):
+        self.ops.append(("del", k, None))
+
+
+class _Iter:
+    """Sorted snapshot iterator with rocksdb seek semantics."""
+
+    def __init__(self, keys, data, mode):
+        self._keys = keys          # sorted list
+        self._data = data
+        self._mode = mode
+        self._pos = 0
+
+    def seek_to_first(self):
+        self._pos = 0
+
+    def seek_to_last(self):
+        self._pos = len(self._keys) - 1 if self._keys else 0
+
+    def seek(self, key):
+        import bisect
+        self._pos = bisect.bisect_left(self._keys, _SortKey(key, self._cmp))
+
+    def seek_for_prev(self, key):
+        import bisect
+        i = bisect.bisect_right(self._keys, _SortKey(key, self._cmp))
+        self._pos = max(i - 1, 0) if i > 0 else len(self._keys)
+
+    @property
+    def _cmp(self):
+        return self._keys.cmp if isinstance(self._keys, _KeyList) else None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._pos >= len(self._keys):
+            raise StopIteration
+        k = self._keys[self._pos].raw if self._cmp else self._keys[self._pos]
+        self._pos += 1
+        if self._mode == "keys":
+            return k
+        if self._mode == "values":
+            return self._data[k]
+        return k, self._data[k]
+
+
+class _SortKey:
+    __slots__ = ("raw", "cmp")
+
+    def __init__(self, raw, cmp):
+        self.raw = raw
+        self.cmp = cmp
+
+    def __lt__(self, other):
+        o = other.raw if isinstance(other, _SortKey) else other
+        if self.cmp is None:
+            return self.raw < o
+        return self.cmp(self.raw, o) < 0
+
+    def __eq__(self, other):
+        o = other.raw if isinstance(other, _SortKey) else other
+        if self.cmp is None:
+            return self.raw == o
+        return self.cmp(self.raw, o) == 0
+
+
+class _KeyList(list):
+    def __init__(self, it, cmp):
+        super().__init__(it)
+        self.cmp = cmp
+
+
+class DB:
+    _stores = {}        # path -> dict: reopening a path sees the same data
+
+    def __init__(self, path, opts, read_only=False):
+        import os
+        # the reference's reset() rmtrees the db path then reopens: a path
+        # that is gone from disk means "fresh store", so drop cached data
+        if not os.path.isdir(path):
+            DB._stores.pop(path, None)
+            os.makedirs(path, exist_ok=True)
+        self._data = DB._stores.setdefault(path, {})
+        comparator = getattr(opts, "comparator", None)
+        self._cmp = comparator.compare if comparator is not None else None
+
+    def put(self, k, v, sync=False):
+        self._data[bytes(k)] = bytes(v)
+
+    def get(self, k):
+        return self._data.get(bytes(k))
+
+    def delete(self, k):
+        self._data.pop(bytes(k), None)
+
+    def write(self, batch: WriteBatch, sync=False):
+        for op, k, v in batch.ops:
+            if op == "put":
+                self.put(k, v)
+            else:
+                self.delete(k)
+
+    def key_may_exist(self, k):
+        return (bytes(k) in self._data,)
+
+    def _sorted_keys(self):
+        if self._cmp is None:
+            keys = sorted(self._data)
+            return keys
+        return _KeyList(
+            (_SortKey(k, self._cmp) for k in
+             sorted(self._data, key=functools.cmp_to_key(self._cmp))),
+            self._cmp)
+
+    def iterkeys(self):
+        return _Iter(self._sorted_keys(), self._data, "keys")
+
+    def itervalues(self):
+        return _Iter(self._sorted_keys(), self._data, "values")
+
+    def iteritems(self):
+        return _Iter(self._sorted_keys(), self._data, "items")

@@ -1,0 +1,42 @@
+"""ujson shim over stdlib json. The one behavioral difference that matters:
+ujson serializes ANY Mapping (plenum MessageBase implements the Mapping ABC
+and rides this), stdlib json only serializes dict — so `default` converts
+Mappings/sets/bytes the way ujson would. Being pure-python this is SLOWER
+than the real C ujson, i.e. it biases the measured reference DOWN slightly
+on wire serialization; noted in BASELINE.md."""
+import json as _json
+from collections.abc import Mapping
+
+
+def _default(o):
+    # ujson's C encoder falls back to the object's __dict__ — plenum's
+    # MessageBase builds a custom __dict__ property (fields + op name)
+    # specifically to ride that behavior (message_base.py:137)
+    d = getattr(o, "__dict__", None)
+    if isinstance(d, Mapping):
+        return dict(d)
+    if isinstance(o, Mapping):
+        return dict(o)
+    if isinstance(o, (set, frozenset, tuple)):
+        return list(o)
+    if isinstance(o, bytes):
+        return o.decode("utf-8")
+    raise TypeError(f"not serializable: {type(o)}")
+
+
+def dumps(obj, **kw):
+    if isinstance(obj, Mapping) and not isinstance(obj, dict):
+        obj = dict(obj)
+    return _json.dumps(obj, default=_default)
+
+
+def loads(s, **kw):
+    return _json.loads(s)
+
+
+def dump(obj, fp, **kw):
+    fp.write(dumps(obj))
+
+
+def load(fp, **kw):
+    return loads(fp.read())

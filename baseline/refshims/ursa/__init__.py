@@ -1,0 +1,3 @@
+"""Stub of the ursa (indy-crypto) BLS bindings: enough to IMPORT the
+reference's BLS factory. The baseline pool runs with no blskeys in genesis,
+so none of these ever execute; any real call raises loudly."""

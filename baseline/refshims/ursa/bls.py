@@ -1,0 +1,62 @@
+"""Stub ursa BLS entities: opaque byte holders so node startup (which
+builds a verifier from the group generator string) succeeds. The baseline
+genesis contains NO blskeys, so sign/verify never execute; if they ever
+do, they raise loudly instead of faking crypto."""
+
+
+class BlsEntity:
+    def __init__(self, data: bytes = b""):
+        self._data = bytes(data)
+
+    @classmethod
+    def from_bytes(cls, b: bytes):
+        return cls(b)
+
+    def as_bytes(self) -> bytes:
+        return self._data
+
+    @classmethod
+    def new(cls, *a, **k):
+        raise NotImplementedError("ursa stub: BLS keygen disabled in baseline")
+
+
+class Generator(BlsEntity):
+    pass
+
+
+class VerKey(BlsEntity):
+    pass
+
+
+class SignKey(BlsEntity):
+    pass
+
+
+class Signature(BlsEntity):
+    pass
+
+
+class MultiSignature(BlsEntity):
+    pass
+
+
+class ProofOfPossession(BlsEntity):
+    pass
+
+
+class Bls:
+    @staticmethod
+    def sign(*a, **k):
+        raise NotImplementedError("ursa stub: BLS signing disabled in baseline")
+
+    @staticmethod
+    def verify(*a, **k):
+        raise NotImplementedError("ursa stub: BLS verify disabled in baseline")
+
+    @staticmethod
+    def verify_multi_sig(*a, **k):
+        raise NotImplementedError("ursa stub: BLS verify disabled in baseline")
+
+    @staticmethod
+    def verify_pop(*a, **k):
+        raise NotImplementedError("ursa stub: BLS PoP disabled in baseline")

@@ -1,0 +1,2 @@
+class IndyCryptoError(Exception):
+    pass

@@ -1,0 +1,281 @@
+"""Measure the REFERENCE (indy-plenum) 4-node pool on this host.
+
+Stands up 4 real `plenum.server.node.Node`s — real ZMQ/CurveZMQ stacks on
+localhost ports, production config defaults — drives signed NYM writes from
+a real ZMQ client connection, and reports TPS + latency percentiles.
+
+Environment notes (see baseline/refshims/*):
+- missing C-extension deps are shimmed; libnacl is a ctypes binding over the
+  SYSTEM libsodium (the same library the real libnacl wraps), so all
+  signing/verification cost is authentic;
+- rocksdb is an in-memory pure-python stand-in, which makes the reference
+  FASTER than with the real disk-backed store (conservative for any speedup
+  we claim over this number);
+- genesis carries no BLS keys (ursa is unavailable), so the reference runs
+  without BLS commit signatures — again a cost REMOVED from the reference,
+  biasing the baseline fast.
+
+Usage: python baseline/run_reference_pool.py [--txns 200] [--window 30]
+Prints one JSON line: {"ref_tps": ..., "ref_p50_ms": ..., ...}
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import compat_boot
+
+compat_boot.add_paths()
+
+import logging  # noqa: E402
+
+logging.disable(logging.WARNING)       # the reference logs heavily at INFO
+
+from stp_core.common.log import Logger  # noqa: E402
+
+Logger().enableStdLogging()
+
+from plenum.common.config_util import getConfig  # noqa: E402
+import plenum.server.general_config.ubuntu_platform_config as platform_config  # noqa: E402
+import plenum.config as plenum_config  # noqa: E402
+from plenum.common.config_helper import PConfigHelper, PNodeConfigHelper  # noqa: E402
+from plenum.common.constants import TRUSTEE, STEWARD, TXN_TYPE, TARGET_NYM, \
+    VERKEY, CURRENT_PROTOCOL_VERSION, NYM  # noqa: E402
+from plenum.common.keygen_utils import initNodeKeysForBothStacks  # noqa: E402
+from plenum.common.member.member import Member  # noqa: E402
+from plenum.common.member.steward import Steward  # noqa: E402
+from plenum.common.signer_did import DidSigner  # noqa: E402
+from plenum.common.test_network_setup import TestNetworkSetup  # noqa: E402
+from plenum.common.txn_util import get_seq_no  # noqa: E402
+from plenum.server.node import Node  # noqa: E402
+from stp_core.loop.looper import Looper  # noqa: E402
+from stp_core.types import HA  # noqa: E402
+from stp_zmq.simple_zstack import SimpleZStack  # noqa: E402
+from stp_zmq.zstack import ZStack  # noqa: E402
+
+
+def build_pool_dirs(base, n_nodes, starting_port):
+    config = getConfig(os.path.join(base, "general"))
+    config.NETWORK_NAME = "sandbox"
+    config_helper = PConfigHelper(config, chroot=base)
+    os.makedirs(config_helper.genesis_dir, exist_ok=True)
+    genesis_dir = config_helper.genesis_dir
+    keys_dir = config_helper.keys_dir
+
+    pool_ledger = TestNetworkSetup.init_pool_ledger(False, genesis_dir, config)
+    from plenum.common.txn_util import getTxnOrderedFields
+    domain_ledger = TestNetworkSetup.init_domain_ledger(
+        False, genesis_dir, config, getTxnOrderedFields())
+
+    trustee_def = TestNetworkSetup.gen_trustee_def(1)
+    steward_defs, node_defs = TestNetworkSetup.gen_defs(
+        None, n_nodes, starting_port)
+
+    seq_no = 1
+    domain_ledger.add(Member.nym_txn(
+        trustee_def.nym, verkey=trustee_def.verkey, role=TRUSTEE,
+        seq_no=seq_no))
+    for sd in steward_defs:
+        seq_no += 1
+        domain_ledger.add(Member.nym_txn(
+            sd.nym, verkey=sd.verkey, role=STEWARD, creator=trustee_def.nym,
+            seq_no=seq_no))
+
+    seq_no = 0
+    for nd in node_defs:
+        # use_bls=False: ursa is stubbed; genesis carries no blskeys and the
+        # nodes run without BLS commit signatures (cost removed from the
+        # reference -> conservative baseline)
+        _, verkey, _, _ = initNodeKeysForBothStacks(
+            nd.name, keys_dir, nd.sigseed, use_bls=False, override=True)
+        node_nym = TestNetworkSetup.getNymFromVerkey(verkey.encode())
+        seq_no += 1
+        pool_ledger.add(Steward.node_txn(
+            nd.steward_nym, nd.name, node_nym, nd.ip, nd.port,
+            nd.client_port, blskey=None, bls_key_proof=None, seq_no=seq_no))
+    pool_ledger.stop()
+    domain_ledger.stop()
+    return config, steward_defs, node_defs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--txns", type=int, default=200)
+    ap.add_argument("--window", type=int, default=30)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--port", type=int, default=9700)
+    ap.add_argument("--batch-wait", type=float, default=None,
+                    help="override Max3PCBatchWait (reference default: 3s); "
+                         "use 0.05 for apples-to-apples with plenum_tpu's "
+                         "bench config")
+    args = ap.parse_args(argv)
+
+    base = tempfile.mkdtemp(prefix="ref_pool_")
+    os.makedirs(os.path.join(base, "general"), exist_ok=True)
+    shutil.copy(platform_config.__file__,
+                os.path.join(base, "general",
+                             plenum_config.GENERAL_CONFIG_FILE))
+    try:
+        run(base, args)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def run(base, args):
+    config, steward_defs, node_defs = build_pool_dirs(
+        base, args.nodes, args.port)
+    if args.batch_wait is not None:
+        config.Max3PCBatchWait = args.batch_wait
+
+    nodes = []
+    with Looper(debug=False) as looper:
+        for nd in node_defs:
+            config_helper = PNodeConfigHelper(nd.name, config, chroot=base)
+            node = Node(nd.name, config_helper=config_helper, config=config,
+                        ha=HA("127.0.0.1", nd.port),
+                        cliha=HA("127.0.0.1", nd.client_port))
+            looper.add(node)
+            nodes.append(node)
+
+        t0 = time.perf_counter()
+        deadline = t0 + 120.0
+        while time.perf_counter() < deadline:
+            looper.runFor(0.5)
+            if all(len(n.nodestack.connecteds) == args.nodes - 1
+                   for n in nodes) and \
+               all(n.isParticipating for n in nodes):
+                break
+        else:
+            raise RuntimeError(
+                "pool never became ready: connecteds="
+                f"{[len(n.nodestack.connecteds) for n in nodes]} "
+                f"participating={[n.isParticipating for n in nodes]}")
+        print(f"# pool ready in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+
+        # --- real ZMQ client -------------------------------------------
+        replies = {}                  # reqId -> t_first_reply
+        acks = set()
+        rx_count = [0]
+
+        def on_msg(wrapped):
+            msg, frm = wrapped
+            rx_count[0] += 1
+            if not isinstance(msg, dict):
+                return
+            op = msg.get("op")
+            if op == "REPLY":
+                rid = msg.get("result", {}).get("txn", {}) \
+                         .get("metadata", {}).get("reqId") \
+                    or msg.get("result", {}).get("reqId")
+                if rid is not None and rid not in replies:
+                    replies[rid] = time.perf_counter()
+            elif op == "REQACK":
+                acks.add(msg.get("reqId"))
+
+        from stp_core.network.auth_mode import AuthMode
+        cli_dir = os.path.join(base, "cli_keys")
+        os.makedirs(cli_dir, exist_ok=True)
+        cli = SimpleZStack({"name": "BenchClient", "ha": HA("0.0.0.0", 0),
+                            "basedirpath": cli_dir,
+                            "auth_mode": AuthMode.ALLOW_ANY.value},
+                           msgHandler=on_msg,
+                           seed=b"baseline-bench-client-seed-0001\0"[:32])
+
+        class ClientProdable:
+            """stp Looper drives Prodables; SimpleZStack itself only has
+            start/service, so adapt it."""
+            name = "BenchClientProdable"
+
+            def start(self, loop):
+                cli.start()
+
+            async def prod(self, limit=None):
+                return await cli.service(limit)
+
+            def stop(self):
+                cli.stop()
+
+        looper.add(ClientProdable())
+        from zmq.utils import z85
+        target = node_defs[0]
+        target_cname = target.name + "C"
+        keys_dir = PConfigHelper(config, chroot=base).keys_dir
+        home = ZStack.homeDirPath(keys_dir, target_cname)
+        pub = ZStack.loadPubKeyFromDisk(ZStack.publicDirPath(home),
+                                        target_cname)
+        ver = ZStack.loadPubKeyFromDisk(ZStack.verifDirPath(home),
+                                        target_cname)
+        cli.connect(name=target_cname,
+                    ha=HA("127.0.0.1", target.client_port),
+                    publicKeyRaw=z85.decode(pub),
+                    verKeyRaw=z85.decode(ver))
+        looper.runFor(1.0)      # let the CURVE handshake settle
+
+        steward = DidSigner(seed=steward_defs[0].sigseed)
+        submit_times = {}
+
+        def make_req(i):
+            dest = DidSigner(seed=(b"baseline-user-%06d" % i).ljust(32, b"0"))
+            msg = {
+                "identifier": steward.identifier,
+                "reqId": 1_000_000 + i,
+                "protocolVersion": CURRENT_PROTOCOL_VERSION,
+                "operation": {TXN_TYPE: NYM,
+                              TARGET_NYM: dest.identifier,
+                              VERKEY: dest.verkey},
+            }
+            msg["signature"] = steward.sign(msg)
+            return msg
+
+        reqs = [make_req(i) for i in range(args.txns)]
+        by_id = {r["reqId"]: r for r in reqs}
+        bench_t0 = time.perf_counter()
+        deadline = bench_t0 + args.timeout
+        last_resend = bench_t0
+        i = 0
+        while len(replies) < args.txns and time.perf_counter() < deadline:
+            while i < len(reqs) and i - len(replies) < args.window:
+                submit_times[reqs[i]["reqId"]] = time.perf_counter()
+                cli.send(reqs[i], target_cname)
+                i += 1
+            now = time.perf_counter()
+            if now - last_resend > 3.0:
+                # sends into a half-open CURVE session and REPLYs on a
+                # congested listener can both be silently dropped; re-send
+                # every unreplied request (nodes dedup by digest and answer
+                # executed requests straight from the seq-no store)
+                last_resend = now
+                for rid in list(by_id):
+                    if rid in submit_times and rid not in replies:
+                        cli.send(by_id[rid], target_cname)
+            looper.runFor(0.05)
+        bench_t1 = time.perf_counter()
+
+        done = sorted(replies)
+        lats = sorted((replies[r] - submit_times[r]) * 1000.0 for r in done)
+        n = len(done)
+        out = {
+            "ref_tps": round(n / (bench_t1 - bench_t0), 1) if n else 0.0,
+            "ref_p50_ms": round(lats[n // 2], 1) if n else None,
+            "ref_p99_ms": round(lats[int(n * 0.99)], 1) if n else None,
+            "completed": n,
+            "submitted": i,
+            "wall_s": round(bench_t1 - bench_t0, 2),
+            "nodes": args.nodes,
+            "batch_wait": config.Max3PCBatchWait,
+            "window": args.window,
+            "note": "in-memory rocksdb shim + no BLS: reference favored",
+        }
+        # sanity: every node ordered the same ledger length
+        sizes = {nd.domainLedger.size for nd in nodes}
+        out["domain_ledger_sizes"] = sorted(sizes)
+        print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

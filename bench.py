@@ -1,10 +1,11 @@
 """Benchmark: the north-star metric — 4-node pool write throughput.
 
 BASELINE.json defines the metric as "write txns/sec at f=1 (4-node pool);
-p50 commit latency", with the reference publishing no numbers, so the CPU
-backend of this framework — the same per-request scalar Ed25519 work the
-reference does via libsodium, plus the same RBFT pipeline — is the measured
-baseline denominator (BASELINE.md). Both backends run the REAL pipeline:
+p50 commit latency". The denominator is the MEASURED reference pool on this
+host: 74 TPS peak (64.7 sustained) at window 100 / Max3PCBatchWait=0.05 —
+see baseline/run_reference_pool.py and BASELINE.md "Measured on this host".
+That measurement favors the reference (in-memory storage shim, no BLS),
+so every vs_baseline here is conservative. Both backends run the REAL pipeline:
 client authN -> propagate quorum -> 3PC with BLS signing + order-time
 aggregate verification -> execute -> REPLY, over real wall-clock time
 (plenum_tpu/tools/local_pool.py).
@@ -74,19 +75,26 @@ def main():
     tcp = _run_tcp_pool()
     jax_stats = _run_jax_pool_subprocess()
 
-    cpu_tps = cpu["tps"] or 1e-9
+    REF_TPS = 74.0      # measured reference peak on this host (BASELINE.md)
     jax_ok = "tps" in jax_stats
+    # headline: the real-transport figure when the jax plane is unavailable
+    # (VERDICT r2: the TCP pool is the honest CPU baseline; the in-process
+    # number double-counts one process's parallelism)
+    tcp_ok = bool(tcp and tcp.get("txns_ordered"))
+    value = jax_stats["tps"] if jax_ok else (
+        tcp["tps"] if tcp_ok else cpu["tps"])
     result = {
         "metric": "pool_write_tps_4node",
-        "value": jax_stats["tps"] if jax_ok else cpu["tps"],
+        "value": value,
         "unit": "txns/s",
-        "vs_baseline": round(jax_stats["tps"] / cpu_tps, 3) if jax_ok
-        else 1.0,
+        "vs_baseline": round(value / REF_TPS, 3),
+        "ref_tps": REF_TPS,
         "cpu_tps": cpu["tps"],
         "cpu_p50_ms": cpu["p50_latency_ms"],
     }
-    if tcp and tcp.get("txns_ordered"):
+    if tcp_ok:
         result["tcp_tps"] = tcp["tps"]          # 4 OS processes, real TCP
+        result["tcp_p50_ms"] = tcp.get("p50_latency_ms")
     if jax_ok:
         result.update({
             "jax_p50_ms": jax_stats["p50_latency_ms"],

@@ -189,7 +189,6 @@ class JaxEd25519Verifier(Ed25519Verifier):
         return ((_ops.P - x) % _ops.P, y)
 
     def _dispatch(self, items: Sequence[VerifyItem]):
-        import jax.numpy as jnp
         n = len(items)
         verdict = np.zeros(n, dtype=bool)
         if n == 0:
@@ -236,10 +235,17 @@ class JaxEd25519Verifier(Ed25519Verifier):
             for q in range(_ops.N_QUARTERS)], axis=1)   # [N_WIN, 4, m]
         aq = np.stack(a_rows)                           # [m, 4, 4, NLIMB]
         ry, r_sign = _ops.r_bytes_to_limbs(r_enc)
-        ok = _ops.verify_kernel(
+        ok = self._device_verify(s_digits, h_digits, aq, ry, r_sign)
+        return _JaxToken(ok, idxs, n)
+
+    def _device_verify(self, s_digits, h_digits, aq, ry, r_sign):
+        """Staged host arrays -> flat verdict array on device. Subclasses
+        re-route the dispatch (ShardedJaxEd25519Verifier shards it over a
+        mesh); the host staging above is identical either way."""
+        import jax.numpy as jnp
+        return _ops.verify_kernel(
             jnp.asarray(s_digits), jnp.asarray(h_digits), jnp.asarray(aq),
             jnp.asarray(ry), jnp.asarray(r_sign))
-        return _JaxToken(ok, idxs, n)
 
     # verify_batch = submit + blocking collect; submit_batch returns right
     # after the (asynchronous) device dispatch
@@ -357,4 +363,8 @@ def make_verifier(backend: str, min_batch: int = 1) -> Ed25519Verifier:
     minutes on a tunneled TPU and starve the prod loop."""
     if backend == "jax":
         return JaxEd25519Verifier(min_batch=min_batch)
+    if backend == "jax-sharded":
+        # deferred: parallel/ pulls in jax.sharding + the SPMD plane
+        from plenum_tpu.parallel.crypto_plane import make_sharded_verifier
+        return make_sharded_verifier(min_batch=min_batch)
     return CpuEd25519Verifier()

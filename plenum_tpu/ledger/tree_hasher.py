@@ -73,6 +73,6 @@ class JaxTreeHasher(TreeHasher):
 
 
 def make_tree_hasher(backend: str) -> TreeHasher:
-    if backend == "jax":
+    if backend in ("jax", "jax-sharded"):
         return JaxTreeHasher()
     return TreeHasher()

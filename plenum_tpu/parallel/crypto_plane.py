@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from plenum_tpu.crypto.ed25519 import JaxEd25519Verifier
 from plenum_tpu.ops import ed25519 as ed_ops
 from plenum_tpu.ops import sha256 as sha_ops
 
@@ -103,3 +104,63 @@ class ShardedCryptoPlane:
         host discards if it padded).
         """
         return self._step(s_dig, h_dig, aq, ry, r_sign, leaves)
+
+
+class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
+    """JaxEd25519Verifier whose device program is the SPMD crypto plane:
+    identical host staging (decompression cache, scalar windows, padding),
+    but the dispatch shards the signature grid over the plane's mesh, so
+    every pool node's traffic runs as a multi-chip program. This is the
+    production seam for `crypto_backend="jax-sharded"` — the
+    CoalescingVerifier wraps it unchanged and node traffic flows through
+    `ShardedCryptoPlane.step` (SURVEY.md §2.3 distributed-comm row)."""
+
+    def __init__(self, plane: ShardedCryptoPlane, min_batch: int = 1,
+                 cache_size: int = 65536):
+        inst = plane.mesh.shape["inst"]
+        sig = plane.mesh.shape["sig"]
+        if inst & (inst - 1) or sig & (sig - 1):
+            raise ValueError(
+                f"mesh axes must be powers of two for the pow2-padded "
+                f"dispatch to tile exactly, got inst={inst} sig={sig}")
+        # every dispatch must fill the grid: at least one lane per shard
+        super().__init__(min_batch=max(min_batch, inst * sig),
+                         cache_size=cache_size)
+        self._plane = plane
+        self._grid = (inst, sig)
+        self.dispatches = 0          # observability for tests/metrics
+
+    def _device_verify(self, s_digits, h_digits, aq, ry, r_sign):
+        import jax.numpy as jnp
+        inst, sig = self._grid
+        m = s_digits.shape[1]        # pow2 >= inst*sig, so inst | m and
+        n = m // inst                # sig | n: the grid tiles exactly
+        # the plane fuses a Merkle reduction; this path only needs verdicts,
+        # so feed one zero leaf per shard and drop the root
+        leaves = jnp.zeros((inst * sig, 8), jnp.uint32)
+        ok, _root, _n_ok = self._plane.step(
+            jnp.asarray(s_digits).reshape(ed_ops.N_COMB, inst, n),
+            jnp.asarray(h_digits).reshape(
+                ed_ops.N_WIN, ed_ops.N_QUARTERS, inst, n),
+            jnp.asarray(aq).reshape(inst, n, 4, 4, ed_ops.NLIMB),
+            jnp.asarray(ry).reshape(inst, n, -1),
+            jnp.asarray(r_sign).reshape(inst, n),
+            leaves)
+        self.dispatches += 1
+        return ok.reshape(m)
+
+
+def make_sharded_verifier(min_batch: int = 1,
+                          n_devices=None) -> ShardedJaxEd25519Verifier:
+    """Plane + verifier over the local devices. The dispatch tiles pow2
+    batches, so a non-pow2 device count (e.g. 6) is trimmed to its largest
+    pow2 subset rather than failing construction."""
+    import jax
+
+    from .mesh import make_mesh
+    avail = len(jax.devices()) if n_devices is None else n_devices
+    pow2 = 1
+    while pow2 * 2 <= avail:
+        pow2 *= 2
+    plane = ShardedCryptoPlane(make_mesh(pow2))
+    return ShardedJaxEd25519Verifier(plane, min_batch=min_batch)

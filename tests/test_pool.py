@@ -54,11 +54,12 @@ def make_genesis(names, validator_names=None):
 
 class Pool:
     def __init__(self, names=NODES, seed=42, config=None, data_dir=None,
-                 validator_names=None):
+                 validator_names=None, verifier=None):
         self.names = list(names)
         self.timer = MockTimer()
         self.net = SimNetwork(self.timer, SimRandom(seed))
         self.config = config or Config(Max3PCBatchWait=0.05)
+        self.verifier = verifier          # shared crypto plane (co-hosted)
         self.data_dir = data_dir          # per-node durable storage root
         self.genesis, self.trustee = make_genesis(self.names, validator_names)
         self.client_msgs: dict[str, list] = {n: [] for n in self.names}
@@ -79,7 +80,8 @@ class Pool:
             name, genesis_txns=self.genesis,
             data_dir=self._node_data_dir(name),
             crypto_backend=self.config.crypto_backend,
-            storage_backend=self.config.kv_backend).build()
+            storage_backend=self.config.kv_backend,
+            verifier=self.verifier).build()
         self.nodes[name] = Node(
             name, self.timer, bus, components,
             client_send=lambda msg, client, n=name:
@@ -246,6 +248,52 @@ def test_pool_jax_backend_end_to_end():
     pool.run(8.0)     # > MAX_AUTH_POLLS prods so the pipelined collect blocks
     from plenum_tpu.common.node_messages import RequestNack
     assert pool.replies("Alpha", RequestNack)
+
+
+def test_pool_sharded_crypto_plane_end_to_end():
+    """REAL node traffic through the multi-chip plane: a 4-node pool shares
+    one CoalescingVerifier whose device program is ShardedCryptoPlane over
+    the suite's 8 virtual CPU devices (2x4 'inst'x'sig' mesh) — the same
+    SPMD program dryrun_multichip compiles, now fed by client authN instead
+    of synthetic batches (SURVEY.md §2.3 distributed-comm row)."""
+    from plenum_tpu.crypto.ed25519 import CoalescingVerifier
+    from plenum_tpu.parallel.crypto_plane import make_sharded_verifier
+
+    sharded = make_sharded_verifier(min_batch=8)
+    shared = CoalescingVerifier(sharded)
+    pool = Pool(config=Config(Max3PCBatchWait=0.05,
+                              crypto_backend="jax-sharded"),
+                verifier=shared)
+    # every node's authenticator feeds the ONE shared plane
+    for n in pool.names:
+        assert pool.nodes[n].c.authenticator.core_authenticator.verifier \
+            is shared
+
+    user = Ed25519Signer(seed=b"sharded-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(10.0)
+    assert sharded.dispatches >= 1, "no traffic reached the sharded plane"
+    sizes = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.names}
+    assert sizes == {2}, sizes
+    roots = {pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+             for n in pool.names}
+    assert len(roots) == 1
+    assert pool.replies("Alpha")
+
+    # a WELL-FORMED wrong signature must be refused by the device verdict
+    # itself (a mangled-encoding sig would be host-rejected before
+    # dispatch and prove nothing about the plane)
+    imposter = Ed25519Signer(seed=b"sharded-imposter".ljust(32, b"\0"))
+    bad = signed_nym(pool.trustee, Ed25519Signer(
+        seed=b"sharded-bad".ljust(32, b"\0")), 2)
+    bad.signature = imposter.sign_b58(bad.signing_bytes())
+    before = sharded.dispatches
+    pool.submit(bad)
+    pool.run(8.0)
+    from plenum_tpu.common.node_messages import RequestNack
+    assert pool.replies("Alpha", RequestNack)
+    assert sharded.dispatches > before
 
 
 def test_endorsed_multi_sig_request_orders():
