@@ -58,12 +58,12 @@ def _run_jax_pool_subprocess():
     return {"error": (out.stderr or "no output").strip()[-300:]}
 
 
-def _run_tcp_pool():
+def _run_tcp_pool(n_nodes=4, n_txns=200):
     """Real-transport color for the bench line (guarded: a broken spawn
     environment must degrade to the in-process numbers, never fail)."""
     try:
         from plenum_tpu.tools.tcp_pool import run_tcp_pool
-        return run_tcp_pool(n_nodes=4, n_txns=200, timeout=90.0)
+        return run_tcp_pool(n_nodes=n_nodes, n_txns=n_txns, timeout=90.0)
     except Exception:
         return None
 
@@ -73,6 +73,7 @@ def main():
 
     cpu = run_load(n_nodes=4, n_txns=300, backend="cpu")
     tcp = _run_tcp_pool()
+    tcp7 = _run_tcp_pool(n_nodes=7, n_txns=100)   # f=2 scale datum
     jax_stats = _run_jax_pool_subprocess()
 
     REF_TPS = 74.0      # measured reference peak on this host (BASELINE.md)
@@ -95,6 +96,13 @@ def main():
     if tcp_ok:
         result["tcp_tps"] = tcp["tps"]          # 4 OS processes, real TCP
         result["tcp_p50_ms"] = tcp.get("p50_latency_ms")
+    if tcp7 and tcp7.get("txns_ordered") == 100:
+        # publish the f=2 scale datum only from a COMPLETE run — a partial
+        # (timed-out) window would silently misrepresent throughput
+        result["tcp7_tps"] = tcp7["tps"]        # 7 nodes / f=2, real TCP
+        result["tcp7_p50_ms"] = tcp7.get("p50_latency_ms")
+    elif tcp7 and tcp7.get("txns_ordered"):
+        result["tcp7_partial"] = tcp7["txns_ordered"]
     if jax_ok:
         result.update({
             "jax_p50_ms": jax_stats["p50_latency_ms"],

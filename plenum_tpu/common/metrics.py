@@ -40,6 +40,18 @@ class MetricsName:
     BACKUP_INSTANCE_REMOVED = "consensus.backup_instance_removed"
     CATCHUPS = "consensus.catchups"
     MASTER_3PC_BATCH_TIME = "consensus.master_3pc_batch_time"
+    # per-phase 3PC timings on the master (perf debugging: where does a
+    # batch spend its life — prepare quorum, commit quorum, or end to end)
+    PREPARE_PHASE_TIME = "consensus.prepare_phase_time"
+    COMMIT_PHASE_TIME = "consensus.commit_phase_time"
+    ORDERING_TIME = "consensus.ordering_time"
+    # queue depths sampled at each metrics flush
+    CLIENT_INBOX_DEPTH = "node.client_inbox_depth"
+    PROPAGATE_INBOX_DEPTH = "node.propagate_inbox_depth"
+    REQUEST_QUEUE_DEPTH = "consensus.request_queue_depth"
+    # shared crypto plane
+    SIG_BATCH_FILL_TIME = "crypto.sig_batch_fill_time"
+    SIG_DISPATCH_TIME = "crypto.sig_dispatch_time"
     # transport
     NODE_MSGS_IN = "transport.node_msgs_in"
     NODE_FRAMES_OUT = "transport.node_frames_out"

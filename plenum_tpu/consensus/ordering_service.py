@@ -19,6 +19,7 @@ from collections import OrderedDict
 from typing import Callable, Optional
 
 from plenum_tpu.common.event_bus import ExternalBus, InternalBus
+from plenum_tpu.common.metrics import MetricsName
 from plenum_tpu.common.internal_messages import (MissingMessage,
                                                  NewViewCheckpointsApplied,
                                                  RaisedSuspicion, ReqKey,
@@ -56,9 +57,14 @@ class OrderingService:
                  executor: Optional[BatchExecutor],
                  bls: Optional[BlsBftReplica] = None,
                  config: Optional[Config] = None,
-                 get_request: Optional[Callable[[str], Optional[Request]]] = None):
+                 get_request: Optional[Callable[[str], Optional[Request]]] = None,
+                 metrics=None):
         self._data = data
         self._timer = timer
+        # per-phase 3PC timing (ref metrics_collector.py's 3PC names):
+        # key -> (t_preprepare, t_prepared); emitted at quorum transitions
+        self._metrics = metrics
+        self._phase_ts: dict[tuple[int, int], list] = {}
         self._bus = bus
         self._network = network
         self._executor = executor
@@ -256,6 +262,8 @@ class OrderingService:
         key = (view_no, pp_seq_no)
         self.sent_preprepares[key] = pre_prepare
         self.prePrepares[key] = pre_prepare
+        if self._metrics is not None:
+            self._phase_ts[key] = [self._timer.get_current_time(), None]
         batch_id = BatchID(view_no, _orig_view(pre_prepare),
                            pp_seq_no, pre_prepare.digest)
         self._data.preprepare_batch(batch_id)
@@ -448,6 +456,8 @@ class OrderingService:
             batch_id = BatchID(msg.view_no, _orig_view(msg),
                                msg.pp_seq_no, msg.digest)
         self.prePrepares[key] = msg
+        if self._metrics is not None:
+            self._phase_ts[key] = [self._timer.get_current_time(), None]
         self._data.preprepare_batch(batch_id)
         # Commits that raced ahead of this pre-prepare: validate their BLS
         # sigs now that we know the signed roots; evict liars.
@@ -513,6 +523,11 @@ class OrderingService:
             return
         self._data.prepare_batch(BatchID(pp.view_no, _orig_view(pp),
                                          pp.pp_seq_no, pp.digest))
+        ts = self._phase_ts.get(key)
+        if ts is not None and ts[1] is None:
+            ts[1] = self._timer.get_current_time()
+            self._metrics.add_event(MetricsName.PREPARE_PHASE_TIME,
+                                    ts[1] - ts[0])
         self._send_commit(pp, key)
 
     def _send_commit(self, pp: PrePrepare, key: tuple[int, int]) -> None:
@@ -641,6 +656,13 @@ class OrderingService:
         return None
 
     def _order(self, key: tuple[int, int], pp: PrePrepare) -> None:
+        ts = self._phase_ts.pop(key, None)
+        if ts is not None and self._metrics is not None:
+            now = self._timer.get_current_time()
+            if ts[1] is not None:
+                self._metrics.add_event(MetricsName.COMMIT_PHASE_TIME,
+                                        now - ts[1])
+            self._metrics.add_event(MetricsName.ORDERING_TIME, now - ts[0])
         orig_key = (_orig_view(pp), pp.pp_seq_no)
         rerun = self._ordered_originals.get(orig_key) == pp.digest
         self.ordered.add(key)
@@ -735,6 +757,7 @@ class OrderingService:
     def process_view_change_started(self, msg: ViewChangeStarted) -> None:
         """Entering a view change: revert uncommitted work, remember old-view
         pre-prepares for possible re-ordering (ref :2380)."""
+        self._phase_ts.clear()      # timings don't span views
         self.revert_unordered_batches()
         # ALL pre-prepares (ordered ones too) become old-view material: a
         # NewView may cite an already-ordered batch, and both the re-sending
@@ -866,7 +889,7 @@ class OrderingService:
         """Drop 3PC log entries at or below a stabilized checkpoint."""
         seq = stable_3pc[1]
         for store in (self.prePrepares, self.sent_preprepares,
-                      self.prepares, self.commits):
+                      self.prepares, self.commits, self._phase_ts):
             for k in [k for k in store if k[1] <= seq]:
                 del store[k]
         # certificate lists follow the same lifetime as the 3PC logs

@@ -44,7 +44,8 @@ class Replica:
                  get_request: Optional[Callable[[str], Optional[Request]]] = None,
                  checkpoint_digest_provider=None,
                  instance_count: int = 1,
-                 external_internal_bus: Optional[InternalBus] = None):
+                 external_internal_bus: Optional[InternalBus] = None,
+                 metrics=None):
         self.name = replica_name(node_name, inst_id)
         self.inst_id = inst_id
         self.config = config or Config()
@@ -64,7 +65,7 @@ class Replica:
         self.ordering = OrderingService(
             data=self._data, timer=timer, bus=self.internal_bus,
             network=network, executor=executor, bls=bls, config=self.config,
-            get_request=get_request)
+            get_request=get_request, metrics=metrics)
         self.checkpointer = CheckpointService(
             data=self._data, bus=self.internal_bus, network=network,
             config=self.config,

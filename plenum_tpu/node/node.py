@@ -94,7 +94,13 @@ class Node:
         if isinstance(self.metrics, KvMetricsCollector):
             self._metrics_flush_timer = RepeatingTimer(
                 timer, self.config.METRICS_FLUSH_INTERVAL,
-                self.metrics.flush)
+                self._flush_metrics)
+        # shared crypto plane reports through the last-attached collector
+        # (fill latency, dispatch wall time, batch size)
+        verifier = getattr(components.authenticator.core_authenticator,
+                           "verifier", None)
+        if hasattr(verifier, "metrics"):
+            verifier.metrics = self.metrics
 
         self.pool_manager = components.pool_manager
         self.pool_manager._on_changed = self._on_pool_changed
@@ -249,6 +255,19 @@ class Node:
                                          pp_seq_no)
         self.spylog.append(("restored_from_audit", (view_no, pp_seq_no)))
 
+    def _flush_metrics(self) -> None:
+        """Sample queue depths, then flush accumulators to the KV store —
+        depth gauges ride the same cadence as every other metric."""
+        self.metrics.add_event(MetricsName.CLIENT_INBOX_DEPTH,
+                               len(self._client_inbox))
+        self.metrics.add_event(MetricsName.PROPAGATE_INBOX_DEPTH,
+                               len(self._propagate_inbox))
+        self.metrics.add_event(
+            MetricsName.REQUEST_QUEUE_DEPTH,
+            sum(len(q) for q in
+                self.master_replica.ordering.request_queues.values()))
+        self.metrics.flush()
+
     def check_performance(self) -> None:
         if self.leecher.is_running:
             return
@@ -391,7 +410,8 @@ class Node:
             get_request=self.propagator.requests.get_request,
             checkpoint_digest_provider=(
                 lambda seq: audit.uncommitted_root_hash.hex()),
-            instance_count=max(1, self.pool_manager.quorums.f + 1))
+            instance_count=max(1, self.pool_manager.quorums.f + 1),
+            metrics=self.metrics if inst_id == 0 else None)
         if bls is not None:
             bls.report_bad_signature = lambda sender, r=replica: \
                 r.internal_bus.send(RaisedSuspicion(
