@@ -32,6 +32,13 @@ class Config:
     LAMBDA: float = 240.0               # window for degradation checks
     OMEGA: float = 20.0                 # latency excess threshold
     PerfCheckFreq: float = 10.0
+
+    # --- notifier events (ref notifierEventTriggeringConfig
+    #     config.py:165-184 + SpikeEventsEnabled) ---
+    NOTIFIER_EVENTS_ENABLED: bool = True
+    NOTIFIER_SPIKE_BOUNDS_COEFF: float = 10.0
+    NOTIFIER_SPIKE_MIN_CNT: int = 15
+    NOTIFIER_SPIKE_MIN_ACTIVITY: float = 10.0
     throughput_averaging_strategy: str = "ema"
     throughput_first_ts_window: float = 15.0
 

@@ -53,6 +53,8 @@ from plenum_tpu.node.blacklister import Blacklister
 from plenum_tpu.node.bootstrap import NodeComponents
 from plenum_tpu.node.message_req_processor import MessageReqProcessor
 from plenum_tpu.node.monitor import Monitor
+from plenum_tpu.node.notifier import (NotifierEventManager,
+                                      TOPIC_VIEW_CHANGE)
 from plenum_tpu.node.observer import Observable
 from plenum_tpu.node.propagator import Propagator
 
@@ -205,6 +207,13 @@ class Node:
         # RBFT monitor: compare master vs backup instances, vote out a
         # degraded master (ref monitor.py:136, node.checkPerformance:2501)
         self.monitor = Monitor(self.config, now=timer.get_current_time)
+        # ops notifications: throughput spikes + view changes fan out to
+        # registered handlers (ref server/notifier_plugin_manager.py)
+        self.notifier = NotifierEventManager(
+            bounds_coeff=self.config.NOTIFIER_SPIKE_BOUNDS_COEFF,
+            min_cnt=self.config.NOTIFIER_SPIKE_MIN_CNT,
+            min_activity_threshold=self.config.NOTIFIER_SPIKE_MIN_ACTIVITY,
+            enabled=self.config.NOTIFIER_EVENTS_ENABLED)
         self._perf_check_timer = RepeatingTimer(
             timer, self.config.PerfCheckFreq, self.check_performance)
 
@@ -271,6 +280,9 @@ class Node:
     def check_performance(self) -> None:
         if self.leecher.is_running:
             return
+        self.notifier.check_throughput(
+            self.monitor.master_throughput(), self.name,
+            self.timer.get_current_time())
         if self.monitor.is_master_degraded():
             self.spylog.append(("master_degraded", self.monitor.stats()))
             self.replicas.master.internal_bus.send(
@@ -460,6 +472,10 @@ class Node:
             replica.adopt_new_view(msg.view_no, primaries)
         self.monitor.reset()
         self.metrics.add_event(MetricsName.VIEW_CHANGES)
+        self.notifier.send(TOPIC_VIEW_CHANGE, {
+            "node": self.name, "view_no": msg.view_no,
+            "primaries": primaries,
+            "time": self.timer.get_current_time()})
         self.spylog.append(("view_change_complete", msg.view_no))
 
     def _on_suspicion(self, msg: RaisedSuspicion) -> None:

@@ -355,3 +355,65 @@ def test_perf_metrics_emitted_during_ordering():
     info = node.validator_info()
     assert MetricsName.REQUEST_QUEUE_DEPTH in info["metrics"]
     assert MetricsName.ORDERING_TIME in info["metrics"]
+
+
+# --- notifier events ------------------------------------------------------
+
+def test_notifier_spike_detection_bounds():
+    """Spike math follows the reference's historical-bounds model
+    (notifier_plugin_manager.py:92-117): no emission until min_cnt history,
+    none below the activity floor, emission outside bounds_coeff x avg."""
+    from plenum_tpu.node.notifier import (NotifierEventManager, TOPIC_SPIKE)
+
+    events = []
+    n = NotifierEventManager(bounds_coeff=3.0, min_cnt=3,
+                             min_activity_threshold=5.0)
+    n.register_handler(lambda topic, msg: events.append((topic, msg)))
+    # building history: never spikes
+    for v in (10.0, 11.0, 9.0):
+        assert not n.check_throughput(v, "N1", 0.0)
+    # inside bounds
+    assert not n.check_throughput(12.0, "N1", 1.0)
+    # way outside bounds -> spike
+    assert n.check_throughput(200.0, "N1", 2.0)
+    assert events and events[-1][0] == TOPIC_SPIKE
+    assert events[-1][1]["value"] == 200.0
+    # below the noise floor nothing fires even if ratio is huge
+    quiet = NotifierEventManager(bounds_coeff=3.0, min_cnt=2,
+                                 min_activity_threshold=5.0)
+    quiet.register_handler(lambda t, m: events.append((t, m)))
+    for v in (0.1, 0.2, 0.1, 2.0):
+        assert not quiet.check_throughput(v, "N1", 0.0)
+    # a broken handler never breaks the send path
+    n._handlers.insert(0, lambda t, m: 1 / 0)
+    assert n.check_throughput(0.01, "N1", 3.0)
+
+
+def test_notifier_view_change_event_from_pool():
+    """A real view change emits TOPIC_VIEW_CHANGE through the node's
+    notifier (ref: viewChange notification wiring)."""
+    from plenum_tpu.config import Config
+    from plenum_tpu.node.notifier import TOPIC_VIEW_CHANGE
+    from plenum_tpu.network import Discard, match_dst, match_frm
+
+    pool = Pool(config=Config(Max3PCBatchWait=0.05,
+                              PRIMARY_HEALTH_CHECK_FREQ=0.5,
+                              ORDERING_PROGRESS_TIMEOUT=2.0,
+                              STATE_FRESHNESS_UPDATE_INTERVAL=3.0))
+    events = {n: [] for n in pool.names}
+    for name, node in pool.nodes.items():
+        node.notifier.register_handler(
+            lambda t, m, nm=name: events[nm].append((t, m)))
+    for rule in (match_dst("Alpha"), match_frm("Alpha")):
+        pool.net.add_rule(Discard(), rule)
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    user = Ed25519Signer(seed=b"notif-user".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, 1),
+                to=[n for n in pool.names if n != "Alpha"])
+    pool.run(20.0)
+    for name in pool.names:
+        if name == "Alpha":
+            continue
+        vc = [m for t, m in events[name] if t == TOPIC_VIEW_CHANGE]
+        assert vc, f"{name} emitted no view-change notification"
+        assert vc[-1]["view_no"] >= 1
