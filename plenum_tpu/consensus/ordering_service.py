@@ -735,13 +735,25 @@ class OrderingService:
         self._data.is_participating = False
 
     def caught_up_till_3pc(self, last_3pc: tuple[int, int]) -> None:
-        """Adopt the 3PC position reached through catchup (ref :2223)."""
+        """Adopt the 3PC position reached through catchup (ref :2223).
+
+        The stable checkpoint is rounded DOWN to the CHK_FREQ boundary
+        (ref checkpoint_service.py:137-139): claiming stability at an
+        off-boundary seq-no the rest of the pool holds no certificate for
+        deadlocks the next view change — NewViewBuilder.calc_checkpoint
+        requires a strong quorum whose stable <= the selected checkpoint,
+        and no candidate at the off-boundary height can exist. A node
+        restored to seq 1 therefore reports stable 0 (which every node's
+        'initial' checkpoint satisfies), not 1.
+        """
         if last_3pc > self._data.last_ordered_3pc:
+            chk = max(1, self._config.CHK_FREQ)
+            boundary = last_3pc[1] // chk * chk
             self._data.last_ordered_3pc = last_3pc
             self._data.pp_seq_no = max(self._data.pp_seq_no, last_3pc[1])
-            self._data.low_watermark = max(self._data.low_watermark, last_3pc[1])
+            self._data.low_watermark = max(self._data.low_watermark, boundary)
             self._data.stable_checkpoint = max(self._data.stable_checkpoint,
-                                               last_3pc[1])
+                                               boundary)
         # Everything at or below the new position is history.
         for store in (self.prePrepares, self.sent_preprepares,
                       self.prepares, self.commits):

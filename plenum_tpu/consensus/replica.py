@@ -45,7 +45,8 @@ class Replica:
                  checkpoint_digest_provider=None,
                  instance_count: int = 1,
                  external_internal_bus: Optional[InternalBus] = None,
-                 metrics=None):
+                 metrics=None,
+                 ic_vote_store=None):
         self.name = replica_name(node_name, inst_id)
         self.inst_id = inst_id
         self.config = config or Config()
@@ -86,7 +87,8 @@ class Replica:
                 instance_count=instance_count)
             self.vc_trigger = ViewChangeTriggerService(
                 data=self._data, timer=timer, bus=self.internal_bus,
-                network=network, config=self.config)
+                network=network, config=self.config,
+                vote_store=ic_vote_store)
             self.primary_health = PrimaryHealthService(
                 data=self._data, timer=timer, bus=self.internal_bus,
                 has_pending_work=self.has_unordered_work, config=self.config,

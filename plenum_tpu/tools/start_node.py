@@ -103,10 +103,29 @@ def main(argv=None):
     ap.add_argument("--kv", default="file", choices=["file", "memory"])
     ap.add_argument("--record", action="store_true",
                     help="record all ingress for offline replay")
+    ap.add_argument("--profile", default=None, metavar="PATH",
+                    help="run under cProfile; dump pstats to PATH on SIGTERM"
+                         " (feeds tools.perf_budget — the Amdahl breakdown)")
     args = ap.parse_args(argv)
 
     prodable, node, _ = build_node(args.name, args.base_dir, args.backend,
                                    args.kv, record=args.record)
+    if args.profile:
+        import cProfile
+        import signal as _signal
+        # CPU-time timer, not wall: bench pools timeshare one core, and a
+        # wall-clock profile would charge each function for time spent
+        # preempted (sum across N processes then exceeds wall by ~Nx).
+        # process_time counts only cycles this process actually burned.
+        profiler = cProfile.Profile(time.process_time)
+        profiler.enable()
+
+        def _dump_and_exit(signum, frame):
+            profiler.disable()
+            profiler.dump_stats(args.profile)
+            os._exit(0)
+
+        _signal.signal(_signal.SIGTERM, _dump_and_exit)
     looper = Looper()
     looper.add(prodable)
 

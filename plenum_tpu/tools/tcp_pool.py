@@ -101,7 +101,8 @@ async def drive_load(addrs, f, requests, window: int, timeout: float):
 
 
 def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
-                 base_dir: str | None = None, timeout: float = 120.0) -> dict:
+                 base_dir: str | None = None, timeout: float = 120.0,
+                 profile_dir: str | None = None) -> dict:
     from plenum_tpu.client.wallet import Wallet
     from plenum_tpu.execution.txn import NYM
 
@@ -115,11 +116,14 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     procs = []
     try:
         for name in names:
+            cmd = [sys.executable, "-m", "plenum_tpu.tools.start_node",
+                   "--name", name, "--base-dir", tmp, "--kv", "memory",
+                   "--backend", backend]
+            if profile_dir:
+                cmd += ["--profile",
+                        os.path.join(profile_dir, f"{name}.pstats")]
             procs.append(subprocess.Popen(
-                [sys.executable, "-m", "plenum_tpu.tools.start_node",
-                 "--name", name, "--base-dir", tmp, "--kv", "memory",
-                 "--backend", backend],
-                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT))
         _wait_all_started(procs, deadline_s=60.0)
 

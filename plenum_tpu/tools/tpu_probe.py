@@ -1,0 +1,64 @@
+"""Cheap liveness probe for the TPU device relay.
+
+The device tunnel in this environment fronts the TPU behind a local relay
+(127.0.0.1:8082/8083).  When the relay is down, ``jax.devices()`` does not
+fail — it hangs forever retrying — so any benchmark that reaches for the
+device without probing first burns its whole timeout budget (25 minutes in
+round 3) learning nothing.  A 3-second TCP connect distinguishes
+"nothing is listening" from "relay up" in bounded time without touching
+jax APIs at all (important: the tunnel is single-tenant, and a second
+process touching device APIs can wedge it — see docs/performance.md).
+
+Replaces nothing in the reference (its CUDA devices are local); this is
+operational armor specific to a tunneled single-tenant accelerator.
+
+Usage:
+    python -m plenum_tpu.tools.tpu_probe          # human-readable + rc 0/1
+    from plenum_tpu.tools.tpu_probe import probe_relay
+"""
+from __future__ import annotations
+
+import socket
+import time
+
+RELAY_HOST = "127.0.0.1"
+RELAY_PORTS = (8083, 8082)
+
+
+def probe_relay(timeout: float = 3.0) -> dict:
+    """TCP-connect each relay port. -> {"up": bool, "ports": {...}, "ts": iso}.
+
+    Never raises; never imports jax.
+    """
+    ports = {}
+    for port in RELAY_PORTS:
+        t0 = time.monotonic()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        try:
+            sock.connect((RELAY_HOST, port))
+            ports[port] = {"state": "open",
+                           "ms": round((time.monotonic() - t0) * 1e3, 1)}
+        except OSError as exc:
+            ports[port] = {"state": type(exc).__name__,
+                           "ms": round((time.monotonic() - t0) * 1e3, 1)}
+        finally:
+            sock.close()
+    return {
+        "up": any(p["state"] == "open" for p in ports.values()),
+        "ports": ports,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def main() -> int:
+    result = probe_relay()
+    state = "UP" if result["up"] else "DOWN"
+    detail = " ".join(f"{port}={info['state']}({info['ms']}ms)"
+                      for port, info in result["ports"].items())
+    print(f"{result['ts']} tpu-relay {state} {detail}")
+    return 0 if result["up"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,14 @@
+#!/bin/bash
+# TPU relay probe loop: appends one timestamped line per attempt to probes/tpu_probe_r04.log.
+# 3s TCP connect to 127.0.0.1:8083 (and 8082); never touches jax APIs.
+LOG="$(dirname "$0")/tpu_probe_r04.log"
+while true; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  R83=$(timeout 4 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8083' 2>&1 && echo open || echo refused)
+  R82=$(timeout 4 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8082' 2>&1 && echo open || echo refused)
+  echo "$TS 8083=$R83 8082=$R82" >> "$LOG"
+  if [ "$R83" = open ] || [ "$R82" = open ]; then
+    echo "$TS TUNNEL UP" >> "$LOG"
+  fi
+  sleep 300
+done

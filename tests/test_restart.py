@@ -131,3 +131,79 @@ def test_restart_discards_uncommitted_tail(tmp_path):
     assert ledger.size == size_before         # catchup refills it
     assert ledger.root_hash == pool.nodes["Alpha"].c.db.get_ledger(
         DOMAIN_LEDGER_ID).root_hash
+
+
+def test_instance_change_votes_survive_restart(tmp_path):
+    """A node crash during a marginal f+1 InstanceChange accumulation must
+    not reset the count: persisted votes + fresh votes still complete the
+    view change (ref instance_change_provider.py:34-69).
+
+    Strictness: Gamma is down for good, so exactly n-f=3 nodes remain and
+    ALL of them must join the view change for it to complete. Delta hears
+    Alpha's vote, restarts, then hears Beta's — without persistence Delta
+    would hold 1 vote < f+1 and the pool would stay in view 0 forever.
+    """
+    from plenum_tpu.common.internal_messages import VoteForViewChange
+    from plenum_tpu.common.suspicion_codes import Suspicions
+
+    pool = _file_pool(tmp_path)
+    pool.crash_node("Gamma")
+
+    # Alpha votes; the InstanceChange broadcast reaches Beta and Delta
+    pool.nodes["Alpha"].master_replica.internal_bus.send(
+        VoteForViewChange(suspicion_code=Suspicions.PRIMARY_DEGRADED.code))
+    pool.run(2.0)
+    assert all(pool.nodes[n].master_replica.view_no == 0
+               for n in pool.nodes)          # 1 vote < f+1: nothing starts
+
+    pool.crash_node("Delta")
+    node = pool.start_node("Delta")
+    pool.net.connect_all()
+
+    # Beta's fresh vote is the second: every live node reaches f+1 only if
+    # Delta still counts Alpha's persisted vote
+    pool.nodes["Beta"].master_replica.internal_bus.send(
+        VoteForViewChange(suspicion_code=Suspicions.PRIMARY_DEGRADED.code))
+    pool.run(15.0)
+    for name in ("Alpha", "Beta", "Delta"):
+        assert pool.nodes[name].master_replica.view_no == 1, name
+        # the view change COMPLETED (NewView accepted), not merely started —
+        # a restarted node claiming an off-boundary stable checkpoint used
+        # to deadlock NewViewBuilder.calc_checkpoint here
+        assert not pool.nodes[name].master_replica.data.waiting_for_new_view
+
+    # and the pool keeps ordering under the new primary
+    pool.submit(signed_nym(pool.trustee, _user(b"ic-u2"), 2),
+                to=list(pool.nodes))
+    pool.run(10.0)
+    for name in ("Alpha", "Beta", "Delta"):
+        assert pool.nodes[name].c.db.get_ledger(
+            DOMAIN_LEDGER_ID).size == 2, name
+
+
+def test_instance_change_votes_expire_at_load(tmp_path):
+    """TTL-on-load: a persisted vote older than INSTANCE_CHANGE_TIMEOUT in
+    wall-clock terms is dropped when the node restarts, so stale grievances
+    can't combine across epochs (ref instance_change_provider TTL)."""
+    from plenum_tpu.common.node_messages import InstanceChange
+    from plenum_tpu.consensus.view_change_trigger_service import \
+        InstanceChangeVoteStore
+    from plenum_tpu.execution.database_manager import NODE_STATUS_DB_LABEL
+
+    pool = _file_pool(tmp_path)
+    node = pool.nodes["Delta"]
+    node.master_replica.vc_trigger.process_instance_change(
+        InstanceChange(view_no=1, reason=0), "Alpha")
+
+    # age the persisted vote past the TTL by rewriting its wall stamp
+    kv = node.c.db.get_store(NODE_STATUS_DB_LABEL)
+    store = InstanceChangeVoteStore(kv)
+    import time as _time
+    old = _time.time() - pool.config.INSTANCE_CHANGE_TIMEOUT - 10
+    store.save_view(1, {"Alpha": old})
+
+    pool.crash_node("Delta")
+    node = pool.start_node("Delta")
+    trigger = node.master_replica.vc_trigger
+    assert trigger._votes.get(1, {}) == {}    # expired vote not reloaded
+    assert store.load(pool.config.INSTANCE_CHANGE_TIMEOUT) == {}  # and purged
