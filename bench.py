@@ -125,6 +125,24 @@ def main():
         })
     else:
         result["jax_error"] = jax_stats.get("error", "unknown")
+
+    # the remaining BASELINE.json configs (2-5), one figure each
+    # (tools/bench_configs; each returns {"error": ...} rather than raising)
+    try:
+        from plenum_tpu.tools import bench_configs as bc
+        c2 = bc.config2_three_instances_mixed(n_txns=200)
+        c3 = bc.config3_bls_proof_reads(n_reads=1500)
+        c4 = bc.config4_viewchange_under_load(n_txns=150)
+        c5 = bc.config5_sim25(n_txns=60)
+        result["config2_mixed_3inst_tps"] = c2.get("tps", c2.get("error"))
+        result["config3_proof_reads_per_s"] = c3.get("reads_per_s",
+                                                     c3.get("error"))
+        result["config4_vc_under_load_tps"] = c4.get("tps_across_fault",
+                                                     c4.get("error"))
+        result["config4_recovered"] = c4.get("recovered", False)
+        result["config5_sim25_tps"] = c5.get("tps", c5.get("error"))
+    except Exception as e:               # the headline line must survive
+        result["configs_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

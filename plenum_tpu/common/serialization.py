@@ -23,12 +23,21 @@ def unpack(data: bytes) -> Any:
 
 def _sort_maps(obj: Any) -> Any:
     if isinstance(obj, dict):
-        # Mixed-type keys must not crash serialization (ingress validation
-        # rejects them on wire messages, but internal data may use int keys).
-        return {k: _sort_maps(obj[k])
-                for k in sorted(obj, key=lambda k: (type(k).__name__, str(k)))}
+        keys = list(obj)
+        if all(type(k) is str for k in keys):
+            keys.sort()               # C-speed for the all-str common case
+        else:
+            # Non-str/mixed keys keep the HISTORIC canonical order —
+            # (type name, str(k)) — so bytes packed by older code compare
+            # equal; ingress validation rejects these on wire messages,
+            # but internal data may use int keys.
+            keys.sort(key=lambda k: (type(k).__name__, str(k)))
+        return {k: (_sort_maps(v) if isinstance(v, (dict, list, tuple))
+                    else v)
+                for k, v in ((k, obj[k]) for k in keys)}
     if isinstance(obj, (list, tuple)):
-        return [_sort_maps(v) for v in obj]
+        return [(_sort_maps(v) if isinstance(v, (dict, list, tuple)) else v)
+                for v in obj]
     return obj
 
 

@@ -46,6 +46,13 @@ class Config:
     LISTENER_MESSAGE_QUOTA: int = 100
     REMOTES_MESSAGE_QUOTA: int = 100
 
+    # --- client connection budget (ref config.py:285-292) ---
+    MAX_CONNECTED_CLIENTS: int = 400
+    CLIENT_CONN_IDLE_TIMEOUT: float = 300.0
+
+    # --- process GC cadence (see common/metrics.tune_gc_for_server) ---
+    GC_SERVER_TUNING: bool = True
+
     # --- view change (ref config.py:294-295) ---
     VIEW_CHANGE_TIMEOUT: float = 60.0
     NEW_VIEW_TIMEOUT: float = 30.0

@@ -93,6 +93,9 @@ class OrderingService:
             lid: OrderedDict() for lid in VALID_LEDGER_IDS}
         # Master-only stack of applied-but-unordered batches for revert.
         self._applied_unordered: list[tuple[int, BatchID]] = []
+        # Node-installed persistence hook for backup primaries' last-sent
+        # PRE-PREPARE seq-no (ref last_sent_pp_store_helper.py).
+        self.on_backup_pp_sent = None
 
         self._stasher = StashingRouter()
         self._stasher.subscribe(PrePrepare, self.process_preprepare)
@@ -269,6 +272,12 @@ class OrderingService:
         self._data.preprepare_batch(batch_id)
         if self._data.is_master:
             self._applied_unordered.append((ledger_id, batch_id))
+        elif self.on_backup_pp_sent is not None:
+            # backup primaries have no audit trail to restore from; the
+            # node persists their last-sent seq-no so a restart resumes
+            # the numbering instead of re-issuing pp_seq_no 1
+            # (ref last_sent_pp_store_helper.py)
+            self.on_backup_pp_sent(self._data.inst_id, view_no, pp_seq_no)
         self._network.send(pre_prepare)
 
     def _apply(self, ledger_id, reqs, pp_time, view_no, pp_seq_no) -> AppliedBatch:

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 from typing import Any, Callable, Optional
 
@@ -432,13 +433,24 @@ class ClientStack:
 
     on_request(msg_dict, client_id) is wired to Node.handle_client_message;
     send(msg, client_id) is the Node's client_send callback.
+
+    Connection budget (ref plenum/config.py:285-292 MAX_CONNECTED_CLIENTS_NUM
+    + client-stack restart): at most `max_connections` concurrent client
+    sockets. The reference restarts the whole ZMQ stack to shed dead
+    connections because ZMQ cannot enumerate them; an asyncio listener can,
+    so a full stack first sweeps connections idle past `idle_timeout`
+    (activity = any frame in OR any push/reply out) and only rejects the
+    new connection if every slot is genuinely live — validator traffic is
+    untouched either way (separate node stack).
     """
 
     INBOUND_CAP = 10_000          # queued requests across all clients
 
     def __init__(self, name: str, host: str, port: int,
                  on_request: Callable[[dict, str], None],
-                 max_inbound_per_drain: int = 500):
+                 max_inbound_per_drain: int = 500,
+                 max_connections: int = 400,
+                 idle_timeout: float = 300.0):
         self.name = name
         self.host, self.port = host, port
         self._on_request = on_request
@@ -447,6 +459,10 @@ class ClientStack:
         self._next_id = 0
         self._inbound: deque[tuple[dict, str]] = deque()
         self._quota = max_inbound_per_drain
+        self.max_connections = max_connections
+        self.idle_timeout = idle_timeout
+        self._last_activity: dict[str, float] = {}
+        self.rejected_connections = 0
 
     async def bind(self) -> int:
         if self._server is None:
@@ -489,21 +505,51 @@ class ClientStack:
             if writer.transport.get_write_buffer_size() > WRITE_HWM:
                 raise ConnectionError("client write buffer over HWM")
             writer.write(len(data).to_bytes(4, "big") + data)
+            self._last_activity[client_id] = time.monotonic()
         except Exception:
-            self._conns.pop(client_id, None)
+            self._drop_client(client_id)
+
+    def _drop_client(self, client_id: str) -> None:
+        writer = self._conns.pop(client_id, None)
+        self._last_activity.pop(client_id, None)
+        if writer is not None:
             try:
                 writer.close()
             except Exception:
                 pass
 
+    def _sweep_idle(self) -> int:
+        """Close connections with no traffic in either direction for
+        idle_timeout; returns number closed."""
+        now = time.monotonic()
+        stale = [cid for cid, ts in self._last_activity.items()
+                 if now - ts > self.idle_timeout]
+        for cid in stale:
+            self._drop_client(cid)
+        return len(stale)
+
     async def _on_accept(self, reader, writer) -> None:
+        if len(self._conns) >= self.max_connections:
+            self._sweep_idle()
+        if len(self._conns) >= self.max_connections:
+            # every slot is live within the idle window: shed the newcomer
+            # (bounded memory/FDs beat fairness here, as in the reference's
+            # MAX_CONNECTED_CLIENTS_NUM)
+            self.rejected_connections += 1
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
         cid = f"client-{self._next_id}"
         self._next_id += 1
         self._conns[cid] = writer
+        self._last_activity[cid] = time.monotonic()
         try:
             while True:
                 frame = await _read_frame(reader)
                 msg = unpack(frame)
+                self._last_activity[cid] = time.monotonic()
                 if isinstance(msg, dict) and \
                         len(self._inbound) < self.INBOUND_CAP:
                     self._inbound.append((msg, cid))
@@ -511,8 +557,4 @@ class ClientStack:
                 Exception):
             pass
         finally:
-            self._conns.pop(cid, None)
-            try:
-                writer.close()
-            except Exception:
-                pass
+            self._drop_client(cid)

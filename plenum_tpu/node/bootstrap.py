@@ -164,7 +164,12 @@ class NodeBootstrap:
         write_manager.register_handler(TxnAuthorAgreementAmlHandler(db, nym))
         write_manager.register_handler(TxnAuthorAgreementDisableHandler(db, nym))
         write_manager.register_handler(LedgersFreezeHandler(db, nym))
+        from plenum_tpu.execution.handlers.attrib import (
+            ATTRIB_STORE_LABEL, AttribHandler, GetAttrHandler)
+        db.register_store(ATTRIB_STORE_LABEL, self._kv("attrib_db"))
+        write_manager.register_handler(AttribHandler(db))
         read_manager = ReadRequestManager()
+        read_manager.register_handler(GetAttrHandler(db))
         read_manager.register_handler(GetNymHandler(db))
         read_manager.register_handler(GetTxnHandler(db))
         read_manager.register_handler(GetTxnAuthorAgreementHandler(db))

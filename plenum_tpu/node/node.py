@@ -35,7 +35,7 @@ from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
                                              Ordered, POOL_LEDGER_ID,
                                              Propagate, Reject, Reply,
                                              RequestAck, RequestNack)
-from plenum_tpu.common.serialization import unpack
+from plenum_tpu.common.serialization import pack, unpack
 from plenum_tpu.execution.database_manager import (NODE_STATUS_DB_LABEL,
                                                    SEQ_NO_DB_LABEL)
 from plenum_tpu.consensus.view_change_trigger_service import \
@@ -78,6 +78,44 @@ BLACKLIST_CODES = frozenset(s.code for s in (
     Suspicions.PPR_FRM_NON_PRIMARY, Suspicions.INVALID_REQ_SIGNATURE))
 
 
+class LastSentPpStore:
+    """Durable {inst_id: (view_no, pp_seq_no)} of the last PRE-PREPARE each
+    BACKUP primary on this node sent (ref last_sent_pp_store_helper.py:1).
+    The master primary needs no such record — its position is restored from
+    the audit ledger — but a restarting backup primary would otherwise
+    re-issue pp_seq_no 1 and collide with its shadows' 3PC logs."""
+
+    KEY = b"last_sent_pp"
+
+    def __init__(self, kv):
+        self._kv = kv
+        # write-through cache: store() fires once per backup batch on the
+        # ordering hot path, and a KV get+unpack per call would be a
+        # read-modify-write tax for data only this object writes
+        self._cur: dict = self._load_from_kv()
+
+    def _load_from_kv(self) -> dict:
+        try:
+            got = unpack(self._kv.get(self.KEY))
+            return got if isinstance(got, dict) else {}
+        except Exception:
+            return {}
+
+    def store(self, inst_id: int, view_no: int, pp_seq_no: int) -> None:
+        self._cur[str(inst_id)] = [view_no, pp_seq_no]
+        self._kv.put(self.KEY, pack(self._cur))
+
+    def load_raw(self) -> dict:
+        return dict(self._cur)
+
+    def erase(self) -> None:
+        self._cur = {}
+        try:
+            self._kv.remove(self.KEY)
+        except KeyError:
+            pass
+
+
 class Node:
     def __init__(self, name: str, timer: TimerService, node_bus: ExternalBus,
                  components: NodeComponents,
@@ -92,6 +130,9 @@ class Node:
         self.c = components
         self._client_send = client_send or (lambda msg, client: None)
         self.started_at = timer.get_current_time()
+        if self.config.GC_SERVER_TUNING:
+            from plenum_tpu.common.metrics import tune_gc_for_server
+            tune_gc_for_server()
 
         # named-metric accumulators (ref common/metrics_collector.py:331);
         # KV-backed collectors get a periodic flush so history survives
@@ -130,11 +171,16 @@ class Node:
             forward_to_replicas=self._forward_to_replicas,
             now=timer.get_current_time)
 
-        # RBFT: f+1 protocol instances (ref replicas.py:19)
-        n_inst = instance_count if instance_count is not None \
-            else self.quorums.f + 1
+        # RBFT: f+1 protocol instances by default (ref replicas.py:19),
+        # recomputed as pool membership changes f; an explicit
+        # instance_count PINS the count (BASELINE config 2 runs 3)
+        self._pinned_instances = instance_count
+        n_inst = self._n_instances()
+        status_kv = self.c.db.get_store(NODE_STATUS_DB_LABEL)
+        self._last_sent_pp = \
+            LastSentPpStore(status_kv) if status_kv is not None else None
         self.replicas = Replicas(self._make_replica)
-        self.replicas.grow_to(max(1, n_inst))
+        self.replicas.grow_to(n_inst)
 
         # audit txns snapshot the current primaries + node reg
         # (ref audit_batch_handler.py:83-231)
@@ -238,6 +284,7 @@ class Node:
         # audit ledger's 3PC position and primaries instead of view 0 / seq 0
         # (ref node.py:1830,1875 — the same restore catchup applies later)
         self._restore_3pc_from_audit()
+        self._restore_backup_last_sent_pp()
 
         # built-in actions need the finished node (ref validator_info_tool)
         from plenum_tpu.execution.action_manager import ValidatorInfoAction
@@ -272,9 +319,52 @@ class Node:
             trigger.purge_stale()
         self.spylog.append(("restored_from_audit", (view_no, pp_seq_no)))
 
+    def _restore_backup_last_sent_pp(self) -> None:
+        """Resume each backup primary at its persisted last-sent seq-no
+        (ref last_sent_pp_store_helper.try_restore_last_sent_pp_seq_no):
+        only for instances where this node IS the primary, only when the
+        stored view matches the restored view — a row from an older view is
+        stale (numbering restarted) and is dropped."""
+        if self._last_sent_pp is None:
+            return
+        stored = self._last_sent_pp.load_raw()
+        if not stored:
+            return
+        stale = False
+        for inst_str, pair in stored.items():
+            try:
+                inst_id, (view_no, pp_seq_no) = int(inst_str), pair
+            except (ValueError, TypeError):
+                stale = True
+                continue
+            if inst_id == 0 or inst_id not in self.replicas:
+                stale = True
+                continue
+            data = self.replicas[inst_id].data
+            if view_no != data.view_no or not data.is_primary:
+                stale = True
+                continue
+            data.pp_seq_no = max(data.pp_seq_no, pp_seq_no)
+            data.last_ordered_3pc = max(data.last_ordered_3pc,
+                                        (view_no, pp_seq_no))
+            self.spylog.append(("restored_backup_pp", (inst_id, pp_seq_no)))
+        if stale:
+            # rewrite only the rows that survived restore
+            self._last_sent_pp.erase()
+            for inst_str, pair in stored.items():
+                try:
+                    inst_id = int(inst_str)
+                    if inst_id != 0 and inst_id in self.replicas and \
+                            pair[0] == self.replicas[inst_id].data.view_no:
+                        self._last_sent_pp.store(inst_id, pair[0], pair[1])
+                except (ValueError, TypeError, IndexError):
+                    continue
+
     def _flush_metrics(self) -> None:
-        """Sample queue depths, then flush accumulators to the KV store —
-        depth gauges ride the same cadence as every other metric."""
+        """Sample queue depths + process RSS/GC gauges, then flush
+        accumulators to the KV store — all gauges ride the same cadence."""
+        from plenum_tpu.common.metrics import sample_process_gauges
+        sample_process_gauges(self.metrics)
         self.metrics.add_event(MetricsName.CLIENT_INBOX_DEPTH,
                                len(self._client_inbox))
         self.metrics.add_event(MetricsName.PROPAGATE_INBOX_DEPTH,
@@ -384,6 +474,13 @@ class Node:
 
     # --- wiring -----------------------------------------------------------
 
+    def _n_instances(self) -> int:
+        """Effective RBFT instance count: pinned if the constructor said
+        so, else f+1 from the CURRENT quorums (tracks pool membership)."""
+        if self._pinned_instances is not None:
+            return max(1, self._pinned_instances)
+        return max(1, self.quorums.f + 1)
+
     def _make_replica(self, inst_id: int) -> Replica:
         from plenum_tpu.execution.handlers import audit as audit_lib
         audit = self.c.db.get_ledger(AUDIT_LEDGER_ID)
@@ -438,7 +535,7 @@ class Node:
             get_request=self.propagator.requests.get_request,
             checkpoint_digest_provider=(
                 lambda seq: audit.uncommitted_root_hash.hex()),
-            instance_count=max(1, self.pool_manager.quorums.f + 1),
+            instance_count=self._n_instances(),
             metrics=self.metrics if inst_id == 0 else None,
             ic_vote_store=ic_store)
         if bls is not None:
@@ -447,6 +544,8 @@ class Node:
                     inst_id=inst_id, code=Suspicions.CM_BLS_WRONG.code,
                     reason="bad COMMIT BLS signature (order-time bisection)",
                     sender=sender))
+        if inst_id != 0 and self._last_sent_pp is not None:
+            replica.ordering.on_backup_pp_sent = self._last_sent_pp.store
         replica.internal_bus.subscribe(Ordered, self._on_ordered)
         replica.internal_bus.subscribe(RaisedSuspicion, self._on_suspicion)
         # lambdas: message_req is constructed after the replicas
@@ -473,8 +572,12 @@ class Node:
         (view change is node-level; backups have no VC machinery of their own).
         Backups removed as faulty are re-created fresh here (ref
         restore_backup_replicas on view change)."""
-        n_inst = max(1, self.quorums.f + 1)
+        n_inst = self._n_instances()
         self._removed_backups.clear()       # a new view restores everything
+        if self._last_sent_pp is not None:
+            # backup numbering restarts with the view; stale rows must not
+            # resume a future restart at an old view's heights
+            self._last_sent_pp.erase()
         # partial vote sets from superseded views can never complete (view
         # is checked at receipt) — drop them or they leak one per view
         self._backup_faulty_votes = {
@@ -596,7 +699,7 @@ class Node:
         the committed validator list — so every honest node derives the
         same assignment from the same pool txn. The full set is reselected
         at the next view change (set_instance_count)."""
-        n_inst = max(1, self.quorums.f + 1)
+        n_inst = self._n_instances()
         master = self.replicas.master
         if master.view_changer is not None:
             master.view_changer.set_instance_count(n_inst)
@@ -694,6 +797,14 @@ class Node:
                                      self._client_inbox[quota:])
         to_auth: list[tuple[Request, str]] = []
         for msg, frm in batch:
+            if msg.get("op") == "OBSERVER_REGISTER":
+                # a follower on this client connection wants BatchCommitted
+                # pushes (ref observer/observable.py; the reference wires
+                # registration through node plugins, here it is a first-
+                # class client op so an ObserverNode needs no side channel)
+                self.observable.add_observer(frm)
+                self._client_send({"op": "OBSERVER_ACK"}, frm)
+                continue
             try:
                 request = Request.from_dict(msg)
             except Exception:

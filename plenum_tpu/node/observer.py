@@ -22,16 +22,40 @@ from plenum_tpu.common.serialization import signing_serialize
 
 
 class Observable:
-    """Node-side observer registry + each-batch send policy."""
+    """Node-side observer registry + each-batch send policy.
 
-    def __init__(self, send: Callable[[Any, str], None]):
+    Registrations arrive over the client stack (OBSERVER_REGISTER op, see
+    Node._service_client_msgs) keyed by the client connection id; pushes to
+    a disconnected id are silently dropped by the stack. The registry is
+    capped — anyone can connect a client socket, so unbounded registration
+    would be a free memory/egress amplifier — with FIFO eviction (a dead
+    registration can't block live ones forever; an evicted live observer
+    re-registers on its next reconnect).
+    """
+
+    MAX_OBSERVERS = 16
+
+    def __init__(self, send: Callable[[Any, str], None],
+                 close: Optional[Callable[[str], None]] = None):
         self._send = send
+        self._close = close          # drops the evicted CONNECTION so the
+        # observer's redial+re-register loop fires; without it an evicted
+        # follower would sit on a silent socket forever
         self._observers: dict[str, str] = {}      # observer id -> policy
 
     def add_observer(self, observer_id: str,
                      policy: str = "each_batch") -> None:
         if policy != "each_batch":
             raise ValueError(f"unknown observer policy {policy!r}")
+        # re-registration refreshes recency (pop + insert moves to the
+        # dict's end), so FIFO eviction removes the LONGEST-UNREFRESHED
+        # id, not the longest-lived legitimate observer
+        self._observers.pop(observer_id, None)
+        if len(self._observers) >= self.MAX_OBSERVERS:
+            oldest = next(iter(self._observers))
+            del self._observers[oldest]
+            if self._close is not None:
+                self._close(oldest)
         self._observers[observer_id] = policy
 
     def remove_observer(self, observer_id: str) -> None:

@@ -1,0 +1,308 @@
+"""The remaining BASELINE.json benchmark configs (2-5).
+
+BASELINE.json names five configs; tools/local_pool + tools/tcp_pool cover
+config 1 (4-node NYM writes). This module measures the rest, each as one
+function returning a small stats dict that bench.py folds into its extras:
+
+  config2  4-node pool, THREE RBFT protocol instances, mixed NYM/ATTRIB
+  config3  BLS state-proof reads: GET_NYM queries answered with a state
+           proof + BLS multi-signature (single node serves reads)
+  config4  7-node / f=2 pool over real TCP, view change UNDER LOAD (the
+           master primary process is killed mid-drive)
+  config5  25-node simulated pool ordering datum
+
+Every function is wall-clock bounded and returns {"error": ...} instead of
+raising — bench.py must always print its one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def _mixed_requests(trustee, n: int):
+    """NYM-create for even i, ATTRIB for odd i. ATTRIBs are trustee-
+    authored (a trustee may set attributes on any DID) and target a DID
+    created >=128 requests earlier — or the genesis trustee itself — so
+    an in-flight window never races a dest's NYM commit: a fresh DID is
+    unusable until its NYM lands, exactly as for real clients."""
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import ATTRIB, NYM
+
+    users = []
+    reqs = []
+    for i in range(n):
+        if i % 2 == 0:
+            user = Ed25519Signer(seed=(b"mix%08d" % i).ljust(32, b"\0")[:32])
+            users.append(user)
+            req = Request(trustee.identifier, i + 1,
+                          {"type": NYM, "dest": user.identifier,
+                           "verkey": user.verkey_b58})
+        else:
+            settled = len(users) - 64          # 64 NYMs = 128 requests ago
+            dest = users[(i // 2) % settled].identifier if settled > 0 \
+                else trustee.identifier
+            req = Request(trustee.identifier, i + 1,
+                          {"type": ATTRIB, "dest": dest,
+                           "raw": json.dumps({"endpoint%d" % i: str(i)})})
+        req.signature = trustee.sign_b58(req.signing_bytes())
+        reqs.append(req)
+    return reqs
+
+
+def _drive_inprocess(names, nodes, timer, replies, Reply, plane, requests,
+                     timeout: float):
+    t0 = time.perf_counter()
+    done: set = set()
+    i = 0
+    while len(done) < len(requests) and time.perf_counter() < t0 + timeout:
+        while i < len(requests) and i - len(done) < 256:
+            for n in names:
+                nodes[n].handle_client_message(requests[i].to_dict(), "bench")
+            i += 1
+        timer.service()
+        for node in nodes.values():
+            node.prod()
+        if plane is not None:
+            plane.flush()
+        for _, msg, _c in replies[names[0]]:
+            if isinstance(msg, Reply):
+                d = msg.result.get("txn", {}).get("metadata", {}).get("digest")
+                if d:
+                    done.add(d)
+        replies[names[0]].clear()
+    return len(done), time.perf_counter() - t0
+
+
+def config2_three_instances_mixed(n_txns: int = 200,
+                                  timeout: float = 120.0) -> dict:
+    """4 nodes, 3 RBFT instances, mixed NYM/ATTRIB writes."""
+    import plenum_tpu.tools.local_pool as lp
+    from plenum_tpu.common.node_messages import Reply
+    from plenum_tpu.common.timer import QueueTimer
+    from plenum_tpu.config import Config
+    from plenum_tpu.network import SimNetwork, SimRandom
+    from plenum_tpu.node import Node, NodeBootstrap
+
+    try:
+        names = [f"Node{i + 1}" for i in range(4)]
+        genesis, trustee = lp.build_genesis(names)
+        timer = QueueTimer(time.perf_counter)
+        net = SimNetwork(timer, SimRandom(7))
+        net.set_latency(0.00005, 0.0002)
+        config = Config(Max3PCBatchWait=0.05,
+                        STATE_FRESHNESS_UPDATE_INTERVAL=600.0)
+        replies = {n: [] for n in names}
+        nodes = {}
+        for name in names:
+            bus = net.create_peer(name)
+            comp = NodeBootstrap(name, genesis_txns=genesis).build()
+            nodes[name] = Node(
+                name, timer, bus, comp,
+                client_send=lambda msg, client, n=name: replies[n].append(
+                    (time.perf_counter(), msg, client)),
+                config=config, instance_count=3)
+        net.connect_all()
+        assert all(len(nd.replicas) == 3 for nd in nodes.values())
+
+        reqs = _mixed_requests(trustee, n_txns)
+        done, dt = _drive_inprocess(names, nodes, timer, replies, Reply,
+                                    None, reqs, timeout)
+        # backups shadow-order slightly behind the master's replies; give
+        # them a drain window before reading their progress gauge
+        for _ in range(400):
+            timer.service()
+            for node in nodes.values():
+                node.prod()
+        # every backup instance must be shadow-ordering, or the "3
+        # instances" claim is hollow
+        inst_progress = [
+            min(nodes[n].replicas[i].data.last_ordered_3pc[1]
+                for n in names) for i in (0, 1, 2)]
+        return {"txns_ordered": done, "txns_requested": n_txns,
+                "tps": round(done / dt, 1) if dt else 0.0,
+                "instances": 3,
+                "min_backup_ordered": min(inst_progress[1:]),
+                }
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def config3_bls_proof_reads(n_reads: int = 2000,
+                            timeout: float = 120.0) -> dict:
+    """GET_NYM state-proof read throughput on one node, with the BLS
+    multi-signature attached (ref docs/source/main.md:24 — one node's
+    reply suffices because the proof + multi-sig carry the trust)."""
+    import plenum_tpu.tools.local_pool as lp
+    from plenum_tpu.common.node_messages import Reply
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import GET_NYM, NYM
+
+    try:
+        (names, nodes, timer, trustee,
+         replies, ReplyCls, DOMAIN, plane) = lp.build_pool(4, "cpu")
+        # commit a handful of NYMs so the BLS store holds multi-sigs
+        users = []
+        reqs = []
+        for i in range(20):
+            user = Ed25519Signer(seed=(b"rd%08d" % i).ljust(32, b"\0")[:32])
+            users.append(user)
+            req = Request(trustee.identifier, i + 1,
+                          {"type": NYM, "dest": user.identifier,
+                           "verkey": user.verkey_b58})
+            req.signature = trustee.sign_b58(req.signing_bytes())
+            reqs.append(req)
+        done, _ = _drive_inprocess(names, nodes, timer, replies, ReplyCls,
+                                   plane, reqs, 60.0)
+        if done < len(reqs):
+            return {"error": f"setup ordered only {done}/{len(reqs)}"}
+
+        node = nodes[names[0]]
+        served = 0
+        with_multisig = 0
+        t0 = time.perf_counter()
+        i = 0
+        while served < n_reads and time.perf_counter() < t0 + timeout:
+            q = Request("reader", i + 1,
+                        {"type": GET_NYM,
+                         "dest": users[i % len(users)].identifier})
+            node.handle_client_message(q.to_dict(), "reader")
+            i += 1
+            if i % 100 == 0 or i >= n_reads:
+                node.prod()
+                for _, msg, _c in replies[names[0]]:
+                    if isinstance(msg, ReplyCls) and \
+                            msg.result.get("type") == GET_NYM:
+                        served += 1
+                        if msg.result.get("state_proof", {}) \
+                                .get("multi_signature"):
+                            with_multisig += 1
+                replies[names[0]].clear()
+        dt = time.perf_counter() - t0
+        return {"reads_served": served,
+                "reads_with_multisig": with_multisig,
+                "reads_per_s": round(served / dt, 1) if dt else 0.0}
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def config4_viewchange_under_load(n_txns: int = 150,
+                                  timeout: float = 150.0) -> dict:
+    """7-node / f=2 TCP pool; the master primary's OS process is SIGKILLed
+    mid-drive. Done = the remaining requests still finish (view change
+    under load) and the figure reports effective TPS across the fault."""
+    import asyncio
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    from plenum_tpu.tools.tcp_pool import (REPO, _wait_all_started,
+                                           setup_pool_dir)
+
+    names = [f"Node{i + 1}" for i in range(7)]
+    tmp = tempfile.mkdtemp(prefix="plenum_vc_pool_")
+    trustee_seed = b"vc-pool-trustee!".ljust(32, b"\0")
+    procs = []
+    try:
+        specs = setup_pool_dir(tmp, names, trustee_seed)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        for name in names:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "plenum_tpu.tools.start_node",
+                 "--name", name, "--base-dir", tmp, "--kv", "memory"],
+                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT))
+        _wait_all_started(procs, deadline_s=90.0)
+
+        from plenum_tpu.client.wallet import Wallet
+        from plenum_tpu.execution.txn import NYM
+        wallet = Wallet("vc-bench")
+        trustee = wallet.add_identifier(seed=trustee_seed)
+        requests = []
+        for i in range(n_txns):
+            user = wallet.add_identifier(
+                seed=(b"vcu%05d" % i).ljust(32, b"\0")[:32])
+            requests.append(wallet.sign_request(
+                {"type": NYM, "dest": user,
+                 "verkey": wallet.verkey_of(user)}, identifier=trustee))
+        addrs = {name: ("127.0.0.1", spec[3])
+                 for name, spec in zip(names, specs)}
+
+        async def drive():
+            from plenum_tpu.client.pipelined import PipelinedPoolClient
+            client = PipelinedPoolClient(addrs, f=2)
+
+            async def killer():
+                await asyncio.sleep(1.0)         # mid-load
+                procs[0].send_signal(signal.SIGKILL)   # Node1 = primary
+
+            kill_task = asyncio.create_task(killer())
+            done, submit = await client.drive(requests, window=50,
+                                              timeout=timeout)
+            await kill_task
+            return done, submit
+
+        t0 = time.perf_counter()
+        done, _submit = asyncio.run(drive())
+        dt = time.perf_counter() - t0
+        return {"txns_ordered": len(done), "txns_requested": n_txns,
+                "primary_killed_at_s": 1.0,
+                "recovered": len(done) == n_txns,
+                "tps_across_fault": round(len(done) / dt, 1) if dt else 0.0}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def config5_sim25(n_txns: int = 60, timeout: float = 180.0) -> dict:
+    """25-node simulated pool (SimNetwork fabric, one process) ordering
+    datum — the scale test's shape (tests/test_scale.py) with a number."""
+    import plenum_tpu.tools.local_pool as lp
+    from plenum_tpu.common.node_messages import Reply
+
+    try:
+        (names, nodes, timer, trustee,
+         replies, ReplyCls, DOMAIN, plane) = lp.build_pool(25, "cpu")
+        from plenum_tpu.common.request import Request
+        from plenum_tpu.crypto.ed25519 import Ed25519Signer
+        from plenum_tpu.execution.txn import NYM
+        reqs = []
+        for i in range(n_txns):
+            user = Ed25519Signer(seed=(b"s25_%05d" % i).ljust(32, b"\0")[:32])
+            req = Request(trustee.identifier, i + 1,
+                          {"type": NYM, "dest": user.identifier,
+                           "verkey": user.verkey_b58})
+            req.signature = trustee.sign_b58(req.signing_bytes())
+            reqs.append(req)
+        done, dt = _drive_inprocess(names, nodes, timer, replies, ReplyCls,
+                                    plane, reqs, timeout)
+        return {"nodes": 25, "txns_ordered": done, "txns_requested": n_txns,
+                "tps": round(done / dt, 1) if dt else 0.0}
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def main():
+    for name, fn in (("config2", config2_three_instances_mixed),
+                     ("config3", config3_bls_proof_reads),
+                     ("config4", config4_viewchange_under_load),
+                     ("config5", config5_sim25)):
+        print(name, json.dumps(fn()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

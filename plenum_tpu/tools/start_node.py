@@ -55,15 +55,19 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
     timer = QueueTimer(time.perf_counter)
     node_stack = TcpStack(name, my_ha[0], my_ha[1], registry,
                           seed=bytes.fromhex(keys["seed"]))
-    client_stack = ClientStack(name, my_client_ha[0], my_client_ha[1],
-                               on_request=None)
     config = Config(crypto_backend=backend, kv_backend=kv)
+    client_stack = ClientStack(name, my_client_ha[0], my_client_ha[1],
+                               on_request=None,
+                               max_connections=config.MAX_CONNECTED_CLIENTS,
+                               idle_timeout=config.CLIENT_CONN_IDLE_TIMEOUT)
     node = Node(name, timer, node_stack.bus, components,
                 client_send=client_stack.send, config=config)
     # late-bound: the recorder may wrap handle_client_message below, and the
     # client stack must call through the WRAPPED method
     client_stack._on_request = \
         lambda msg, frm: node.handle_client_message(msg, frm)
+    # observer eviction must close the connection so the follower redials
+    node.observable._close = client_stack._drop_client
 
     if record:
         # the reference's STACK_COMPANION=1 mode: record every ingress +

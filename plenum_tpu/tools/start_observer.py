@@ -1,0 +1,57 @@
+"""Start an observer (read follower) as an OS process.
+
+The observer counterpart of tools/start_node: load the pool genesis from a
+base dir, derive every validator's client address from the pool ledger,
+and run a plenum_tpu.node.observer_node.ObserverNode until killed. Plays
+the role of the reference's runnable ObserverNode
+(plenum/server/observer/observer_node.py).
+
+    python -m plenum_tpu.tools.start_observer --name obs1 --base-dir /tmp/pool \
+        [--f 1] [--data-dir /var/obs1] [--kv file|native|memory]
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+
+def main(argv=None):
+    from plenum_tpu.common.node_messages import POOL_LEDGER_ID
+    from plenum_tpu.node.observer_node import ObserverNode
+    from plenum_tpu.tools.genesis import load_genesis_files
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--base-dir", required=True,
+                    help="pool dir holding the genesis files")
+    ap.add_argument("--f", type=int, default=1,
+                    help="push quorum is f+1 content-identical validators")
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--kv", default="memory",
+                    choices=["memory", "file", "native"])
+    args = ap.parse_args(argv)
+
+    genesis = load_genesis_files(args.base_dir)
+    addrs = {}
+    for txn in genesis[POOL_LEDGER_ID]:
+        data = txn["txn"]["data"]["data"]
+        addrs[data["alias"]] = (data["client_ip"], data["client_port"])
+
+    obs = ObserverNode(args.name, genesis, addrs, f=args.f,
+                       data_dir=args.data_dir, storage_backend=args.kv)
+
+    async def run():
+        stop = asyncio.Event()
+        print(json.dumps({"started": args.name,
+                          "following": sorted(addrs)}), flush=True)
+        await obs.run(stop)
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

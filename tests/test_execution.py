@@ -318,3 +318,93 @@ class TestExecutorSeam:
         assert ex.ledger_id_for(req) == DOMAIN_LEDGER_ID
         ex.revert_last_batch(DOMAIN_LEDGER_ID)
         assert wm.uncommitted_batch_count == 0
+
+
+class TestAttribHandler:
+    """ATTRIB write + GET_ATTR read (BASELINE config 2's second write type;
+    indy-node semantics at the plenum layer — see handlers/attrib.py)."""
+
+    def _managers(self, db):
+        from plenum_tpu.execution.handlers.attrib import (
+            ATTRIB_STORE_LABEL, AttribHandler, GetAttrHandler)
+        wm, rm = make_managers(db)
+        db.register_store(ATTRIB_STORE_LABEL, KvMemory())
+        wm.register_handler(AttribHandler(db))
+        rm.register_handler(GetAttrHandler(db))
+        return wm, rm
+
+    def _attrib_req(self, author, dest, raw=None, req_id=10, **extra):
+        from plenum_tpu.execution.txn import ATTRIB
+        op = {"type": ATTRIB, "dest": dest}
+        if raw is not None:
+            op["raw"] = raw
+        op.update(extra)
+        return Request(author, req_id, op, signature="sig")
+
+    def test_owner_sets_attr_and_reads_it_back_with_proof(self, db):
+        import json
+        from plenum_tpu.execution.txn import GET_ATTR
+        wm, rm = self._managers(db)
+        bootstrap_trustee(wm)
+        wm.apply_batch(DOMAIN_LEDGER_ID,
+                       [nym_req(TRUSTEE_DID, USER_DID, req_id=2)],
+                       pp_time=1001.0, view_no=0, pp_seq_no=2)
+        req = self._attrib_req(USER_DID, USER_DID,
+                               raw=json.dumps({"endpoint": "127.0.0.1:99"}))
+        valid, rejected, _ = wm.apply_batch(DOMAIN_LEDGER_ID, [req],
+                                            pp_time=1002.0, view_no=0,
+                                            pp_seq_no=3)
+        assert len(valid) == 1 and not rejected
+        for seq in (1, 2, 3):
+            wm.commit_batch(ThreePcBatch(
+                ledger_id=DOMAIN_LEDGER_ID, view_no=0, pp_seq_no=seq,
+                pp_time=1002.0, valid_digests=(req.digest,) if seq == 3
+                else (),
+                state_root=b"", txn_root=b"", audit_txn_root=b""))
+
+        q = Request(USER_DID, 11, {"type": GET_ATTR, "dest": USER_DID,
+                                   "attr_name": "endpoint"})
+        result = rm.get_result(q)
+        assert json.loads(result["data"]) == {"endpoint": "127.0.0.1:99"}
+        assert result["meta"]["kind"] == "raw"
+        assert result["state_proof"]["proof_nodes"]
+
+    def test_stranger_cannot_set_attr(self, db):
+        import json
+        wm, _ = self._managers(db)
+        bootstrap_trustee(wm)
+        for did, rid in ((USER_DID, 2), (STEWARD_DID, 3)):
+            wm.apply_batch(DOMAIN_LEDGER_ID,
+                           [nym_req(TRUSTEE_DID, did, req_id=rid)],
+                           pp_time=1001.0, view_no=0, pp_seq_no=rid)
+        req = self._attrib_req(STEWARD_DID, USER_DID,
+                               raw=json.dumps({"x": 1}))
+        valid, rejected, _ = wm.apply_batch(DOMAIN_LEDGER_ID, [req],
+                                            pp_time=1002.0, view_no=0,
+                                            pp_seq_no=4)
+        assert not valid and len(rejected) == 1
+
+    def test_attr_on_unknown_did_rejected(self, db):
+        import json
+        wm, _ = self._managers(db)
+        bootstrap_trustee(wm)
+        req = self._attrib_req(TRUSTEE_DID, "ghostGhostGhostGhost11",
+                               raw=json.dumps({"x": 1}))
+        valid, rejected, _ = wm.apply_batch(DOMAIN_LEDGER_ID, [req],
+                                            pp_time=1002.0, view_no=0,
+                                            pp_seq_no=2)
+        assert not valid and len(rejected) == 1
+
+    def test_exactly_one_of_raw_enc_hash(self, db):
+        import json
+        wm, _ = self._managers(db)
+        with pytest.raises(InvalidClientRequest):
+            wm.static_validation(self._attrib_req(USER_DID, USER_DID))
+        with pytest.raises(InvalidClientRequest):
+            wm.static_validation(self._attrib_req(
+                USER_DID, USER_DID, raw=json.dumps({"a": 1}), enc="blob"))
+        with pytest.raises(InvalidClientRequest):
+            wm.static_validation(self._attrib_req(
+                USER_DID, USER_DID, raw=json.dumps({"a": 1, "b": 2})))
+        wm.static_validation(self._attrib_req(USER_DID, USER_DID,
+                                              enc="ciphertextblob", req_id=1))

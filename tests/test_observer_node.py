@@ -1,0 +1,136 @@
+"""Deployable observer: a follower process with its own transport that
+subscribes to a LIVE TCP pool, applies pushed batches under an f+1 push
+quorum, and — after being killed and restarted — catches up unaided by
+pulling the gap over its own GET_TXN queries.
+
+Reference behavior under test: plenum/server/observer/observer_node.py (a
+self-contained follower with storage + transport + sync policy).
+"""
+from __future__ import annotations
+
+import asyncio
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID, POOL_LEDGER_ID
+
+
+@pytest.fixture
+def tcp_pool_4():
+    from plenum_tpu.tools.tcp_pool import REPO, setup_pool_dir, _wait_all_started
+    import os
+
+    names = [f"Node{i + 1}" for i in range(4)]
+    tmp = tempfile.mkdtemp(prefix="plenum_obs_pool_")
+    trustee_seed = b"obs-pool-trustee".ljust(32, b"\0")
+    specs = setup_pool_dir(tmp, names, trustee_seed)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "plenum_tpu.tools.start_node",
+         "--name", name, "--base-dir", tmp, "--kv", "memory"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for name in names]
+    try:
+        _wait_all_started(procs, deadline_s=60.0)
+        yield tmp, names, specs, trustee_seed
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _signed_nyms(trustee_seed, tags):
+    from plenum_tpu.client.wallet import Wallet
+    from plenum_tpu.execution.txn import NYM
+
+    wallet = Wallet("obs-test")
+    trustee = wallet.add_identifier(seed=trustee_seed)
+    reqs = []
+    for tag in tags:
+        user = wallet.add_identifier(seed=tag.encode().ljust(32, b"\0")[:32])
+        reqs.append(wallet.sign_request(
+            {"type": NYM, "dest": user, "verkey": wallet.verkey_of(user)},
+            identifier=trustee))
+    return reqs
+
+
+async def _drive(addrs, f, requests):
+    from plenum_tpu.client.pipelined import PipelinedPoolClient
+    client = PipelinedPoolClient(addrs, f)
+    done, _ = await client.drive(requests, window=50, timeout=60.0)
+    assert len(done) == len(requests)
+
+
+async def _wait_for(predicate, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(0.2)
+    return False
+
+
+def test_observer_follows_live_pool_and_catches_up_after_restart(tcp_pool_4):
+    from plenum_tpu.node.observer_node import ObserverNode
+    from plenum_tpu.tools.genesis import load_genesis_files
+
+    tmp, names, specs, trustee_seed = tcp_pool_4
+    genesis = load_genesis_files(tmp)
+    addrs = {name: ("127.0.0.1", spec[3])
+             for name, spec in zip(names, specs)}
+    obs_dir = tempfile.mkdtemp(prefix="plenum_obs_data_")
+
+    async def scenario():
+        # phase 1: observer follows live traffic via pushes
+        stop = asyncio.Event()
+        obs = ObserverNode("observer1", genesis, addrs, f=1,
+                           data_dir=obs_dir, storage_backend="file")
+        task = asyncio.create_task(obs.run(stop))
+        await asyncio.sleep(1.0)                 # registrations land
+        await _drive(addrs, 1, _signed_nyms(trustee_seed,
+                                            [f"obs-a{i}" for i in range(5)]))
+        ledger = obs.observer.c.db.get_ledger(DOMAIN_LEDGER_ID)
+        assert await _wait_for(lambda: ledger.size >= 6), \
+            f"observer never applied pushes (size={ledger.size})"
+        live_root = ledger.root_hash
+
+        # phase 2: kill the observer (no clean shutdown of its stores),
+        # order traffic it never sees...
+        stop.set()
+        await task
+        await _drive(addrs, 1, _signed_nyms(trustee_seed,
+                                            [f"obs-b{i}" for i in range(5)]))
+
+        # phase 3: restart from its own data dir; the first push after
+        # restart carries roots binding the whole gap, which the observer
+        # fills with its OWN GET_TXN pulls — no helper callback
+        stop2 = asyncio.Event()
+        obs2 = ObserverNode("observer1", genesis, addrs, f=1,
+                            data_dir=obs_dir, storage_backend="file")
+        ledger2 = obs2.observer.c.db.get_ledger(DOMAIN_LEDGER_ID)
+        assert ledger2.size >= 6                 # durable recovery
+        task2 = asyncio.create_task(obs2.run(stop2))
+        await asyncio.sleep(1.0)
+        await _drive(addrs, 1, _signed_nyms(trustee_seed, ["obs-c0"]))
+        ok = await _wait_for(lambda: ledger2.size >= 12)
+        stop2.set()
+        await task2
+        assert ok, f"observer did not catch up (size={ledger2.size})"
+        assert ledger2.size == 12                # 1 genesis + 5 + 5 + 1
+        assert ledger2.root_hash != live_root
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        shutil.rmtree(obs_dir, ignore_errors=True)

@@ -207,3 +207,36 @@ def test_instance_change_votes_expire_at_load(tmp_path):
     trigger = node.master_replica.vc_trigger
     assert trigger._votes.get(1, {}) == {}    # expired vote not reloaded
     assert store.load(pool.config.INSTANCE_CHANGE_TIMEOUT) == {}  # and purged
+
+
+def test_backup_primary_resumes_last_sent_pp(tmp_path):
+    """A restarting BACKUP primary resumes its 3PC numbering from the
+    persisted last-sent PRE-PREPARE instead of re-issuing pp_seq_no 1
+    (ref last_sent_pp_store_helper.py). The master restores from the audit
+    ledger; backups have no audit trail — only this store."""
+    pool = _file_pool(tmp_path)
+    # view 0 primaries: inst 0 = Alpha (master), inst 1 = Beta
+    beta = pool.nodes["Beta"]
+    assert beta.replicas[1].data.is_primary
+
+    for i in range(3):
+        pool.submit(signed_nym(pool.trustee, _user(b"bp-u%d" % i), i + 1))
+        pool.run(2.0)
+    sent_before = beta.replicas[1].data.pp_seq_no
+    assert sent_before >= 1          # the backup primary really sent PPs
+
+    pool.crash_node("Beta")
+    beta = pool.start_node("Beta")
+    pool.net.connect_all()
+    # restored, not reset: the next PP it sends will be sent_before + 1
+    assert beta.replicas[1].data.pp_seq_no == sent_before
+    assert ("restored_backup_pp", (1, sent_before)) in list(beta.spylog)
+
+    # new traffic: the backup keeps ordering with fresh seq-nos on every
+    # node's shadow instance — a duplicate/gap would stall inst 1
+    pool.submit(signed_nym(pool.trustee, _user(b"bp-u9"), 9))
+    pool.run(8.0)
+    for name in pool.names:
+        inst1 = pool.nodes[name].replicas[1]
+        assert inst1.data.last_ordered_3pc[1] >= sent_before + 1, \
+            (name, inst1.data.last_ordered_3pc, sent_before)

@@ -347,3 +347,57 @@ def test_primary_crash_recovers_within_disconnect_timeout():
             assert elapsed < 25.0
 
     asyncio.run(main())
+
+
+def test_client_connection_flood_is_bounded():
+    """Client-stack connection budget (ref plenum/config.py:285-292):
+    a connection flood is capped at max_connections with the overflow
+    rejected; sweeping reclaims slots from idle connections so live
+    clients still get served after the flood."""
+    import time as _time
+
+    async def scenario():
+        stack = ClientStack("srv", "127.0.0.1", 0, on_request=None,
+                            max_connections=8, idle_timeout=0.5)
+        seen = []
+        stack._on_request = lambda msg, cid: seen.append((msg, cid))
+        port = await stack.bind()
+
+        # flood: 30 connections, each sending one frame to prove liveness
+        floods = []
+        for i in range(30):
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                from plenum_tpu.common.serialization import pack
+                payload = pack({"op": "NOOP", "i": i})
+                w.write(len(payload).to_bytes(4, "big") + payload)
+                await w.drain()
+                floods.append((r, w))
+            except OSError:
+                pass
+        await asyncio.sleep(0.3)
+        assert len(stack._conns) <= 8            # bounded, not 30
+        assert stack.rejected_connections >= 20
+
+        # flood connections go idle; a NEW client connects after the
+        # idle window and must be admitted via the sweep
+        await asyncio.sleep(0.6)
+        r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+        from plenum_tpu.common.serialization import pack as _pack
+        payload = _pack({"op": "LIVE"})
+        w2.write(len(payload).to_bytes(4, "big") + payload)
+        await w2.drain()
+        await asyncio.sleep(0.3)
+        stack.drain()
+        assert any(m.get("op") == "LIVE" for m, _ in seen)
+        assert len(stack._conns) <= 8
+
+        for _, w in floods:
+            try:
+                w.close()
+            except Exception:
+                pass
+        w2.close()
+        await stack.stop()
+
+    asyncio.run(scenario())
