@@ -125,8 +125,16 @@ def sample_process_gauges(collector: "MetricsCollector") -> None:
                             rss_pages * resource.getpagesize())
     except (OSError, ValueError, IndexError):
         pass                                   # non-procfs platform
-    counts = gc.get_count()
-    collector.add_event(MetricsName.GC_TRACKED_OBJECTS, sum(counts))
+    # a real leak signal: long-lived objects live in gen2, and its count
+    # only grows if the heap does (gc.get_count() is collection counters,
+    # bounded by the thresholds — useless for soak-leak detection). The
+    # gen2 list build is O(live objects); at the flush cadence (10 s)
+    # that is ~ms, not hot-path cost.
+    try:
+        tracked = len(gc.get_objects(generation=2))
+    except TypeError:                          # pre-3.8 signature
+        tracked = len(gc.get_objects())
+    collector.add_event(MetricsName.GC_TRACKED_OBJECTS, tracked)
     stats = gc.get_stats()
     if stats:
         collector.add_event(MetricsName.GC_GEN2_COLLECTIONS,
@@ -215,8 +223,17 @@ class KvMetricsCollector(MetricsCollector):
         self.accumulators.clear()
 
     def read_rows(self) -> list[tuple[float, str, dict]]:
-        rows = []
-        for key, value in self._storage.iterator():
-            ts_ms = int.from_bytes(key[:8], "big")
-            rows.append((ts_ms / 1000.0, key[8:].decode(), unpack(value)))
-        return rows
+        return rows_from_kv_items(self._storage.iterator())
+
+
+def rows_from_kv_items(items) -> list[tuple[float, str, dict]]:
+    """(key, value) pairs in the flush layout (ms-timestamp || name ->
+    msgpack fold) -> [(ts_s, name, fold)] sorted by time. The ONE parser
+    for the row format — KvMetricsCollector and tools.metrics_report
+    both go through here."""
+    rows = []
+    for key, value in items:
+        ts_ms = int.from_bytes(key[:8], "big")
+        rows.append((ts_ms / 1000.0, key[8:].decode(), unpack(value)))
+    rows.sort(key=lambda r: r[0])
+    return rows

@@ -81,6 +81,7 @@ class Config:
 
     # --- metrics (ref config.py METRICS_COLLECTOR_TYPE/flush) ---
     METRICS_FLUSH_INTERVAL: float = 10.0
+    QUEUE_GAUGE_SAMPLE_INTERVAL: float = 1.0
 
     # --- blacklisting (TTL: self-isolation must heal; see blacklister.py) ---
     BLACKLIST_TTL: float = 120.0
@@ -108,7 +109,6 @@ class Config:
     kv_backend: str = "memory"          # 'memory' | 'file'
 
     # --- misc ---
-    METRICS_FLUSH_INTERVAL: float = 60.0
     ACCEPTABLE_DEVIATION_PREPREPARE_SECS: float = 600.0
     TRACK_UNORDERED: bool = True
     OUTDATED_REQS_CHECK_INTERVAL: float = 60.0

@@ -141,6 +141,13 @@ class Node:
             self._metrics_flush_timer = RepeatingTimer(
                 timer, self.config.METRICS_FLUSH_INTERVAL,
                 self._flush_metrics)
+            # queue depths are sampled well below the flush cadence so the
+            # flushed fold's max/mean reflect depth UNDER load, not the
+            # drained snapshot at flush time (ref node.py:2289 dumps queue
+            # gauges the same way)
+            self._gauge_sample_timer = RepeatingTimer(
+                timer, self.config.QUEUE_GAUGE_SAMPLE_INTERVAL,
+                self._sample_queue_gauges)
         # shared crypto plane reports through the last-attached collector
         # (fill latency, dispatch wall time, batch size)
         verifier = getattr(components.authenticator.core_authenticator,
@@ -331,6 +338,7 @@ class Node:
         if not stored:
             return
         stale = False
+        survivors: list[tuple[int, int, int]] = []
         for inst_str, pair in stored.items():
             try:
                 inst_id, (view_no, pp_seq_no) = int(inst_str), pair
@@ -347,24 +355,16 @@ class Node:
             data.pp_seq_no = max(data.pp_seq_no, pp_seq_no)
             data.last_ordered_3pc = max(data.last_ordered_3pc,
                                         (view_no, pp_seq_no))
+            survivors.append((inst_id, view_no, pp_seq_no))
             self.spylog.append(("restored_backup_pp", (inst_id, pp_seq_no)))
         if stale:
-            # rewrite only the rows that survived restore
+            # rewrite exactly the rows the restore loop accepted — a dead
+            # row (wrong view OR not primary here) must not resurrect
             self._last_sent_pp.erase()
-            for inst_str, pair in stored.items():
-                try:
-                    inst_id = int(inst_str)
-                    if inst_id != 0 and inst_id in self.replicas and \
-                            pair[0] == self.replicas[inst_id].data.view_no:
-                        self._last_sent_pp.store(inst_id, pair[0], pair[1])
-                except (ValueError, TypeError, IndexError):
-                    continue
+            for inst_id, view_no, pp_seq_no in survivors:
+                self._last_sent_pp.store(inst_id, view_no, pp_seq_no)
 
-    def _flush_metrics(self) -> None:
-        """Sample queue depths + process RSS/GC gauges, then flush
-        accumulators to the KV store — all gauges ride the same cadence."""
-        from plenum_tpu.common.metrics import sample_process_gauges
-        sample_process_gauges(self.metrics)
+    def _sample_queue_gauges(self) -> None:
         self.metrics.add_event(MetricsName.CLIENT_INBOX_DEPTH,
                                len(self._client_inbox))
         self.metrics.add_event(MetricsName.PROPAGATE_INBOX_DEPTH,
@@ -373,7 +373,20 @@ class Node:
             MetricsName.REQUEST_QUEUE_DEPTH,
             sum(len(q) for q in
                 self.master_replica.ordering.request_queues.values()))
-        self.metrics.flush()
+
+    def _flush_metrics(self) -> None:
+        """Sample process RSS/GC gauges + one last queue sample, then flush
+        accumulators to the KV store. The in-flush flag lets signal
+        handlers (start_node's SIGTERM tail-flush) skip the call instead
+        of re-entering a KV append already on the stack."""
+        self._in_metrics_flush = True
+        try:
+            from plenum_tpu.common.metrics import sample_process_gauges
+            sample_process_gauges(self.metrics)
+            self._sample_queue_gauges()
+            self.metrics.flush()
+        finally:
+            self._in_metrics_flush = False
 
     def check_performance(self) -> None:
         if self.leecher.is_running:

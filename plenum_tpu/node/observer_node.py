@@ -44,9 +44,9 @@ from plenum_tpu.node.observer import NodeObserver
 logger = logging.getLogger(__name__)
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> bytes:
-    hdr = await reader.readexactly(4)
-    return await reader.readexactly(int.from_bytes(hdr, "big"))
+# one wire-framing implementation for the whole package: length-prefixed
+# frames with the transport's max-frame guard
+from plenum_tpu.network.tcp_stack import HandshakeError, _read_frame
 
 
 class ObserverNode:
@@ -98,6 +98,13 @@ class ObserverNode:
                 await self._read_loop(validator, reader)
             except (OSError, asyncio.IncompleteReadError):
                 pass
+            except HandshakeError as e:
+                # shared _read_frame rejects oversize/desynced frames; drop
+                # the stream and redial rather than killing this
+                # validator's maintain task (which would silently shrink
+                # the f+1 push quorum)
+                logger.warning("%s: bad frame from %s (%s); reconnecting",
+                               self.name, validator, e)
             finally:
                 self._conns.pop(validator, None)
                 try:
@@ -188,6 +195,15 @@ class ObserverNode:
         key = (batch.ledger_id, batch.seq_no_start)
         digest = hashlib.sha256(
             signing_serialize(batch.to_dict())).hexdigest()
+        # one in-flight gap vote per validator per ledger: a new start from
+        # the same validator supersedes its old one, so the bucket count is
+        # bounded by pool size — a Byzantine pusher minting ever-new starts
+        # can no longer grow the buffer without bound
+        for other_key, other_votes in list(self._gap_votes.items()):
+            if other_key[0] == batch.ledger_id and other_key != key:
+                other_votes.pop(validator, None)
+                if not other_votes:
+                    del self._gap_votes[other_key]
         votes = self._gap_votes.setdefault(key, {})
         votes[validator] = (digest, batch)
         if sum(1 for d, _ in votes.values()

@@ -18,6 +18,31 @@ _PUT, _DEL = 0, 1
 _HDR = struct.Struct(">BII")  # op, key_len, value_len
 
 
+def read_log_readonly(path: str, name: str = "kv") -> list[tuple[bytes, bytes]]:
+    """Replay a KvFile log WITHOUT opening it for append, truncating a torn
+    tail, or compacting — safe against a store another process is writing.
+    Torn/corrupt tails are simply ignored. -> sorted [(key, value)]."""
+    file_path = os.path.join(path, name + ".kvlog")
+    mem = KvMemory()
+    if not os.path.exists(file_path):
+        return []
+    with open(file_path, "rb") as fh:
+        data = fh.read()
+    off, n = 0, len(data)
+    while off + _HDR.size <= n:
+        op, klen, vlen = _HDR.unpack_from(data, off)
+        if op not in (_PUT, _DEL) or off + _HDR.size + klen + vlen > n:
+            break
+        off += _HDR.size
+        key = data[off:off + klen]; off += klen
+        val = data[off:off + vlen]; off += vlen
+        if op == _PUT:
+            mem.put(key, val)
+        else:
+            mem.remove(key)
+    return list(mem.iterator())
+
+
 class KvFile(KeyValueStorage):
     def __init__(self, path: str, name: str = "kv"):
         os.makedirs(path, exist_ok=True)

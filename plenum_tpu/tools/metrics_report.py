@@ -1,0 +1,170 @@
+"""Operator-facing analyzer for flushed node metrics.
+
+Reference behavior: scripts/process_logs + scripts/log_stats — turn a
+node's on-disk metrics history into per-metric statistics and a derived
+health summary an operator can read. Here the source is the msgpack rows
+a KvMetricsCollector flushes (common/metrics.py), one store per node at
+<base-dir>/<name>/metrics (written by tools.start_node).
+
+    python -m plenum_tpu.tools.metrics_report <base-dir> [--node Node1]
+        [--last 300] [--json]
+
+With no --node, every `<base-dir>/*/metrics` store found is reported
+(and the derived pool summary aggregates across them).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def read_store(path: str) -> list[tuple[float, str, dict]]:
+    """metrics dir -> [(ts, name, fold)] sorted by ts. GENUINELY
+    read-only: never truncates a torn tail or compacts, so it is safe to
+    run against a store a live node is appending to."""
+    from plenum_tpu.common.metrics import rows_from_kv_items
+    from plenum_tpu.storage.kv_file import read_log_readonly
+    return rows_from_kv_items(read_log_readonly(path))
+
+
+def fold_rows(rows: list[tuple[float, str, dict]]) -> dict[str, dict]:
+    """Merge per-flush folds into one per-metric fold over the window.
+
+    Each stored fold is {count, sum, min, max} (Accumulator.to_dict).
+    `last` keeps the most recent flush's mean — the right reading for
+    gauges sampled at flush time (queue depths, RSS).
+    """
+    out: dict[str, dict] = {}
+    for ts, name, fold in rows:
+        agg = out.setdefault(name, {
+            "count": 0, "sum": 0.0, "min": None, "max": None,
+            "first_ts": ts, "last_ts": ts, "last": None, "flushes": 0})
+        agg["count"] += fold.get("count", 0)
+        agg["sum"] += fold.get("sum", 0.0)
+        for k, pick in (("min", min), ("max", max)):
+            v = fold.get(k)
+            if v is not None:
+                agg[k] = v if agg[k] is None else pick(agg[k], v)
+        agg["last_ts"] = ts
+        agg["flushes"] += 1
+        if fold.get("count"):
+            agg["last"] = fold["sum"] / fold["count"]
+    for agg in out.values():
+        agg["mean"] = agg["sum"] / agg["count"] if agg["count"] else None
+    return out
+
+
+def derive_summary(folds: dict[str, dict], span_s: float,
+                   windowed: bool = False) -> dict:
+    """Pool-health figures an operator actually asks for."""
+    def s(name):            # total over window
+        return folds.get(name, {}).get("sum") or 0.0
+
+    def mean(name):
+        return folds.get(name, {}).get("mean")
+
+    def last(name):
+        return folds.get(name, {}).get("last")
+
+    txns = s("node.ordered_batch_size")
+    # gc_pause_time is a CUMULATIVE counter sampled at each flush. Full
+    # run: the latest value (max) IS the run's total, since the timer
+    # starts at 0 with the process. Trailing window: the delta across
+    # the window's flushes.
+    gp = folds.get("process.gc_pause_time", {})
+    if windowed and gp.get("flushes", 0) > 1:
+        gc_pause = (gp.get("max") or 0.0) - (gp.get("min") or 0.0)
+    else:
+        gc_pause = gp.get("max") or 0.0
+    out = {
+        "window_s": round(span_s, 1),
+        "txns_ordered": int(txns),
+        "tps": round(txns / span_s, 1) if span_s > 0 else None,
+        "mean_batch_size": mean("node.ordered_batch_size"),
+        "prepare_phase_ms": _ms(mean("consensus.prepare_phase_time")),
+        "commit_phase_ms": _ms(mean("consensus.commit_phase_time")),
+        "ordering_ms": _ms(mean("consensus.ordering_time")),
+        "view_changes": int(s("consensus.view_changes")),
+        "suspicions": int(s("consensus.suspicions")),
+        "catchups": int(s("consensus.catchups")),
+        "client_inbox_depth_max": folds.get("node.client_inbox_depth",
+                                            {}).get("max"),
+        "propagate_inbox_depth_max": folds.get("node.propagate_inbox_depth",
+                                               {}).get("max"),
+        "request_queue_depth_max": folds.get("consensus.request_queue_depth",
+                                             {}).get("max"),
+        "request_queue_depth_mean": mean("consensus.request_queue_depth"),
+        "gc_pause_s": round(gc_pause, 2),
+        "gc_pause_pct": round(100 * gc_pause / span_s, 2) if span_s else None,
+        "rss_mb_last": (last("process.rss_bytes") or 0) / 1e6 or None,
+    }
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def _ms(v):
+    return round(v * 1000, 2) if v is not None else None
+
+
+def report_node(path: str, last_s: float | None):
+    rows = read_store(path)
+    if last_s and rows:
+        cutoff = rows[-1][0] - last_s
+        rows = [r for r in rows if r[0] >= cutoff]
+    folds = fold_rows(rows)
+    span = (rows[-1][0] - rows[0][0]) if len(rows) > 1 else 0.0
+    return folds, derive_summary(folds, span, windowed=last_s is not None)
+
+
+def _print_table(folds: dict[str, dict]) -> None:
+    hdr = f"{'metric':42} {'count':>8} {'mean':>12} {'min':>10} {'max':>10}"
+    print(hdr)
+    print("-" * len(hdr))
+    for name in sorted(folds):
+        a = folds[name]
+        fmt = lambda v: f"{v:.4g}" if isinstance(v, (int, float)) else "-"
+        print(f"{name:42} {a['count']:>8} {fmt(a['mean']):>12}"
+              f" {fmt(a['min']):>10} {fmt(a['max']):>10}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("base_dir")
+    ap.add_argument("--node", default=None,
+                    help="single node name (default: all found)")
+    ap.add_argument("--last", type=float, default=None, metavar="SECONDS",
+                    help="only the trailing window")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.node:
+        paths = [os.path.join(args.base_dir, args.node, "metrics")]
+    else:
+        paths = sorted(glob.glob(os.path.join(args.base_dir, "*", "metrics")))
+    paths = [p for p in paths if os.path.isdir(p)]
+    if not paths:
+        print(json.dumps({"error": f"no metrics stores under {args.base_dir}"}))
+        return 1
+
+    all_out = {}
+    for p in paths:
+        name = os.path.basename(os.path.dirname(p))
+        folds, summary = report_node(p, args.last)
+        all_out[name] = {"summary": summary,
+                         "metrics": {k: {kk: vv for kk, vv in v.items()
+                                         if kk in ("count", "mean", "min",
+                                                   "max", "last")}
+                                     for k, v in folds.items()}}
+        if not args.json:
+            print(f"\n=== {name} ===")
+            _print_table(folds)
+            print("\nderived:", json.dumps(summary, indent=2))
+    if args.json:
+        print(json.dumps(all_out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

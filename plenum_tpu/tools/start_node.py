@@ -53,6 +53,16 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
         crypto_backend=backend,
         bls_seed=bytes.fromhex(keys["bls_seed"])).build()
     timer = QueueTimer(time.perf_counter)
+    # durable metrics history next to the node's keys so operators can run
+    # tools.metrics_report after (or during) a run — the reference flushes
+    # to a RocksDB metrics store the same way (KvStoreMetricsCollector,
+    # common/metrics_collector.py:428) and analyzes it with process_logs.
+    # Kept even with --kv memory: the node data may be ephemeral, but the
+    # performance history is what post-mortems need.
+    from plenum_tpu.common.metrics import KvMetricsCollector
+    from plenum_tpu.storage.kv_file import KvFile
+    metrics = KvMetricsCollector(
+        KvFile(os.path.join(base_dir, name, "metrics")))
     node_stack = TcpStack(name, my_ha[0], my_ha[1], registry,
                           seed=bytes.fromhex(keys["seed"]))
     config = Config(crypto_backend=backend, kv_backend=kv)
@@ -61,7 +71,8 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
                                max_connections=config.MAX_CONNECTED_CLIENTS,
                                idle_timeout=config.CLIENT_CONN_IDLE_TIMEOUT)
     node = Node(name, timer, node_stack.bus, components,
-                client_send=client_stack.send, config=config)
+                client_send=client_stack.send, config=config,
+                metrics=metrics)
     # late-bound: the recorder may wrap handle_client_message below, and the
     # client stack must call through the WRAPPED method
     client_stack._on_request = \
@@ -114,9 +125,10 @@ def main(argv=None):
 
     prodable, node, _ = build_node(args.name, args.base_dir, args.backend,
                                    args.kv, record=args.record)
+    import signal as _signal
+    profiler = None
     if args.profile:
         import cProfile
-        import signal as _signal
         # CPU-time timer, not wall: bench pools timeshare one core, and a
         # wall-clock profile would charge each function for time spent
         # preempted (sum across N processes then exceeds wall by ~Nx).
@@ -124,12 +136,22 @@ def main(argv=None):
         profiler = cProfile.Profile(time.process_time)
         profiler.enable()
 
-        def _dump_and_exit(signum, frame):
+    def _finalize_and_exit(signum, frame):
+        if profiler is not None:
             profiler.disable()
             profiler.dump_stats(args.profile)
-            os._exit(0)
+        try:
+            # capture the tail of the run: gauges + accumulators since the
+            # last periodic flush would otherwise die with the process.
+            # Skip if the signal landed INSIDE a periodic flush — a
+            # re-entered KV append would interleave torn records.
+            if not getattr(node, "_in_metrics_flush", False):
+                node._flush_metrics()
+        except Exception:
+            pass
+        os._exit(0)
 
-        _signal.signal(_signal.SIGTERM, _dump_and_exit)
+    _signal.signal(_signal.SIGTERM, _finalize_and_exit)
     looper = Looper()
     looper.add(prodable)
 

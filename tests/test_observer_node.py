@@ -134,3 +134,34 @@ def test_observer_follows_live_pool_and_catches_up_after_restart(tcp_pool_4):
         asyncio.run(scenario())
     finally:
         shutil.rmtree(obs_dir, ignore_errors=True)
+
+
+def test_gap_vote_buffer_bounded_per_validator():
+    """A Byzantine validator minting ever-new seq_no_start values must hold
+    at most ONE gap-vote bucket per ledger; honest f+1 quorum still arms."""
+    from unittest.mock import MagicMock
+
+    from plenum_tpu.common.node_messages import BatchCommitted
+    from plenum_tpu.node.observer_node import ObserverNode
+
+    def mk(start):
+        return BatchCommitted(requests=(), ledger_id=1, inst_id=0, view_no=0,
+                              pp_seq_no=start, pp_time=0.0,
+                              state_root="00" * 32, txn_root="00" * 32,
+                              seq_no_start=start, seq_no_end=start)
+
+    obs = ObserverNode.__new__(ObserverNode)
+    obs._gap_votes = {}
+    inner = MagicMock()
+    inner.f = 1
+    ledger = MagicMock()
+    ledger.size = 0
+    inner.c.db.get_ledger.return_value = ledger
+    obs.observer = inner
+
+    for start in range(100, 1100):
+        obs._gap_quorum("Evil", mk(start))
+    assert len(obs._gap_votes) == 1
+
+    assert not obs._gap_quorum("A", mk(50))
+    assert obs._gap_quorum("B", mk(50))

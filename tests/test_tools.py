@@ -208,3 +208,44 @@ def test_pipelined_client_survives_dead_node_and_reuse():
             s.close()
 
     asyncio.run(main())
+
+
+def test_metrics_report_reads_flushed_history(tmp_path):
+    """tools.metrics_report turns a node's flushed metrics store into
+    per-metric folds and a derived summary (ref scripts/process_logs)."""
+    from plenum_tpu.common.metrics import KvMetricsCollector, MetricsName
+    from plenum_tpu.storage.kv_file import KvFile
+    from plenum_tpu.tools.metrics_report import main as report_main, report_node
+
+    mdir = tmp_path / "Node1" / "metrics"
+    clock = [1000.0]
+    m = KvMetricsCollector(KvFile(str(mdir)), now=lambda: clock[0])
+    for tick in range(3):
+        for _ in range(10):
+            m.add_event(MetricsName.ORDERED_BATCH_SIZE, 5)
+        m.add_event(MetricsName.PREPARE_PHASE_TIME, 0.040)
+        m.add_event(MetricsName.CLIENT_INBOX_DEPTH, tick)  # gauge: last wins
+        m.flush()
+        clock[0] += 10.0
+
+    folds, summary = report_node(str(mdir), last_s=None)
+    assert folds["node.ordered_batch_size"]["count"] == 30
+    assert summary["txns_ordered"] == 150
+    assert summary["window_s"] == 20.0            # 3 flushes, 10 s apart
+    assert summary["tps"] == 7.5                  # 150 txns / 20 s
+    assert summary["prepare_phase_ms"] == 40.0
+    assert summary["client_inbox_depth_max"] == 2
+
+    # the trailing-window filter drops the first flush
+    _, tail = report_node(str(mdir), last_s=10.0)
+    assert tail["txns_ordered"] == 100
+
+    # CLI over the whole base dir, machine-readable
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = report_main([str(tmp_path), "--json"])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    assert out["Node1"]["summary"]["txns_ordered"] == 150
