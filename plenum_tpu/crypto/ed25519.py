@@ -416,4 +416,10 @@ def make_verifier(backend: str, min_batch: int = 1) -> Ed25519Verifier:
         # deferred: parallel/ pulls in jax.sharding + the SPMD plane
         from plenum_tpu.parallel.crypto_plane import make_sharded_verifier
         return make_sharded_verifier(min_batch=min_batch)
+    if backend == "service":
+        # cross-process crypto plane: the device has ONE owner process
+        # and co-hosted nodes ship batches to it (socket path from
+        # PLENUM_CRYPTO_SOCKET); see parallel/crypto_service.py
+        from plenum_tpu.parallel.crypto_service import ServiceEd25519Verifier
+        return ServiceEd25519Verifier()
     return CpuEd25519Verifier()

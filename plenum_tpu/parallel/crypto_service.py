@@ -1,0 +1,337 @@
+"""Cross-process crypto plane: one device owner, many node clients.
+
+Why this exists (measured, round 4): (a) the TPU behind the tunnel is a
+single device — four OS-process nodes each initializing their own jax
+backend wedge on device contention (tcp_pool backend=jax ordered 0
+txns), so the device needs ONE owner process; (b) every client request
+is signature-verified by all n co-hosted nodes (the propagate path,
+ref plenum/server/client_authn.py:273 runs on every node), which the
+7-node scaling analysis (docs/performance.md) names as part of the
+dominant cost — a host-wide verdict cache collapses those n
+verifications into one.
+
+Design: an asyncio unix-socket server fronting a single inner
+`Ed25519Verifier` (cpu | jax | jax-sharded via the existing factory
+seam). A worker thread drains a queue of client batches: everything
+that arrives while the previous device dispatch runs is coalesced into
+the next one — the cross-process generalization of CoalescingVerifier
+(crypto/ed25519.py), with the same natural backpressure. Verdicts are
+cached by content digest (bounded FIFO), so a request already verified
+for node A is free for nodes B..N.
+
+Wire: 4-byte big-endian length frames, msgpack maps.
+  request  {"id": u64, "items": [[msg, sig, vk], ...]}
+  reply    {"id": u64, "verdicts": [0|1, ...]}
+  request  {"op": "stats"} -> server counters (ops tooling).
+
+Server:  python -m plenum_tpu.parallel.crypto_service --socket PATH \
+             [--backend cpu|jax|jax-sharded] [--min-batch N]
+Client:  make_verifier("service") with PLENUM_CRYPTO_SOCKET set, or
+         ServiceEd25519Verifier(path) directly.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from plenum_tpu.common.serialization import pack, unpack
+from plenum_tpu.crypto.ed25519 import Ed25519Verifier, VerifyItem
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024
+DEFAULT_SOCKET = "/tmp/plenum_crypto.sock"
+CACHE_SIZE = 65536
+
+
+def _digest(msg: bytes, sig: bytes, vk: bytes) -> bytes:
+    # EVERY field is length-prefixed: without the prefixes an attacker
+    # could shift bytes between sig and vk ((msg, sig+vk[:1], vk[1:])
+    # hashes identically), pre-poison the cache with a False verdict for
+    # a digest an honest (msg, sig, vk) later maps to, and make every
+    # co-hosted node reject a validly signed request
+    h = hashlib.sha256()
+    for part in (msg, sig, vk):
+        h.update(len(part).to_bytes(4, "big"))
+        h.update(part)
+    return h.digest()
+
+
+class CryptoPlaneServer:
+    """Owns the inner verifier; coalesces client batches in a worker
+    thread so the asyncio loop never blocks on a device dispatch."""
+
+    def __init__(self, inner: Ed25519Verifier,
+                 socket_path: str = DEFAULT_SOCKET,
+                 cache_size: int = CACHE_SIZE):
+        self._inner = inner
+        self.socket_path = socket_path
+        self._q: "queue.Queue" = queue.Queue()
+        # content-digest -> bool; FIFO-bounded like the verkey cache
+        # (attacker-supplied keys must not grow it without bound)
+        self._cache: dict[bytes, bool] = {}
+        self._cache_size = cache_size
+        self.stats = {"batches": 0, "items": 0, "cache_hits": 0,
+                      "dispatches": 0, "dispatched_items": 0}
+        self._server = None
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # --- worker thread: the only place the inner verifier runs ----------
+
+    def _drain(self, first) -> list:
+        jobs = [first]
+        while True:
+            try:
+                jobs.append(self._q.get_nowait())
+            except queue.Empty:
+                return jobs
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            jobs = self._drain(first)   # coalesce everything queued
+            # unique uncached items across all jobs -> one dispatch
+            todo: dict[bytes, int] = {}
+            items: list[VerifyItem] = []
+            for _done, batch, digests in jobs:
+                for it, d in zip(batch, digests):
+                    if d not in self._cache and d not in todo:
+                        todo[d] = len(items)
+                        items.append(it)
+            new: dict[bytes, bool] = {}
+            if items:
+                verdicts = self._inner.verify_batch(items)
+                self.stats["dispatches"] += 1
+                self.stats["dispatched_items"] += len(items)
+                new = {d: bool(verdicts[idx]) for d, idx in todo.items()}
+            # resolve every job from (new | pre-existing cache) BEFORE
+            # eviction can touch the entries these verdicts came from
+            for done, batch, digests in jobs:
+                hits = sum(1 for d in digests if d not in new)
+                self.stats["cache_hits"] += hits
+                self.stats["batches"] += 1
+                self.stats["items"] += len(batch)
+                done([new[d] if d in new else self._cache.get(d, False)
+                      for d in digests])
+            self._cache.update(new)
+            if len(self._cache) > self._cache_size:
+                # FIFO eviction in bulk; dict preserves insert order
+                drop = len(self._cache) - self._cache_size
+                for k in list(self._cache)[:drop]:
+                    del self._cache[k]
+
+    # --- asyncio front end ----------------------------------------------
+
+    async def _process(self, req: dict, writer, wlock) -> None:
+        """One request end-to-end; runs as its own task so a connection's
+        pipelined batches overlap (submit B2 while B1 is on the device)
+        instead of serializing behind each other's replies."""
+        import asyncio
+        loop = asyncio.get_running_loop()
+        try:
+            if req.get("op") == "stats":
+                payload = pack(dict(self.stats,
+                                    cache_size=len(self._cache)))
+            else:
+                rid = req["id"]
+                batch = [(bytes(m), bytes(s), bytes(v))
+                         for m, s, v in req["items"]]
+                digests = [_digest(*it) for it in batch]
+                fut = loop.create_future()
+                self._q.put((lambda verdicts, f=fut:
+                             loop.call_soon_threadsafe(f.set_result,
+                                                       verdicts),
+                             batch, digests))
+                verdicts = await fut
+                payload = pack({"id": rid,
+                                "verdicts": [int(v) for v in verdicts]})
+            async with wlock:
+                writer.write(_LEN.pack(len(payload)) + payload)
+                await writer.drain()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # schema garbage / dead writer: this request dies, the plane
+            # (and the connection's other in-flight requests) live on
+            writer.close()
+
+    async def _handle(self, reader, writer) -> None:
+        import asyncio
+        wlock = asyncio.Lock()
+        tasks: set = set()
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                length = _LEN.unpack(hdr)[0]
+                if length > MAX_FRAME:
+                    return
+                req = unpack(await reader.readexactly(length))
+                t = asyncio.create_task(self._process(req, writer, wlock))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception:
+            # malformed frame (bad msgpack, wrong schema): drop THIS
+            # connection; the plane itself must survive garbage clients
+            pass
+        finally:
+            for t in tasks:
+                t.cancel()
+            writer.close()
+
+    async def start(self) -> None:
+        import asyncio
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        daemon=True)
+        self._worker.start()
+        self._server = await asyncio.start_unix_server(
+            self._handle, path=self.socket_path)
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+
+class ServiceEd25519Verifier(Ed25519Verifier):
+    """Client side of the plane: ships batches to the owner process over
+    a unix socket. Implements the same submit/collect token protocol as
+    the in-process verifiers, so node pipelining works unchanged.
+
+    Thread-safety: one socket, one lock; replies are matched by id so
+    multiple outstanding submits are fine."""
+
+    def __init__(self, socket_path: Optional[str] = None,
+                 connect_timeout: float = 5.0):
+        self.socket_path = socket_path or os.environ.get(
+            "PLENUM_CRYPTO_SOCKET", DEFAULT_SOCKET)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(connect_timeout)
+        self._sock.connect(self.socket_path)   # fail fast: operator error
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._replies: dict[int, list] = {}
+        # partial frame bytes survive across non-blocking polls — throwing
+        # them away on BlockingIOError would desync the framing forever
+        self._rxbuf = b""
+
+    def _send(self, obj) -> None:
+        payload = pack(obj)
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def _parse_frame(self):
+        if len(self._rxbuf) < 4:
+            return None
+        length = _LEN.unpack(self._rxbuf[:4])[0]
+        if len(self._rxbuf) < 4 + length:
+            return None
+        payload = self._rxbuf[4:4 + length]
+        self._rxbuf = self._rxbuf[4 + length:]
+        return unpack(payload)
+
+    def _recv(self, block: bool = True):
+        """Next complete frame, buffering partial reads. None when
+        non-blocking and no complete frame is available yet."""
+        while True:
+            frame = self._parse_frame()
+            if frame is not None:
+                return frame
+            if block:
+                chunk = self._sock.recv(65536)
+            else:
+                self._sock.setblocking(False)
+                try:
+                    chunk = self._sock.recv(65536)
+                except BlockingIOError:
+                    return None
+                finally:
+                    self._sock.setblocking(True)
+            if not chunk:
+                raise ConnectionError("crypto service closed")
+            self._rxbuf += chunk
+
+    def submit_batch(self, items: Sequence[VerifyItem]):
+        items = [(bytes(m), bytes(s), bytes(v)) for m, s, v in items]
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._send({"id": rid, "items": items})
+        return (rid, len(items))
+
+    def collect_batch(self, token, wait: bool = True):
+        rid, n = token
+        with self._lock:
+            while rid not in self._replies:
+                reply = self._recv(block=wait)
+                if reply is None:
+                    return None
+                self._replies[reply["id"]] = reply["verdicts"]
+            verdicts = self._replies.pop(rid)
+        return np.array(verdicts, dtype=bool)
+
+    def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        return self.collect_batch(self.submit_batch(items), wait=True)
+
+    def stats(self) -> dict:
+        with self._lock:
+            self._send({"op": "stats"})
+            while True:
+                reply = self._recv()
+                if "id" in reply:        # verify reply racing ahead of ours
+                    self._replies[reply["id"]] = reply["verdicts"]
+                    continue
+                return reply
+
+
+def main(argv=None):
+    import asyncio
+
+    from plenum_tpu.crypto.ed25519 import make_verifier
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--socket", default=DEFAULT_SOCKET)
+    ap.add_argument("--backend", default="cpu",
+                    choices=["cpu", "jax", "jax-sharded"])
+    ap.add_argument("--min-batch", type=int, default=128)
+    args = ap.parse_args(argv)
+
+    inner = make_verifier(args.backend, min_batch=args.min_batch)
+    server = CryptoPlaneServer(inner, socket_path=args.socket)
+
+    async def run():
+        await server.start()
+        print(json.dumps({"crypto_service": args.socket,
+                          "backend": args.backend}), flush=True)
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -114,7 +114,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--name", required=True)
     ap.add_argument("--base-dir", required=True)
-    ap.add_argument("--backend", default="cpu", choices=["cpu", "jax"])
+    ap.add_argument("--backend", default="cpu",
+                    choices=["cpu", "jax", "service"])
     ap.add_argument("--kv", default="file", choices=["file", "memory"])
     ap.add_argument("--record", action="store_true",
                     help="record all ingress for offline replay")

@@ -102,7 +102,8 @@ async def drive_load(addrs, f, requests, window: int, timeout: float):
 
 def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                  base_dir: str | None = None, timeout: float = 120.0,
-                 profile_dir: str | None = None) -> dict:
+                 profile_dir: str | None = None,
+                 service_min_batch: int = 128) -> dict:
     from plenum_tpu.client.wallet import Wallet
     from plenum_tpu.execution.txn import NYM
 
@@ -114,7 +115,37 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
 
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     procs = []
+    service_proc = None
+    # "service:<inner>" runs the cross-process crypto plane: ONE process
+    # owns the device/verifier, nodes ship batches to it (the topology a
+    # single TPU chip requires — n processes initializing jax wedge on
+    # device contention; parallel/crypto_service.py)
     try:
+        if backend.startswith("service:"):
+            inner = backend.split(":", 1)[1]
+            sock_path = os.path.join(tmp, "crypto.sock")
+            service_env = dict(env)
+            if inner.startswith("jax"):
+                # the service owns the real device; nodes keep
+                # JAX_PLATFORMS=cpu
+                service_env.pop("JAX_PLATFORMS", None)
+            service_proc = subprocess.Popen(
+                [sys.executable, "-m", "plenum_tpu.parallel.crypto_service",
+                 "--socket", sock_path, "--backend", inner,
+                 "--min-batch", str(service_min_batch)],
+                env=service_env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            deadline = time.perf_counter() + 240.0   # jax init can compile
+            while time.perf_counter() < deadline:
+                if os.path.exists(sock_path):
+                    break
+                if service_proc.poll() is not None:
+                    raise RuntimeError("crypto service died during startup")
+                time.sleep(0.2)
+            else:
+                raise RuntimeError("crypto service never bound its socket")
+            env = dict(env, PLENUM_CRYPTO_SOCKET=sock_path)
+            backend = "service"
         for name in names:
             cmd = [sys.executable, "-m", "plenum_tpu.tools.start_node",
                    "--name", name, "--base-dir", tmp, "--kv", "memory",
@@ -144,7 +175,17 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
             drive_load(addrs, f, requests, window=100, timeout=timeout))
         t_total = (max(done.values()) - t0) if done else 0.0
         lat = sorted(done[k] - submit_times[k] for k in done)
+        service_stats = None
+        if service_proc is not None:
+            try:
+                from plenum_tpu.parallel.crypto_service import \
+                    ServiceEd25519Verifier
+                service_stats = ServiceEd25519Verifier(
+                    socket_path=env["PLENUM_CRYPTO_SOCKET"]).stats()
+            except Exception:
+                pass
         return {
+            **({"crypto_service": service_stats} if service_stats else {}),
             "transport": "tcp", "nodes": n_nodes, "backend": backend,
             "txns_ordered": len(done), "txns_requested": n_txns,
             "seconds": round(t_total, 3),
@@ -162,6 +203,12 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+        if service_proc is not None:
+            service_proc.terminate()
+            try:
+                service_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                service_proc.kill()
         if base_dir is None:
             shutil.rmtree(tmp, ignore_errors=True)
 
@@ -170,7 +217,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
     ap.add_argument("--txns", type=int, default=200)
-    ap.add_argument("--backend", default="cpu", choices=["cpu", "jax"])
+    ap.add_argument("--backend", default="cpu",
+                    choices=["cpu", "jax", "service:cpu", "service:jax",
+                             "service:jax-sharded"])
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     stats = run_tcp_pool(args.nodes, args.txns, args.backend)

@@ -1,0 +1,136 @@
+"""Cross-process crypto plane (parallel/crypto_service.py): one device
+owner, many clients; coalescing, verdict cache, and the OS-process pool
+topology it exists for."""
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+
+import numpy as np
+import pytest
+
+
+def _make_items(n, signers=4, tag=b""):
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    sgs = [Ed25519Signer((b"svc%d" % i).ljust(32, b"\0")) for i in range(signers)]
+    out = []
+    for i in range(n):
+        s = sgs[i % signers]
+        msg = tag + b"payload-%d" % i
+        out.append((msg, s.sign(msg), s.verkey))
+    return out
+
+
+@pytest.fixture
+def service(tmp_path):
+    """A live server on a CPU verifier + a factory for connected clients."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.crypto_service import (CryptoPlaneServer,
+                                                    ServiceEd25519Verifier)
+    sock = str(tmp_path / "crypto.sock")
+    server = CryptoPlaneServer(CpuEd25519Verifier(), socket_path=sock)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def run():
+        await server.start()
+        started.set()
+        while not server._stop.is_set():
+            await asyncio.sleep(0.05)
+        await server.stop()
+
+    t = threading.Thread(target=lambda: loop.run_until_complete(run()),
+                         daemon=True)
+    t.start()
+    assert started.wait(5.0)
+    clients = []
+
+    def connect():
+        c = ServiceEd25519Verifier(socket_path=sock)
+        clients.append(c)
+        return c
+
+    yield server, connect
+    server._stop.set()
+    t.join(timeout=5.0)
+
+
+def test_verdicts_match_direct_verification(service):
+    server, connect = service
+    ver = connect()
+    items = _make_items(12)
+    # corrupt two: flipped sig byte, wrong key
+    items[3] = (items[3][0], items[3][1][:32] + bytes(32), items[3][2])
+    items[7] = (items[7][0], items[7][1], items[0][2])
+    out = ver.verify_batch(items)
+    expected = np.ones(12, dtype=bool)
+    expected[3] = expected[7] = False
+    assert (out == expected).all()
+
+
+def test_cache_dedupes_across_clients(service):
+    server, connect = service
+    a, b = connect(), connect()
+    items = _make_items(20, tag=b"dedup")
+    assert a.verify_batch(items).all()
+    dispatched_before = server.stats["dispatched_items"]
+    assert b.verify_batch(items).all()          # same content, other client
+    # nothing new dispatched: B rode A's cached verdicts
+    assert server.stats["dispatched_items"] == dispatched_before
+    assert server.stats["cache_hits"] >= 20
+
+
+def test_pipelined_submit_collect(service):
+    _, connect = service
+    ver = connect()
+    t1 = ver.submit_batch(_make_items(5, tag=b"one"))
+    t2 = ver.submit_batch(_make_items(5, tag=b"two"))
+    # out-of-order collection: replies are matched by id
+    assert ver.collect_batch(t2, wait=True).all()
+    assert ver.collect_batch(t1, wait=True).all()
+
+
+def test_malformed_items_are_false_not_fatal(service):
+    _, connect = service
+    ver = connect()
+    good = _make_items(2)
+    bad = [(b"msg", b"short-sig", b"short-key"), good[0], (b"", b"", b"")]
+    out = ver.verify_batch(bad)
+    assert list(out) == [False, True, False]
+
+
+def test_connect_fails_fast_without_server(tmp_path):
+    from plenum_tpu.parallel.crypto_service import ServiceEd25519Verifier
+    with pytest.raises(OSError):
+        ServiceEd25519Verifier(socket_path=str(tmp_path / "nope.sock"))
+
+
+def test_tcp_pool_over_crypto_service():
+    """The topology this exists for: a 4-process pool whose nodes all
+    verify through ONE crypto-plane process (backend service:cpu), with
+    the verdict cache collapsing per-node re-verification."""
+    from plenum_tpu.tools.tcp_pool import run_tcp_pool
+    r = run_tcp_pool(n_nodes=4, n_txns=60, backend="service:cpu",
+                     timeout=90.0)
+    assert r["txns_ordered"] == 60, r
+    stats = r.get("crypto_service")
+    assert stats, "service stats missing from the bench result"
+    # 4 nodes x 60 requests: without the cache the plane would dispatch
+    # ~4x the unique signatures; with it, roughly one dispatch per unique
+    # signature (trustee + 60 users, plus handshake traffic)
+    assert stats["cache_hits"] > 0
+    assert stats["dispatched_items"] < stats["items"]
+
+
+def test_cache_poisoning_by_field_shift_rejected(service):
+    """(msg, sig+vk[:1], vk[1:]) must NOT share a cache digest with the
+    honest (msg, sig, vk): every field is length-prefixed. An attacker
+    pre-submitting the shifted triple (malformed -> False) must not make
+    the plane reject the honest signature afterwards."""
+    _, connect = service
+    attacker, honest = connect(), connect()
+    (msg, sig, vk) = _make_items(1, tag=b"poison")[0]
+    shifted = (msg, sig + vk[:1], vk[1:])
+    assert not attacker.verify_batch([shifted]).any()   # cached False
+    assert honest.verify_batch([(msg, sig, vk)]).all()  # unaffected
