@@ -10,7 +10,21 @@ from __future__ import annotations
 import hashlib
 from typing import Any, Optional
 
+import msgpack
+
 from .serialization import signing_serialize
+
+# Process-global digest cache. The node pipeline builds a FRESH Request
+# instance per hop (client ingress, each PROPAGATE arrival, 3PC
+# re-validation), so the per-instance cache below misses once per
+# instance and pays the pure-Python canonical-JSON serialization each
+# time (~27 digest derivations per request across a 4-node pool, the top
+# serde cost in the round-4 profile). Keyed by sha256 of the C-speed
+# msgpack of to_dict() — content-identity, so a forged variant can never
+# alias an honest request's digest. FIFO-bounded: attacker-supplied
+# requests must not grow it without bound.
+_GLOBAL_DIGESTS: dict[bytes, tuple[str, str]] = {}
+_GLOBAL_DIGESTS_MAX = 65536
 
 
 class _FrozenDict(dict):
@@ -143,16 +157,29 @@ class Request:
                self.protocol_version, self.endorser)
         c = self._digest_cache
         if c is None or c[0] != key:
-            payload = self.signing_bytes()
-            d = self.signing_payload()
-            if self.signature is not None:
-                d["signature"] = self.signature
-            if self.signatures is not None:
-                d["signatures"] = self.signatures
-            self._digest_cache = c = (
-                key,
-                hashlib.sha256(signing_serialize(d)).hexdigest(),
-                hashlib.sha256(payload).hexdigest())
+            # RAW msgpack, not serialization.pack: the canonical map sort
+            # is a pure-Python deep rebuild and would cost what this cache
+            # saves. to_dict() has a fixed insertion order, so equal
+            # content packs to equal bytes; an order difference could only
+            # cause a harmless miss, never a wrong hit.
+            gkey = hashlib.sha256(
+                msgpack.packb(self.to_dict(), use_bin_type=True)).digest()
+            hit = _GLOBAL_DIGESTS.get(gkey)
+            if hit is None:
+                payload = self.signing_bytes()
+                d = self.signing_payload()
+                if self.signature is not None:
+                    d["signature"] = self.signature
+                if self.signatures is not None:
+                    d["signatures"] = self.signatures
+                hit = (hashlib.sha256(signing_serialize(d)).hexdigest(),
+                       hashlib.sha256(payload).hexdigest())
+                if len(_GLOBAL_DIGESTS) >= _GLOBAL_DIGESTS_MAX:
+                    for k in list(_GLOBAL_DIGESTS)[
+                            :_GLOBAL_DIGESTS_MAX // 8]:
+                        del _GLOBAL_DIGESTS[k]
+                _GLOBAL_DIGESTS[gkey] = hit
+            self._digest_cache = c = (key, *hit)
         return c
 
     @property

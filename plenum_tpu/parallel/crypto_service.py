@@ -111,11 +111,22 @@ class CryptoPlaneServer:
                         todo[d] = len(items)
                         items.append(it)
             new: dict[bytes, bool] = {}
+            error = None
             if items:
-                verdicts = self._inner.verify_batch(items)
-                self.stats["dispatches"] += 1
-                self.stats["dispatched_items"] += len(items)
-                new = {d: bool(verdicts[idx]) for d, idx in todo.items()}
+                try:
+                    verdicts = self._inner.verify_batch(items)
+                except Exception as e:
+                    # backend/device failure (e.g. the tunnel dropping
+                    # mid-dispatch) must surface as an ERROR to every
+                    # waiting client, not kill this thread — a dead
+                    # worker would silently wedge every co-hosted node
+                    error = f"{type(e).__name__}: {e}"
+                    self.stats["errors"] = self.stats.get("errors", 0) + 1
+                else:
+                    self.stats["dispatches"] += 1
+                    self.stats["dispatched_items"] += len(items)
+                    new = {d: bool(verdicts[idx])
+                           for d, idx in todo.items()}
             # resolve every job from (new | pre-existing cache) BEFORE
             # eviction can touch the entries these verdicts came from
             for done, batch, digests in jobs:
@@ -123,8 +134,15 @@ class CryptoPlaneServer:
                 self.stats["cache_hits"] += hits
                 self.stats["batches"] += 1
                 self.stats["items"] += len(batch)
-                done([new[d] if d in new else self._cache.get(d, False)
-                      for d in digests])
+                try:
+                    if error is not None:
+                        done(error)
+                    else:
+                        done([new[d] if d in new
+                              else self._cache.get(d, False)
+                              for d in digests])
+                except Exception:
+                    pass   # loop closing mid-shutdown: nothing to notify
             self._cache.update(new)
             if len(self._cache) > self._cache_size:
                 # FIFO eviction in bulk; dict preserves insert order
@@ -140,6 +158,12 @@ class CryptoPlaneServer:
         instead of serializing behind each other's replies."""
         import asyncio
         loop = asyncio.get_running_loop()
+
+        def _resolve(fut, result):
+            if not fut.cancelled():     # disconnect may cancel us first
+                fut.set_result(result)
+
+        rid = None
         try:
             if req.get("op") == "stats":
                 payload = pack(dict(self.stats,
@@ -150,22 +174,30 @@ class CryptoPlaneServer:
                          for m, s, v in req["items"]]
                 digests = [_digest(*it) for it in batch]
                 fut = loop.create_future()
-                self._q.put((lambda verdicts, f=fut:
-                             loop.call_soon_threadsafe(f.set_result,
-                                                       verdicts),
+                self._q.put((lambda result, f=fut:
+                             loop.call_soon_threadsafe(_resolve, f, result),
                              batch, digests))
-                verdicts = await fut
-                payload = pack({"id": rid,
-                                "verdicts": [int(v) for v in verdicts]})
+                result = await fut
+                if isinstance(result, str):      # backend failure
+                    payload = pack({"id": rid, "error": result})
+                else:
+                    payload = pack({"id": rid,
+                                    "verdicts": [int(v) for v in result]})
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # schema garbage: answer THIS request with an error when we
+            # know its id; the connection and its other in-flight
+            # requests live on
+            if rid is None:
+                return
+            payload = pack({"id": rid, "error": f"bad request: {e}"})
+        try:
             async with wlock:
                 writer.write(_LEN.pack(len(payload)) + payload)
                 await writer.drain()
-        except asyncio.CancelledError:
-            raise
         except Exception:
-            # schema garbage / dead writer: this request dies, the plane
-            # (and the connection's other in-flight requests) live on
-            writer.close()
+            writer.close()              # dead writer: drop the connection
 
     async def _handle(self, reader, writer) -> None:
         import asyncio
@@ -284,12 +316,23 @@ class ServiceEd25519Verifier(Ed25519Verifier):
                 reply = self._recv(block=wait)
                 if reply is None:
                     return None
-                self._replies[reply["id"]] = reply["verdicts"]
-            verdicts = self._replies.pop(rid)
-        return np.array(verdicts, dtype=bool)
+                self._replies[reply["id"]] = reply
+            reply = self._replies.pop(rid)
+        if "error" in reply:
+            # backend/device failure or a request the server rejected —
+            # loud, not a silent all-False verdict (which would read as
+            # 'n invalid signatures' and trigger bogus suspicions)
+            raise RuntimeError(f"crypto service: {reply['error']}")
+        return np.array(reply["verdicts"], dtype=bool)
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
         return self.collect_batch(self.submit_batch(items), wait=True)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
     def stats(self) -> dict:
         with self._lock:

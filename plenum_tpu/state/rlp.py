@@ -17,7 +17,17 @@ def encode(item) -> bytes:
             return b
         return _len_prefix(len(b), 0x80) + b
     if isinstance(item, (list, tuple)):
-        payload = b"".join(encode(x) for x in item)
+        # trie nodes are flat lists of byte strings — inline that case
+        # instead of recursing per item (hot path of every state write)
+        parts = []
+        for x in item:
+            if isinstance(x, (bytes, bytearray)):
+                b = bytes(x)
+                parts.append(b if len(b) == 1 and b[0] < 0x80
+                             else _len_prefix(len(b), 0x80) + b)
+            else:
+                parts.append(encode(x))
+        payload = b"".join(parts)
         return _len_prefix(len(payload), 0xC0) + payload
     raise RlpError(f"cannot RLP-encode {type(item)}")
 

@@ -52,6 +52,8 @@ def service(tmp_path):
         return c
 
     yield server, connect
+    for c in clients:
+        c.close()
     server._stop.set()
     t.join(timeout=5.0)
 
@@ -134,3 +136,48 @@ def test_cache_poisoning_by_field_shift_rejected(service):
     shifted = (msg, sig + vk[:1], vk[1:])
     assert not attacker.verify_batch([shifted]).any()   # cached False
     assert honest.verify_batch([(msg, sig, vk)]).all()  # unaffected
+
+
+def test_backend_failure_is_loud_and_worker_survives(tmp_path):
+    """An inner-verifier exception (device tunnel dropping) must surface
+    as an error to waiting clients — never a silent all-False verdict or
+    a dead worker thread that wedges every node."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.crypto_service import (CryptoPlaneServer,
+                                                    ServiceEd25519Verifier)
+
+    class FlakyVerifier(CpuEd25519Verifier):
+        def __init__(self):
+            super().__init__()
+            self.fail_next = True
+
+        def verify_batch(self, items):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("device tunnel dropped")
+            return super().verify_batch(items)
+
+    sock = str(tmp_path / "crypto.sock")
+    server = CryptoPlaneServer(FlakyVerifier(), socket_path=sock)
+    loop_ready = threading.Event()
+
+    def runner():
+        async def run():
+            await server.start()
+            loop_ready.set()
+            while not server._stop.is_set():
+                await asyncio.sleep(0.05)
+        asyncio.new_event_loop().run_until_complete(run())
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    assert loop_ready.wait(5.0)
+    ver = ServiceEd25519Verifier(socket_path=sock)
+    items = _make_items(3, tag=b"flaky")
+    with pytest.raises(RuntimeError, match="device tunnel dropped"):
+        ver.verify_batch(items)
+    # the worker survived: the next dispatch succeeds
+    assert ver.verify_batch(items).all()
+    ver.close()
+    server._stop.set()
+    t.join(timeout=5.0)
