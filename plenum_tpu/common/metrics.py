@@ -85,6 +85,8 @@ class _GcPauseTimer:
 
 _gc_pause_timer: Optional[_GcPauseTimer] = None
 _gc_tuned = False
+# (last_sample_monotonic, count) for the throttled gen2-object gauge
+_gc_tracked_cache: tuple[float, Optional[int]] = (float("-inf"), None)
 
 
 def tune_gc_for_server() -> None:
@@ -128,13 +130,20 @@ def sample_process_gauges(collector: "MetricsCollector") -> None:
     # a real leak signal: long-lived objects live in gen2, and its count
     # only grows if the heap does (gc.get_count() is collection counters,
     # bounded by the thresholds — useless for soak-leak detection). The
-    # gen2 list build is O(live objects); at the flush cadence (10 s)
-    # that is ~ms, not hot-path cost.
-    try:
-        tracked = len(gc.get_objects(generation=2))
-    except TypeError:                          # pre-3.8 signature
-        tracked = len(gc.get_objects())
-    collector.add_event(MetricsName.GC_TRACKED_OBJECTS, tracked)
+    # gen2 list build is O(live objects) — ~40 ms at 600k objects — so
+    # it is throttled to once a minute per process; leak detection needs
+    # a trend, not a 10 s cadence.
+    global _gc_tracked_cache
+    now = time.monotonic()
+    if now - _gc_tracked_cache[0] >= 60.0:
+        try:
+            tracked = len(gc.get_objects(generation=2))
+        except TypeError:                      # pre-3.8 signature
+            tracked = len(gc.get_objects())
+        _gc_tracked_cache = (now, tracked)
+    if _gc_tracked_cache[1] is not None:
+        collector.add_event(MetricsName.GC_TRACKED_OBJECTS,
+                            _gc_tracked_cache[1])
     stats = gc.get_stats()
     if stats:
         collector.add_event(MetricsName.GC_GEN2_COLLECTIONS,

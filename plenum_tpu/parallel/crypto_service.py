@@ -130,14 +130,16 @@ class CryptoPlaneServer:
             # resolve every job from (new | pre-existing cache) BEFORE
             # eviction can touch the entries these verdicts came from
             for done, batch, digests in jobs:
-                hits = sum(1 for d in digests if d not in new)
-                self.stats["cache_hits"] += hits
                 self.stats["batches"] += 1
                 self.stats["items"] += len(batch)
                 try:
-                    if error is not None:
+                    if error is not None and any(d not in self._cache
+                                                 for d in digests):
+                        # this job actually needed the failed dispatch
                         done(error)
                     else:
+                        self.stats["cache_hits"] += sum(
+                            1 for d in digests if d not in new)
                         done([new[d] if d in new
                               else self._cache.get(d, False)
                               for d in digests])
@@ -188,8 +190,11 @@ class CryptoPlaneServer:
         except Exception as e:
             # schema garbage: answer THIS request with an error when we
             # know its id; the connection and its other in-flight
-            # requests live on
+            # requests live on. Without an id there is no way to reply —
+            # drop the connection so the sender gets ConnectionError
+            # instead of blocking forever on a reply that can't come.
             if rid is None:
+                writer.close()
                 return
             payload = pack({"id": rid, "error": f"bad request: {e}"})
         try:
@@ -340,7 +345,7 @@ class ServiceEd25519Verifier(Ed25519Verifier):
             while True:
                 reply = self._recv()
                 if "id" in reply:        # verify reply racing ahead of ours
-                    self._replies[reply["id"]] = reply["verdicts"]
+                    self._replies[reply["id"]] = reply
                     continue
                 return reply
 

@@ -1,7 +1,18 @@
-"""In-memory KV store (ref storage/kv_in_memory.py) backed by a sorted dict."""
+"""In-memory KV store (ref storage/kv_in_memory.py).
+
+Writes are O(1): new keys go to a pending list instead of being
+insort'ed into the sorted key list (the previous design paid an O(n)
+memmove per write, which made long-running pools fade — a 10-minute
+soak spent more time maintaining these lists for the million-row
+txn/state stores than verifying signatures). Sorted iteration merges
+the pending run in on demand: `list.sort()` on [sorted-run, sorted-run]
+is a C-level Timsort gallop-merge, so a read after a write burst costs
+~O(n) with memcpy-like constants, and reads on a clean store cost
+nothing extra.
+"""
 from __future__ import annotations
 
-from bisect import bisect_left, insort
+from bisect import bisect_left
 from typing import Iterator, Optional
 
 from .kv_store import KeyValueStorage, encode_key
@@ -10,12 +21,13 @@ from .kv_store import KeyValueStorage, encode_key
 class KvMemory(KeyValueStorage):
     def __init__(self):
         self._data: dict[bytes, bytes] = {}
-        self._keys: list[bytes] = []
+        self._sorted_keys: Optional[list[bytes]] = []   # None = full rebuild
+        self._pending: list[bytes] = []                 # new keys, unsorted
 
     def put(self, key, value: bytes) -> None:
         k = encode_key(key)
         if k not in self._data:
-            insort(self._keys, k)
+            self._pending.append(k)
         self._data[k] = bytes(value)
 
     def get(self, key) -> bytes:
@@ -28,18 +40,31 @@ class KvMemory(KeyValueStorage):
         k = encode_key(key)
         if k in self._data:
             del self._data[k]
-            i = bisect_left(self._keys, k)
-            if i < len(self._keys) and self._keys[i] == k:
-                self._keys.pop(i)
+            self._sorted_keys = None    # rare: full rebuild on next scan
+
+    def _keys(self) -> list[bytes]:
+        if self._sorted_keys is None:
+            self._sorted_keys = sorted(self._data)
+            self._pending = []
+        elif self._pending:
+            self._pending.sort()
+            self._sorted_keys += self._pending
+            self._sorted_keys.sort()    # two sorted runs: C gallop-merge
+            self._pending = []
+        return self._sorted_keys
 
     def iterator(self, start=None, end=None, include_value: bool = True) -> Iterator:
-        lo = 0 if start is None else bisect_left(self._keys, encode_key(start))
+        keys = self._keys()
+        lo = 0 if start is None else bisect_left(keys, encode_key(start))
         hi = None if end is None else encode_key(end)
-        for i in range(lo, len(self._keys)):
-            k = self._keys[i]
+        for i in range(lo, len(keys)):
+            k = keys[i]
             if hi is not None and k > hi:
                 return
-            yield (k, self._data[k]) if include_value else k
+            # a put/remove during iteration leaves this snapshot list
+            # consistent; keys deleted mid-iteration are skipped
+            if k in self._data:
+                yield (k, self._data[k]) if include_value else k
 
     def close(self) -> None:
         pass
