@@ -102,23 +102,42 @@ class MessageBase:
 
     typename: ClassVar[str] = ""
 
+    @classmethod
+    def _schema(cls):
+        """(names, required-set, {name: resolved annotation}) — computed
+        once per class: dataclasses.fields() rebuilds its tuple and
+        _resolve re-evaluates annotations on every call, which dominated
+        the 25-node profile (one schema walk per message per receiver)."""
+        cached = cls.__dict__.get("_schema_cache")
+        if cached is None:
+            fields = dc_fields(cls)
+            names = tuple(f.name for f in fields)
+            required = frozenset(
+                f.name for f in fields
+                if f.default is dataclasses.MISSING
+                and f.default_factory is dataclasses.MISSING)
+            annots = {f.name: _resolve(cls, f) for f in fields}
+            cached = (names, required, annots)
+            cls._schema_cache = cached
+        return cached
+
     def to_dict(self) -> dict:
         d = {"op": self.typename}
-        for f in dc_fields(self):
-            d[f.name] = _plainify(getattr(self, f.name))
+        for name in self._schema()[0]:
+            d[name] = _plainify(getattr(self, name))
         return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "MessageBase":
+        names, required, annots = cls._schema()
         kwargs = {}
-        known = {f.name: f for f in dc_fields(cls)}
-        for name, f in known.items():
+        for name in names:
             if name in d:
                 kwargs[name] = _check_type(f"{cls.typename}.{name}", d[name],
-                                           _resolve(cls, f))
-            elif f.default is dataclasses.MISSING and f.default_factory is dataclasses.MISSING:
+                                           annots[name])
+            elif name in required:
                 raise MessageValidationError(f"{cls.typename}: missing field {name!r}")
-        extra = set(d) - set(known) - {"op"}
+        extra = set(d) - set(names) - {"op"}
         if extra:
             raise MessageValidationError(f"{cls.typename}: unknown fields {sorted(extra)}")
         obj = cls(**kwargs)

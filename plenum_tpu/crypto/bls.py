@@ -171,6 +171,23 @@ class BlsCryptoSigner:
         return key.verkey, key.generate_pop()
 
 
+# Process-wide verdict cache for the per-batch pairing checks, shared by
+# every BlsCryptoVerifier: in a co-hosted topology each node runs the
+# IDENTICAL aggregate check (same multi-sig, same state root, same
+# participant set) at order time, and a pairing costs ~4 ms. One shared
+# digest/eviction implementation (crypto/ed25519.py) serves every
+# verdict cache in the package.
+from plenum_tpu.crypto.ed25519 import (content_digest as _bls_verdict_key,
+                                       verdict_cache_put as _cache_put)
+
+_BLS_VERDICTS: dict[bytes, bool] = {}
+_BLS_VERDICTS_MAX = 16384
+
+
+def _bls_cache_put(key: bytes, verdict: bool) -> bool:
+    return _cache_put(_BLS_VERDICTS, _BLS_VERDICTS_MAX, key, verdict)
+
+
 class BlsCryptoVerifier:
     """Stateless verification provider; caches decoded verkeys."""
 
@@ -194,27 +211,39 @@ class BlsCryptoVerifier:
             return False
 
     def verify_sig(self, signature: str, message: bytes, verkey: str) -> bool:
+        key = _bls_verdict_key(b"sig", signature.encode(), message,
+                               verkey.encode())
+        hit = _BLS_VERDICTS.get(key)
+        if hit is not None:
+            return hit
         try:
             sig = _decode_sig(signature)
             pk = self._pk(verkey)
         except (ValueError, KeyError):
-            return False
+            return _bls_cache_put(key, False)
         h = c.hash_to_g1(message, _MSG_DOMAIN)
-        return c.pairing_check([(c.G2_GEN, c.g1_neg(sig)), (pk, h)])
+        return _bls_cache_put(key, c.pairing_check(
+            [(c.G2_GEN, c.g1_neg(sig)), (pk, h)]))
 
     def verify_multi_sig(self, signature: str, message: bytes,
                          verkeys: Sequence[str]) -> bool:
         if not verkeys:
             return False
+        key = _bls_verdict_key(b"multi", signature.encode(), message,
+                               *sorted(v.encode() for v in verkeys))
+        hit = _BLS_VERDICTS.get(key)
+        if hit is not None:
+            return hit
         try:
             sig = _decode_sig(signature)
             pk: c.G2Point = None
             for v in verkeys:
                 pk = c.g2_add(pk, self._pk(v))
         except (ValueError, KeyError):
-            return False
+            return _bls_cache_put(key, False)
         h = c.hash_to_g1(message, _MSG_DOMAIN)
-        return c.pairing_check([(c.G2_GEN, c.g1_neg(sig)), (pk, h)])
+        return _bls_cache_put(key, c.pairing_check(
+            [(c.G2_GEN, c.g1_neg(sig)), (pk, h)]))
 
     def create_multi_sig(self, signatures: Sequence[str]) -> str:
         return aggregate_sigs(signatures)

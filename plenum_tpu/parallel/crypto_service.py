@@ -32,7 +32,6 @@ Client:  make_verifier("service") with PLENUM_CRYPTO_SOCKET set, or
 from __future__ import annotations
 
 import argparse
-import hashlib
 import json
 import os
 import queue
@@ -52,17 +51,9 @@ DEFAULT_SOCKET = "/tmp/plenum_crypto.sock"
 CACHE_SIZE = 65536
 
 
-def _digest(msg: bytes, sig: bytes, vk: bytes) -> bytes:
-    # EVERY field is length-prefixed: without the prefixes an attacker
-    # could shift bytes between sig and vk ((msg, sig+vk[:1], vk[1:])
-    # hashes identically), pre-poison the cache with a False verdict for
-    # a digest an honest (msg, sig, vk) later maps to, and make every
-    # co-hosted node reject a validly signed request
-    h = hashlib.sha256()
-    for part in (msg, sig, vk):
-        h.update(len(part).to_bytes(4, "big"))
-        h.update(part)
-    return h.digest()
+# one shared length-prefixed digest for every verdict cache — the
+# anti-aliasing property is load-bearing (see content_digest docstring)
+from plenum_tpu.crypto.ed25519 import content_digest as _digest
 
 
 class CryptoPlaneServer:

@@ -43,13 +43,17 @@ class KvMemory(KeyValueStorage):
             self._sorted_keys = None    # rare: full rebuild on next scan
 
     def _keys(self) -> list[bytes]:
+        # always build a NEW list: a live iterator holds the previous one
+        # as its snapshot, and mutating it in place would re-yield or skip
+        # keys under the iterator's cursor
         if self._sorted_keys is None:
             self._sorted_keys = sorted(self._data)
             self._pending = []
         elif self._pending:
-            self._pending.sort()
-            self._sorted_keys += self._pending
-            self._sorted_keys.sort()    # two sorted runs: C gallop-merge
+            self._pending.sort()        # no iterator ever holds _pending
+            merged = self._sorted_keys + self._pending
+            merged.sort()               # two sorted runs: C gallop-merge
+            self._sorted_keys = merged
             self._pending = []
         return self._sorted_keys
 
