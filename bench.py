@@ -135,6 +135,7 @@ def main():
         result["tcp7_partial"] = tcp7["txns_ordered"]
     if jax_ok:
         result.update({
+            "jax_tps": jax_stats["tps"],    # real-device in-process pool
             "jax_p50_ms": jax_stats["p50_latency_ms"],
             "jax_ordered": jax_stats["txns_ordered"],
             "ledgers_agree": bool(cpu["ledger_sizes_agree"]
