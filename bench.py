@@ -148,6 +148,8 @@ def main():
     # (tools/bench_configs; each returns {"error": ...} rather than raising)
     try:
         from plenum_tpu.tools import bench_configs as bc
+        c1b = bc.config1b_distinct_signers(n_txns=200)
+        result["distinct_signers_tps"] = c1b.get("tps", c1b.get("error"))
         c2 = bc.config2_three_instances_mixed(n_txns=200)
         c3 = bc.config3_bls_proof_reads(n_reads=1500)
         c4 = bc.config4_viewchange_under_load(n_txns=150)

@@ -234,7 +234,8 @@ def test_pt_cache_bounded():
 # --- coalescing crypto plane (co-hosted nodes, one dispatch) --------------
 
 def test_coalescing_verifier_merges_batches():
-    from plenum_tpu.crypto.ed25519 import CoalescingVerifier
+    from plenum_tpu.crypto.ed25519 import _PLANE_VERDICTS, CoalescingVerifier
+    _PLANE_VERDICTS.clear()   # flush() asserts below depend on a cold cache
     inner = JaxEd25519Verifier(min_batch=8)
     plane = CoalescingVerifier(inner)
     signers = [Ed25519Signer(bytes([i + 1]) * 32) for i in range(3)]

@@ -249,3 +249,14 @@ def test_metrics_report_reads_flushed_history(tmp_path):
     assert rc == 0
     out = json.loads(buf.getvalue())
     assert out["Node1"]["summary"]["txns_ordered"] == 150
+
+
+def test_distinct_signers_config_orders_owner_writes():
+    """config1b: n distinct client keys on the authN hot path — every
+    ATTRIB owner-signed by its own DID (authorization: owner-or-trustee),
+    so the figure reflects diverse-client traffic, not one amortized
+    trustee key."""
+    from plenum_tpu.tools.bench_configs import config1b_distinct_signers
+    r = config1b_distinct_signers(n_txns=40, timeout=60.0)
+    assert r.get("txns_ordered") == 40, r
+    assert r["distinct_signers"] == 40
