@@ -1,0 +1,81 @@
+"""Native (C++) fast path for the trie's per-node encode+hash.
+
+Every trie store/commit pays `rlp.encode(node)` + `sha3_256` per
+modified node (plenum_tpu/state/trie.py:_store, root_hash) — the state
+category's hottest pure-Python cost after the round-4 fast paths. The
+in-tree C++ codec (native/mptcodec.cpp) does both in one call for FLAT
+nodes (every item a byte string — the common shape once children are
+hashed refs); nodes with embedded (nested-list) children fall back to
+the pure-Python twin, which stays authoritative for differential tests.
+Gracefully absent when the toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    from plenum_tpu.native import _build
+    lib = _build("mptcodec.cpp", "mptcodec")
+    if lib is None:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.mptc_sha3_256.argtypes = [ctypes.c_char_p, ctypes.c_uint64, u8p]
+    lib.mptc_sha3_256.restype = None
+    lib.mptc_encode_hash.argtypes = [ctypes.c_int32, u32p, ctypes.c_char_p,
+                                     u8p, ctypes.c_uint64, u8p]
+    lib.mptc_encode_hash.restype = ctypes.c_long
+    lib.mptc_rlp_encode.argtypes = [ctypes.c_int32, u32p, ctypes.c_char_p,
+                                    u8p, ctypes.c_uint64]
+    lib.mptc_rlp_encode.restype = ctypes.c_long
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def encode_hash_flat(node: list) -> Optional[tuple[bytes, bytes]]:
+    """Flat list-of-bytes node -> (rlp, sha3) via C++, or None when the
+    node has nested children / the native lib is absent (caller falls
+    back to the Python twin)."""
+    lib = _load()
+    if lib is None:
+        return None
+    lens = []
+    for item in node:
+        if type(item) is not bytes:
+            return None                  # embedded child or non-bytes
+        if len(item) > 0xFFFFFFFF:
+            return None                  # would truncate in the u32 ABI
+        lens.append(len(item))
+    n = len(node)
+    concat = b"".join(node)
+    cap = len(concat) + 9 * (n + 1) + 32
+    out = (ctypes.c_uint8 * cap)()
+    digest = (ctypes.c_uint8 * 32)()
+    lens_arr = (ctypes.c_uint32 * n)(*lens)
+    got = lib.mptc_encode_hash(n, lens_arr, concat, out, cap, digest)
+    if got < 0:                          # cannot happen with cap above
+        return None
+    return bytes(out[:got]), bytes(digest)
+
+
+def sha3_native(data: bytes) -> Optional[bytes]:
+    """Differential-test surface for the in-tree SHA3-256."""
+    lib = _load()
+    if lib is None:
+        return None
+    digest = (ctypes.c_uint8 * 32)()
+    lib.mptc_sha3_256(data, len(data), digest)
+    return bytes(digest)

@@ -1,0 +1,61 @@
+"""In-tree C++ MPT node codec (native/mptcodec.cpp): SHA3-256 and
+flat-node RLP, differential-tested against hashlib and the pure-Python
+twin. Deliberately NOT wired into the trie hot path: measured through
+ctypes at single-node granularity it is ~2x slower than Python rlp +
+hashlib sha3 (docs/performance.md "Future directions") — the native
+win requires a batch-granularity API. The differential surface keeps
+the codec honest until then."""
+from __future__ import annotations
+
+import hashlib
+import random
+
+import pytest
+
+from plenum_tpu.state import native_codec as nc
+from plenum_tpu.state import rlp
+
+pytestmark = pytest.mark.skipif(not nc.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_sha3_matches_hashlib_across_padding_boundaries():
+    rng = random.Random(3)
+    # rate = 136 bytes for SHA3-256: cover both sides of every boundary
+    for n in (0, 1, 55, 56, 135, 136, 137, 271, 272, 273, 4096):
+        data = bytes(rng.randrange(256) for _ in range(n))
+        assert nc.sha3_native(data) == hashlib.sha3_256(data).digest(), n
+
+
+def test_flat_node_encode_hash_matches_python_twin():
+    rng = random.Random(7)
+    for trial in range(300):
+        n_items = rng.choice([2, 17])
+        node = []
+        for _ in range(n_items):
+            kind = rng.random()
+            if kind < 0.3:
+                node.append(b"")
+            elif kind < 0.5:
+                node.append(bytes([rng.randrange(256)]))  # 1-byte RLP case
+            elif kind < 0.8:
+                node.append(bytes(rng.randrange(256) for _ in range(32)))
+            else:
+                node.append(bytes(rng.randrange(256)
+                                  for _ in range(rng.randrange(2, 90))))
+        enc_py = rlp.encode(node)
+        got = nc.encode_hash_flat(node)
+        assert got is not None
+        assert got[0] == enc_py, (trial, node)
+        assert got[1] == hashlib.sha3_256(enc_py).digest()
+
+
+def test_nested_children_defer_to_python():
+    assert nc.encode_hash_flat([b"ab", [b"x", b"y"]]) is None
+
+
+def test_long_item_prefix_encoding():
+    # >55-byte items exercise the multi-byte length prefix
+    node = [bytes(200), bytes(56), b"\x7f"]
+    got = nc.encode_hash_flat(node)
+    assert got is not None and got[0] == rlp.encode(node)
