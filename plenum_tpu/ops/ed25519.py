@@ -445,6 +445,19 @@ def _build_a_tables(qx, qy, qz, qt):
 
 
 @jax.jit
+def verify_kernel_indexed(s_digits, h_digits, aq_unique, idx, ry, r_sign):
+    """verify_kernel with the verkey-derived quarter-point rows DEDUPED:
+    aq_unique is int32[U, 4, 4, NLIMB] (one row per distinct verkey in
+    the batch) and idx int32[N] maps each signature to its row. The
+    gather runs on device, so the host->device payload shrinks from
+    640 B/signature to 640 B/distinct key + 4 B/signature — measured to
+    matter because ~80% of a tunneled dispatch is link transfer and aq
+    was 73% of the bytes (probes/tunnel_decomposition_r04.json)."""
+    aq = jnp.take(aq_unique, idx, axis=0)
+    return verify_kernel(s_digits, h_digits, aq, ry, r_sign)
+
+
+@jax.jit
 def verify_kernel(s_digits, h_digits, aq, ry, r_sign):
     """Batched check compress([S]B + [h](-A)) == R-bytes.
 

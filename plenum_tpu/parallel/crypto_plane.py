@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from plenum_tpu.crypto.ed25519 import JaxEd25519Verifier
@@ -48,15 +49,20 @@ def _reduce_roots(roots: jax.Array) -> jax.Array:
     return sha_ops.merkle_reduce_pow2(roots)
 
 
-def _local_step(s_dig, h_dig, aq, ry, r_sign, leaves):
+def _local_step(s_dig, h_dig, aq_unique, idx, ry, r_sign, leaves):
     """Per-shard body. Signature grid arrives as [I_loc, N_loc, ...]; the
-    local grid flattens into one kernel batch. leaves: uint32[L_loc, 8]."""
-    i_loc, n_loc = aq.shape[0], aq.shape[1]
+    local grid flattens into one kernel batch. The verkey quarter-point
+    table is REPLICATED (it is the deduped host->device payload — the
+    transfer win must survive sharding, since on tunneled multi-chip
+    hardware the link dominates dispatch cost) and gathered per shard by
+    the sharded idx. leaves: uint32[L_loc, 8]."""
+    i_loc, n_loc = idx.shape[0], idx.shape[1]
     m = i_loc * n_loc
+    aq = jnp.take(aq_unique, idx.reshape(m), axis=0)
     ok = ed_ops.verify_kernel(
         s_dig.reshape(ed_ops.N_COMB, m),
         h_dig.reshape(ed_ops.N_WIN, ed_ops.N_QUARTERS, m),
-        aq.reshape(m, 4, 4, ed_ops.NLIMB),
+        aq,
         ry.reshape(m, -1), r_sign.reshape(m))
     ok = ok.reshape(i_loc, n_loc)
 
@@ -80,7 +86,8 @@ class ShardedCryptoPlane:
         self.mesh = mesh
         spec_s = P(None, "inst", "sig")            # s digits [N_COMB, I, N]
         spec_h = P(None, None, "inst", "sig")      # h digits [W, 4, I, N]
-        spec_aq = P("inst", "sig", None, None, None)   # [I, N, 4, 4, L]
+        spec_aq = P(None, None, None, None)        # aq_unique [U, 4, 4, L]
+        spec_idx = P("inst", "sig")                # idx      [I, N]
         spec_ry = P("inst", "sig", None)           # ry       [I, N, L]
         spec_scalar = P("inst", "sig")             # r_sign   [I, N]
         spec_leaf = P(("inst", "sig"), None)       # leaves   [L, 8]
@@ -90,20 +97,21 @@ class ShardedCryptoPlane:
         # safe.
         self._step = jax.jit(_shard_map(
             _local_step, mesh=mesh,
-            in_specs=(spec_s, spec_h, spec_aq, spec_ry, spec_scalar,
-                      spec_leaf),
+            in_specs=(spec_s, spec_h, spec_aq, spec_idx, spec_ry,
+                      spec_scalar, spec_leaf),
             out_specs=(P("inst", "sig"), P(), P()),
             check_vma=False))
 
-    def step(self, s_dig, h_dig, aq, ry, r_sign, leaves):
+    def step(self, s_dig, h_dig, aq_unique, idx, ry, r_sign, leaves):
         """-> (ok[I, N] bool, root uint32[8], n_ok int32).
 
-        Shape contract: I divides mesh 'inst' size exactly; N divides 'sig';
-        the leaf count divides the full mesh and the per-shard leaf count is a
-        power of two (host pads; padding is duplicate leaves whose root the
-        host discards if it padded).
+        Shape contract: idx is [I, N] with I dividing mesh 'inst' exactly
+        and N dividing 'sig'; aq_unique [U, 4, 4, L] is replicated; the
+        leaf count divides the full mesh and the per-shard leaf count is a
+        power of two (host pads; padding is duplicate leaves whose root
+        the host discards if it padded).
         """
-        return self._step(s_dig, h_dig, aq, ry, r_sign, leaves)
+        return self._step(s_dig, h_dig, aq_unique, idx, ry, r_sign, leaves)
 
 
 class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
@@ -130,7 +138,7 @@ class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
         self._grid = (inst, sig)
         self.dispatches = 0          # observability for tests/metrics
 
-    def _device_verify(self, s_digits, h_digits, aq, ry, r_sign):
+    def _device_verify(self, s_digits, h_digits, aq_unique, idx, ry, r_sign):
         import jax.numpy as jnp
         inst, sig = self._grid
         m = s_digits.shape[1]        # pow2 >= inst*sig, so inst | m and
@@ -142,7 +150,8 @@ class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
             jnp.asarray(s_digits).reshape(ed_ops.N_COMB, inst, n),
             jnp.asarray(h_digits).reshape(
                 ed_ops.N_WIN, ed_ops.N_QUARTERS, inst, n),
-            jnp.asarray(aq).reshape(inst, n, 4, 4, ed_ops.NLIMB),
+            jnp.asarray(aq_unique),
+            jnp.asarray(idx).reshape(inst, n),
             jnp.asarray(ry).reshape(inst, n, -1),
             jnp.asarray(r_sign).reshape(inst, n),
             leaves)
