@@ -160,10 +160,13 @@ def content_digest(*parts: bytes) -> bytes:
 def verdict_cache_put(cache: dict, maxsize: int, key: bytes,
                       verdict: bool) -> bool:
     """Bounded FIFO insert shared by the verdict caches (attacker-supplied
-    content must never grow them without bound); returns the verdict."""
+    content must never grow them without bound); returns the verdict.
+    Tolerates concurrent callers (the crypto service verifies BLS in
+    executor threads): a key another thread already evicted is skipped,
+    not raised."""
     if len(cache) >= maxsize:
         for k in list(cache)[:maxsize // 8]:
-            del cache[k]
+            cache.pop(k, None)
     cache[key] = verdict
     return verdict
 

@@ -45,7 +45,6 @@ from plenum_tpu.common.timer import RepeatingTimer, TimerService
 from plenum_tpu.config import Config
 from plenum_tpu.consensus.bls_bft_replica import BlsBftReplica
 from plenum_tpu.consensus.replica import Replica, Replicas
-from plenum_tpu.crypto.bls import BlsCryptoVerifier
 from plenum_tpu.execution import txn as txn_lib
 from plenum_tpu.execution.exceptions import (InvalidClientRequest,
                                              UnauthorizedClientRequest)
@@ -525,9 +524,15 @@ class Node:
         # cannot cite a pool-state epoch for rotation-aware validation.
         bls = None
         if inst_id == 0:
+            # with the service plane, the per-batch aggregate pairing is
+            # deduped host-wide (every co-hosted node runs the identical
+            # check); otherwise verify locally — the factory encodes both
+            from plenum_tpu.parallel.crypto_service import \
+                make_bls_verifier
+            bls_verifier = make_bls_verifier(self.config.crypto_backend)
             bls = BlsBftReplica(
                 node_name=self.name, bls_signer=self.c.bls_signer,
-                bls_verifier=BlsCryptoVerifier(),
+                bls_verifier=bls_verifier,
                 key_register=self.c.bls_register,
                 bls_store=self.c.bls_store,
                 node_reg_at=node_reg_at, key_at=key_at)

@@ -64,6 +64,17 @@ class CryptoPlaneServer:
                  socket_path: str = DEFAULT_SOCKET,
                  cache_size: int = CACHE_SIZE):
         self._inner = inner
+        # BLS aggregate checks ride the same plane: each co-hosted node
+        # runs the IDENTICAL per-batch pairing (~4 ms), and the
+        # process-wide verdict cache inside BlsCryptoVerifier collapses
+        # the n-fold repetition automatically once they all ask here
+        from plenum_tpu.crypto.bls import BlsCryptoVerifier
+        self._bls = BlsCryptoVerifier()
+        # single-flight: key -> future, so n co-hosted nodes submitting
+        # the identical order-time check inside one pairing window run
+        # ONE pairing, not n (the Ed25519 path gets this from the
+        # worker's coalescing todo map; BLS bypasses the queue)
+        self._bls_pending: dict = {}
         self.socket_path = socket_path
         self._q: "queue.Queue" = queue.Queue()
         # content-digest -> bool; FIFO-bounded like the verkey cache
@@ -145,6 +156,37 @@ class CryptoPlaneServer:
 
     # --- asyncio front end ----------------------------------------------
 
+    async def _bls_check(self, loop, sig, msg, vks) -> bool:
+        from plenum_tpu.crypto import bls as bls_mod
+        sig, msg = str(sig), bytes(msg)
+        vks = [str(v) for v in vks]
+        key = bls_mod._bls_verdict_key(b"multi", sig.encode(), msg,
+                                       *sorted(v.encode() for v in vks))
+        hit = bls_mod._BLS_VERDICTS.get(key)
+        if hit is not None:
+            return hit
+        pending = self._bls_pending.get(key)
+        if pending is not None:
+            kind, val = await pending
+            if kind == "err":
+                raise RuntimeError(val)
+            return val
+        fut = loop.create_future()
+        self._bls_pending[key] = fut
+        try:
+            verdict = await loop.run_in_executor(
+                None, self._bls.verify_multi_sig, sig, msg, vks)
+        except Exception as e:
+            self._bls_pending.pop(key, None)
+            if not fut.done():
+                fut.set_result(("err", str(e)))
+            raise
+        self._bls_pending.pop(key, None)
+        self.stats["bls_pairings"] = self.stats.get("bls_pairings", 0) + 1
+        if not fut.done():
+            fut.set_result(("ok", verdict))
+        return verdict
+
     async def _process(self, req: dict, writer, wlock) -> None:
         """One request end-to-end; runs as its own task so a connection's
         pipelined batches overlap (submit B2 while B1 is on the device)
@@ -161,6 +203,20 @@ class CryptoPlaneServer:
             if req.get("op") == "stats":
                 payload = pack(dict(self.stats,
                                     cache_size=len(self._cache)))
+            elif "bls" in req:
+                # [[sig_b58, msg_bytes, [verkey_b58...]], ...] -> bools.
+                # Pairings run in the default executor (the BN254 ctypes
+                # call releases the GIL, so neither the event loop nor
+                # the Ed25519 worker stalls); repeated content is served
+                # by the process-wide verdict cache, and concurrent
+                # identical checks share one pairing via single-flight
+                rid = req["id"]
+                results = [await self._bls_check(loop, *c)
+                           for c in req["bls"]]
+                self.stats["bls_checks"] = (
+                    self.stats.get("bls_checks", 0) + len(req["bls"]))
+                payload = pack({"id": rid,
+                                "verdicts": [int(v) for v in results]})
             else:
                 rid = req["id"]
                 batch = [(bytes(m), bytes(s), bytes(v))
@@ -324,6 +380,18 @@ class ServiceEd25519Verifier(Ed25519Verifier):
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
         return self.collect_batch(self.submit_batch(items), wait=True)
 
+    def verify_bls_multi(self, signature: str, message: bytes,
+                         verkeys) -> bool:
+        """One aggregate check via the plane (the server's process-wide
+        verdict cache dedupes identical checks across co-hosted nodes)."""
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._send({"id": rid, "bls": [[signature, bytes(message),
+                                            list(verkeys)]]})
+        reply = self.collect_batch((rid, 1), wait=True)
+        return bool(reply[0])
+
     def close(self) -> None:
         try:
             self._sock.close()
@@ -339,6 +407,57 @@ class ServiceEd25519Verifier(Ed25519Verifier):
                     self._replies[reply["id"]] = reply
                     continue
                 return reply
+
+
+class ServiceBlsVerifier:
+    """BlsCryptoVerifier facade that routes the hot aggregate check to
+    the crypto-plane service, consulting the local process-wide verdict
+    cache first (repeat checks inside ONE node cost a dict hit, repeat
+    checks ACROSS nodes cost one IPC round-trip instead of a 4 ms
+    pairing). Everything else (PoP, well-formedness, aggregation)
+    delegates to the local implementation."""
+
+    def __init__(self, socket_path: Optional[str] = None):
+        from plenum_tpu.crypto import bls as _bls
+        self._local = _bls.BlsCryptoVerifier()
+        self._bls_mod = _bls
+        self._client = ServiceEd25519Verifier(socket_path=socket_path)
+
+    def verify_multi_sig(self, signature: str, message: bytes,
+                         verkeys) -> bool:
+        verkeys = list(verkeys)
+        if not verkeys:
+            return False
+        b = self._bls_mod
+        key = b._bls_verdict_key(b"multi", signature.encode(), message,
+                                 *sorted(v.encode() for v in verkeys))
+        hit = b._BLS_VERDICTS.get(key)
+        if hit is not None:
+            return hit
+        try:
+            verdict = self._client.verify_bls_multi(signature, message,
+                                                    verkeys)
+        except (OSError, RuntimeError, ConnectionError):
+            # plane down mid-run: verify locally rather than stalling
+            # consensus on an ops failure
+            return self._local.verify_multi_sig(signature, message, verkeys)
+        return b._bls_cache_put(key, verdict)
+
+    def close(self) -> None:
+        self._client.close()
+
+    def __getattr__(self, name):
+        return getattr(self._local, name)
+
+
+def make_bls_verifier(backend: str):
+    """BLS twin of crypto.ed25519.make_verifier: 'service' routes the
+    per-batch aggregate checks through the shared plane; anything else
+    verifies locally."""
+    if backend == "service":
+        return ServiceBlsVerifier()
+    from plenum_tpu.crypto.bls import BlsCryptoVerifier
+    return BlsCryptoVerifier()
 
 
 def main(argv=None):

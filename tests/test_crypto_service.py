@@ -181,3 +181,51 @@ def test_backend_failure_is_loud_and_worker_survives(tmp_path):
     ver.close()
     server._stop.set()
     t.join(timeout=5.0)
+
+
+def test_bls_checks_ride_the_plane_and_dedupe(service):
+    """The per-batch BLS aggregate check is the other identical-on-every-
+    node pairing; routed through the plane it runs once per host."""
+    from plenum_tpu.crypto import bls as bls_mod
+    from plenum_tpu.crypto.bls import BlsCryptoSigner, aggregate_sigs
+    from plenum_tpu.parallel.crypto_service import ServiceBlsVerifier
+
+    server, connect = service
+    signers = [BlsCryptoSigner(seed=b"svcbls%d" % i + bytes(25))
+               for i in range(3)]
+    message = b"state-root-over-the-plane"
+    agg = aggregate_sigs([s.sign(message) for s in signers])
+    vks = [s.pk for s in signers]
+
+    a = ServiceBlsVerifier(socket_path=connect().socket_path)
+    bls_mod._BLS_VERDICTS.clear()
+    assert a.verify_multi_sig(agg, message, vks)
+    pairings_after_first = server.stats.get("bls_pairings", 0)
+    assert pairings_after_first >= 1
+
+    # the REAL cross-process claim: a separate OS process (fresh local
+    # cache) asking the same check costs the server a lookup, not a
+    # pairing — and different verkey order must not change the verdict
+    import base64
+    import pickle
+    import subprocess
+    import sys
+    blob = base64.b64encode(pickle.dumps(
+        (a._client.socket_path, agg, message, list(reversed(vks))))).decode()
+    code = (
+        "import base64, pickle, sys\n"
+        "sock, agg, msg, vks = pickle.loads(base64.b64decode('" + blob + "'))\n"
+        "from plenum_tpu.parallel.crypto_service import ServiceBlsVerifier\n"
+        "v = ServiceBlsVerifier(socket_path=sock)\n"
+        "assert v.verify_multi_sig(agg, msg, vks)\n"
+        "print('XPROC-OK')\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60,
+                         env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert "XPROC-OK" in out.stdout, out.stderr[-500:]
+    assert server.stats.get("bls_pairings", 0) == pairings_after_first
+
+    # wrong participant set still fails closed
+    assert not a.verify_multi_sig(agg, message, vks[:2])
+    assert not a.verify_multi_sig(agg, b"other", vks)
+    a.close()
