@@ -32,6 +32,7 @@ Client:  make_verifier("service") with PLENUM_CRYPTO_SOCKET set, or
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
 import queue
@@ -167,31 +168,45 @@ class CryptoPlaneServer:
             return hit
         pending = self._bls_pending.get(key)
         if pending is not None:
-            kind, val = await pending
+            # shield: a cancelled waiter must not cancel the shared future
+            # out from under every other waiter
+            kind, val = await asyncio.shield(pending)
             if kind == "err":
                 raise RuntimeError(val)
             return val
         fut = loop.create_future()
         self._bls_pending[key] = fut
-        try:
-            verdict = await loop.run_in_executor(
-                None, self._bls.verify_multi_sig, sig, msg, vks)
-        except Exception as e:
+        # The pairing runs detached from THIS request: if the submitting
+        # client disconnects mid-pairing (its _process task is cancelled),
+        # the done-callback below still pops the key and resolves `fut`,
+        # so every other waiter on this single-flight entry gets the real
+        # verdict instead of awaiting a dead future forever.
+        work = asyncio.ensure_future(loop.run_in_executor(
+            None, self._bls.verify_multi_sig, sig, msg, vks))
+
+        def _settle(t, key=key, fut=fut):
             self._bls_pending.pop(key, None)
-            if not fut.done():
-                fut.set_result(("err", str(e)))
-            raise
-        self._bls_pending.pop(key, None)
-        self.stats["bls_pairings"] = self.stats.get("bls_pairings", 0) + 1
-        if not fut.done():
-            fut.set_result(("ok", verdict))
-        return verdict
+            if fut.done():
+                return
+            exc = t.exception()
+            if exc is not None:
+                fut.set_result(("err", f"{type(exc).__name__}: {exc}"))
+            else:
+                self.stats["bls_pairings"] = (
+                    self.stats.get("bls_pairings", 0) + 1)
+                fut.set_result(("ok", t.result()))
+
+        work.add_done_callback(_settle)
+        # shield: cancelling this waiter must not cancel the shared fut
+        kind, val = await asyncio.shield(fut)
+        if kind == "err":
+            raise RuntimeError(val)
+        return val
 
     async def _process(self, req: dict, writer, wlock) -> None:
         """One request end-to-end; runs as its own task so a connection's
         pipelined batches overlap (submit B2 while B1 is on the device)
         instead of serializing behind each other's replies."""
-        import asyncio
         loop = asyncio.get_running_loop()
 
         def _resolve(fut, result):
@@ -252,7 +267,6 @@ class CryptoPlaneServer:
             writer.close()              # dead writer: drop the connection
 
     async def _handle(self, reader, writer) -> None:
-        import asyncio
         wlock = asyncio.Lock()
         tasks: set = set()
         try:
@@ -277,14 +291,21 @@ class CryptoPlaneServer:
             writer.close()
 
     async def start(self) -> None:
-        import asyncio
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
         self._worker = threading.Thread(target=self._worker_loop,
                                         daemon=True)
         self._worker.start()
-        self._server = await asyncio.start_unix_server(
-            self._handle, path=self.socket_path)
+        # owner-only FROM CREATION (umask, not post-hoc chmod — a chmod
+        # after listen leaves a connect window): any local user reaching
+        # the socket could churn the verdict cache and monopolize the
+        # single shared device
+        old_umask = os.umask(0o177)
+        try:
+            self._server = await asyncio.start_unix_server(
+                self._handle, path=self.socket_path)
+        finally:
+            os.umask(old_umask)
 
     async def stop(self) -> None:
         self._stop.set()
@@ -304,13 +325,18 @@ class ServiceEd25519Verifier(Ed25519Verifier):
     multiple outstanding submits are fine."""
 
     def __init__(self, socket_path: Optional[str] = None,
-                 connect_timeout: float = 5.0):
+                 connect_timeout: float = 5.0,
+                 request_timeout: float = 300.0):
         self.socket_path = socket_path or os.environ.get(
             "PLENUM_CRYPTO_SOCKET", DEFAULT_SOCKET)
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.settimeout(connect_timeout)
         self._sock.connect(self.socket_path)   # fail fast: operator error
-        self._sock.settimeout(None)
+        # blocking recv wears a generous deadline (service-side jax kernel
+        # compile can take ~2 min per shape) so a wedged service surfaces
+        # as ConnectionError -> local fallback, never an infinite hang
+        self._request_timeout = request_timeout
+        self._sock.settimeout(request_timeout)
         self._lock = threading.Lock()
         self._next_id = 0
         self._replies: dict[int, list] = {}
@@ -320,7 +346,16 @@ class ServiceEd25519Verifier(Ed25519Verifier):
 
     def _send(self, obj) -> None:
         payload = pack(obj)
-        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        try:
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        except socket.timeout:
+            # a timed-out sendall may have written a PARTIAL frame; the
+            # socket's framing is unrecoverable — kill it so every later
+            # use fails loudly instead of desyncing the stream
+            self._sock.close()
+            raise ConnectionError(
+                f"crypto service send stalled for "
+                f"{self._request_timeout:.0f}s (socket closed)") from None
 
     def _parse_frame(self):
         if len(self._rxbuf) < 4:
@@ -340,7 +375,17 @@ class ServiceEd25519Verifier(Ed25519Verifier):
             if frame is not None:
                 return frame
             if block:
-                chunk = self._sock.recv(65536)
+                try:
+                    chunk = self._sock.recv(65536)
+                except socket.timeout:
+                    # caller abandons the request; a reply landing later
+                    # for a caller that gave up helps nobody — close so
+                    # the wedged-service state is unambiguous
+                    self._sock.close()
+                    raise ConnectionError(
+                        f"crypto service unresponsive for "
+                        f"{self._request_timeout:.0f}s (socket closed)"
+                    ) from None
             else:
                 self._sock.setblocking(False)
                 try:
@@ -348,7 +393,7 @@ class ServiceEd25519Verifier(Ed25519Verifier):
                 except BlockingIOError:
                     return None
                 finally:
-                    self._sock.setblocking(True)
+                    self._sock.settimeout(self._request_timeout)
             if not chunk:
                 raise ConnectionError("crypto service closed")
             self._rxbuf += chunk
@@ -461,7 +506,6 @@ def make_bls_verifier(backend: str):
 
 
 def main(argv=None):
-    import asyncio
 
     from plenum_tpu.crypto.ed25519 import make_verifier
 

@@ -137,22 +137,40 @@ def main(argv=None):
         profiler = cProfile.Profile(time.process_time)
         profiler.enable()
 
-    def _finalize_and_exit(signum, frame):
+    # SIGTERM only SETS a flag: the tail work (profiler dump + metrics
+    # flush) runs from the event loop below, where no accumulator can be
+    # mid-mutation — flushing from signal context raced add_event and
+    # could silently lose the tail flush. Escalation keeps a WEDGED node
+    # killable: a second SIGTERM (or the alarm if the loop never polls
+    # the flag) hard-exits without the tail flush.
+    term = {"requested": False}
+
+    def _request_term(signum, frame):
+        if term["requested"]:           # second SIGTERM: loop is stuck
+            os._exit(143)
+        term["requested"] = True
+        _signal.alarm(10)               # loop dead -> SIGALRM hard-exits
+
+    _signal.signal(_signal.SIGALRM, lambda s, f: os._exit(143))
+
+    def _finalize_and_exit():
+        # the loop is provably alive here — stand down the dead-loop
+        # alarm so a >10s flush isn't hard-killed mid-append (a second
+        # SIGTERM still escalates if the flush itself wedges)
+        _signal.alarm(0)
         if profiler is not None:
             profiler.disable()
             profiler.dump_stats(args.profile)
         try:
             # capture the tail of the run: gauges + accumulators since the
-            # last periodic flush would otherwise die with the process.
-            # Skip if the signal landed INSIDE a periodic flush — a
-            # re-entered KV append would interleave torn records.
-            if not getattr(node, "_in_metrics_flush", False):
-                node._flush_metrics()
+            # last periodic flush would otherwise die with the process
+            node._flush_metrics()
         except Exception:
             pass
-        os._exit(0)
+        # 128+SIGTERM: supervisors must see termination, not a clean exit
+        os._exit(143)
 
-    _signal.signal(_signal.SIGTERM, _finalize_and_exit)
+    _signal.signal(_signal.SIGTERM, _request_term)
     looper = Looper()
     looper.add(prodable)
 
@@ -161,12 +179,18 @@ def main(argv=None):
                           "node_port": prodable.node_stack.port,
                           "client_port": prodable.client_stack.port}),
               flush=True)
+        last_status = time.monotonic()
         while True:
-            await asyncio.sleep(60)
-            info = node.validator_info()
-            print(json.dumps({"uptime": round(info["uptime"], 1),
-                              "last_ordered_3pc": info["last_ordered_3pc"],
-                              "connected": info["connected"]}), flush=True)
+            await asyncio.sleep(0.25)
+            if term["requested"]:
+                _finalize_and_exit()
+            if time.monotonic() - last_status >= 60:
+                last_status = time.monotonic()
+                info = node.validator_info()
+                print(json.dumps(
+                    {"uptime": round(info["uptime"], 1),
+                     "last_ordered_3pc": info["last_ordered_3pc"],
+                     "connected": info["connected"]}), flush=True)
 
     looper.run(forever())
 

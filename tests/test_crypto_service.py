@@ -229,3 +229,43 @@ def test_bls_checks_ride_the_plane_and_dedupe(service):
     assert not a.verify_multi_sig(agg, message, vks[:2])
     assert not a.verify_multi_sig(agg, b"other", vks)
     a.close()
+
+
+def test_bls_single_flight_survives_cancellation(tmp_path):
+    """A client disconnect cancels its _process task mid-pairing; the
+    single-flight future must still resolve (and the key must be popped)
+    so later identical checks don't await a dead future forever."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.crypto_service import CryptoPlaneServer
+    server = CryptoPlaneServer(CpuEd25519Verifier(),
+                               socket_path=str(tmp_path / "c.sock"))
+    in_pairing = threading.Event()
+    release = threading.Event()
+
+    def slow_verify(sig, msg, vks):
+        in_pairing.set()
+        assert release.wait(5.0)
+        return True
+
+    server._bls.verify_multi_sig = slow_verify
+    msg = b"cancel-regression-%d" % os.getpid()   # dodge the global cache
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        first = asyncio.ensure_future(
+            server._bls_check(loop, "sig", msg, ["vk1", "vk2"]))
+        while not in_pairing.is_set():
+            await asyncio.sleep(0.01)
+        first.cancel()                 # the disconnecting client
+        with pytest.raises(asyncio.CancelledError):
+            await first
+        # identical check from a co-hosted node: joins the in-flight
+        # pairing and must resolve once it completes
+        second = asyncio.ensure_future(
+            server._bls_check(loop, "sig", msg, ["vk1", "vk2"]))
+        await asyncio.sleep(0.05)
+        release.set()
+        return await asyncio.wait_for(second, timeout=5.0)
+
+    assert asyncio.run(scenario()) is True
+    assert server._bls_pending == {}
