@@ -171,4 +171,86 @@ long mptc_encode_hash(int32_t n_items, const uint32_t* lens,
     return n;
 }
 
+// Batch encode+hash with BACKREFS: the trie's whole dirty set for a 3PC
+// batch in ONE call (per-node ctypes dispatch measured ~2x slower than
+// Python; the batch amortizes it). Nodes arrive in post-order (children
+// before parents). Item tags:
+//   -1  literal byte string (RLP string-encode; next chunk of concat)
+//   -2  raw RLP splice (pre-encoded inline child; next chunk of concat)
+//   j>=0 backref to node j: splice node j's RLP raw when it is <32 bytes
+//        (an inline child, per the MPT ref rule), else string-encode its
+//        32-byte SHA3 from out_hashes
+// `lens` has one entry PER CHUNK (tag<0 items in order), not per item —
+// the Python caller builds it with a single map(len, chunks).
+// Node RLPs are written contiguously into out; out_lens[i] and
+// out_hashes[32*i..] are filled for EVERY node. Returns total bytes,
+// -1 on cap overflow, -2 on a forward backref.
+long mptc_encode_hash_batch(int32_t n_nodes, const int32_t* item_counts,
+                            const int32_t* tags, const uint32_t* lens,
+                            const uint8_t* concat, uint8_t* out,
+                            uint64_t cap64, uint32_t* out_lens,
+                            uint8_t* out_hashes) {
+    const size_t cap = static_cast<size_t>(cap64);
+    uint64_t* offs = new uint64_t[n_nodes > 0 ? n_nodes : 1];
+    size_t cursor = 0;     // next write position in out
+    size_t item_idx = 0;
+    size_t chunk_idx = 0;
+    size_t data_off = 0;
+    for (int32_t ni = 0; ni < n_nodes; ++ni) {
+        const size_t node_off = cursor;
+        size_t pos = node_off + 9;    // gap for the largest list header
+        if (pos + 9 > cap) { delete[] offs; return -1; }
+        for (int32_t k = 0; k < item_counts[ni]; ++k, ++item_idx) {
+            const int32_t tag = tags[item_idx];
+            if (tag == -1) {
+                const size_t il = lens[chunk_idx++];
+                if (pos + 9 + il > cap) { delete[] offs; return -1; }
+                const uint8_t* item = concat + data_off;
+                if (il == 1 && item[0] < 0x80) {
+                    out[pos++] = item[0];
+                } else {
+                    pos += len_prefix(il, 0x80, out + pos);
+                    std::memcpy(out + pos, item, il);
+                    pos += il;
+                }
+                data_off += il;
+            } else if (tag == -2) {
+                const size_t il = lens[chunk_idx++];
+                if (pos + il > cap) { delete[] offs; return -1; }
+                std::memcpy(out + pos, concat + data_off, il);
+                pos += il;
+                data_off += il;
+            } else {
+                if (tag >= ni) { delete[] offs; return -2; }
+                const uint32_t cl = out_lens[tag];
+                if (cl < 32) {    // inline child: splice its RLP raw
+                    if (pos + cl > cap) { delete[] offs; return -1; }
+                    std::memcpy(out + pos, out + offs[tag], cl);
+                    pos += cl;
+                } else {          // hashed child: 0xa0 + 32-byte digest
+                    if (pos + 33 > cap) { delete[] offs; return -1; }
+                    out[pos++] = 0x80 + 32;
+                    std::memcpy(out + pos,
+                                out_hashes + 32 * static_cast<size_t>(tag),
+                                32);
+                    pos += 32;
+                }
+            }
+        }
+        const size_t payload = pos - (node_off + 9);
+        uint8_t hdr[16];
+        const size_t hl = len_prefix(payload, 0xc0, hdr);
+        std::memmove(out + node_off + hl, out + node_off + 9, payload);
+        std::memcpy(out + node_off, hdr, hl);
+        const size_t total = hl + payload;
+        out_lens[ni] = static_cast<uint32_t>(total);
+        offs[ni] = node_off;
+        sha3_256(out + node_off, total,
+                 out_hashes + 32 * static_cast<size_t>(ni));
+        cursor = node_off + total;
+    }
+    delete[] offs;
+    return static_cast<long>(cursor);
+}
+
 }  // extern "C"

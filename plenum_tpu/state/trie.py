@@ -64,6 +64,41 @@ def hex_prefix_decode(data: bytes) -> tuple[list[int], bool]:
     return rest, leaf
 
 
+class _Dirty:
+    """Deferred ref: a freshly-built node whose RLP+SHA3 (and db write)
+    are postponed to the next root_hash resolution, where the WHOLE
+    dirty set is encoded+hashed in one native batch call
+    (native_codec.encode_hash_many / native/mptcodec.cpp).
+
+    Deferral also deduplicates the spine: k writes in a 3PC batch
+    rebuild the root-adjacent nodes k times, and only the LAST version
+    of each position is ever hashed — the reference
+    (state/trie/pruning_trie.py:215) encodes+hashes every intermediate.
+
+    Invariant: a _Dirty appears only as a DIRECT item of another dirty
+    node's list or of root_node (every freshly-built list is wrapped by
+    _store before being embedded), so collection/substitution walk one
+    level per node. A violation fails loudly in rlp.encode."""
+    __slots__ = ("node",)
+
+    def __init__(self, node):
+        self.node = node
+
+
+def _collect_dirty(lst, order: list) -> None:
+    """Post-order (children first) over the _Dirty tree."""
+    for x in lst:
+        if type(x) is _Dirty:
+            _collect_dirty(x.node, order)
+            order.append(x)
+
+
+def _substitute(lst, ref_of: dict) -> None:
+    for i, x in enumerate(lst):
+        if type(x) is _Dirty:
+            lst[i] = ref_of[id(x)]
+
+
 class Trie:
     # hashed refs are content-addressed, so a decoded node can be cached
     # forever; the upper levels of the trie repeat on every key's path and
@@ -85,20 +120,16 @@ class Trie:
     # --- refs -------------------------------------------------------------
 
     def _store(self, node) -> object:
-        """node (decoded form) -> ref (inline rlp-decoded node or 32B hash)."""
+        """node (decoded form) -> ref. Deferred: the inline-vs-hash
+        decision and the db write happen at the next root_hash
+        resolution (one native batch call for the whole dirty set)."""
         if node == BLANK_NODE:
             return b""
-        enc = rlp.encode(node)
-        if len(enc) < 32:
-            return node
-        h = sha3(enc)
-        self.db.put(h, enc)
-        # freshly-stored nodes are read right back on the next key's walk;
-        # callers never mutate a node after storing it (copy-on-write)
-        self._cache_put(h, node)
-        return h
+        return _Dirty(node)
 
     def _load(self, ref):
+        if type(ref) is _Dirty:
+            return ref.node
         if ref == b"" or ref == BLANK_NODE:
             return BLANK_NODE
         if isinstance(ref, bytes) and len(ref) == 32:
@@ -136,10 +167,67 @@ class Trie:
     def root_hash(self) -> bytes:
         if self.root_node == BLANK_NODE:
             return BLANK_ROOT
+        self._resolve_dirty()
         enc = rlp.encode(self.root_node)
         h = sha3(enc)
         self.db.put(h, enc)     # root is always persisted by hash
         return h
+
+    def _resolve_dirty(self) -> None:
+        """Encode+hash+persist every deferred node below the root, one
+        native batch call for the lot (pure-Python twin when the
+        toolchain is absent). Children resolve before parents; a child
+        whose RLP is <32 bytes becomes an inline ref (the node itself),
+        exactly as the eager path decided per node."""
+        root = self.root_node
+        if type(root) is not list:
+            return
+        order: list[_Dirty] = []
+        _collect_dirty(root, order)
+        if not order:
+            return
+        from . import native_codec
+        encoded = None
+        if native_codec.available():
+            index = {id(x): i for i, x in enumerate(order)}
+            counts, tags, chunks = [], [], []
+            ap_t, ap_c = tags.append, chunks.append
+            for x in order:
+                node = x.node
+                counts.append(len(node))
+                for it in node:
+                    t = type(it)
+                    if t is bytes:
+                        ap_t(-1)
+                        ap_c(it)
+                    elif t is _Dirty:
+                        ap_t(index[id(it)])
+                    else:             # clean inline child (nested list)
+                        ap_t(-2)
+                        ap_c(rlp.encode(it))
+            encoded = native_codec.encode_hash_batch(counts, tags, chunks)
+        ref_of: dict[int, object] = {}
+        if encoded is not None:
+            for x, (enc, h) in zip(order, encoded):
+                _substitute(x.node, ref_of)
+                if len(enc) < 32:
+                    ref_of[id(x)] = x.node
+                else:
+                    self.db.put(h, enc)
+                    self._cache_put(h, x.node)
+                    ref_of[id(x)] = h
+        else:
+            for x in order:
+                _substitute(x.node, ref_of)
+                enc = rlp.encode(x.node)
+                if len(enc) < 32:
+                    ref_of[id(x)] = x.node
+                else:
+                    h = sha3(enc)
+                    self.db.put(h, enc)
+                    self._cache_put(h, x.node)
+                    ref_of[id(x)] = h
+        _substitute(root, ref_of)
 
     @root_hash.setter
     def root_hash(self, value: bytes) -> None:
@@ -335,6 +423,7 @@ class Trie:
 
     def produce_proof(self, key: bytes) -> list[bytes]:
         """RLP-encoded nodes along the path of `key` (root first)."""
+        self._resolve_dirty()           # _prove encodes nodes directly
         proof: list[bytes] = []
         self._prove(self.root_node, bytes_to_nibbles(key), proof, True)
         return proof
