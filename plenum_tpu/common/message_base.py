@@ -13,6 +13,8 @@ import dataclasses
 from dataclasses import dataclass, fields as dc_fields
 from typing import Any, ClassVar, Optional, get_args, get_origin, Union
 
+from .serialization import CanonicalDict
+
 
 class MessageValidationError(ValueError):
     pass
@@ -189,6 +191,8 @@ def _plainify_for_hash(v: Any) -> Any:
 
 
 def _plainify(v: Any) -> Any:
+    if type(v) is CanonicalDict:
+        return v            # already canonical+immutable: share, don't copy
     if isinstance(v, MessageBase):
         return v.to_dict()
     if isinstance(v, (list, tuple)):

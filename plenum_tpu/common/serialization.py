@@ -21,7 +21,48 @@ def unpack(data: bytes) -> Any:
     return msgpack.unpackb(data, raw=False, strict_map_key=False)
 
 
+class CanonicalDict(dict):
+    """A dict ALREADY in canonical form (str keys sorted, nested values
+    canonical): pack()/_sort_maps trust it and skip the deep walk. The
+    serialize-once seam for the propagate path — a request's canonical
+    form is built once (Request.to_dict) and embedded by reference in
+    every hop's message instead of being re-walked per pack (the
+    reference re-serializes per send, common/batched.py:20 over
+    prepForSending). Immutable, so a shared cached instance can never
+    be silently poisoned; build a new dict to change content."""
+
+    def _immutable(self, *a, **k):
+        raise TypeError("CanonicalDict is immutable; build a new dict")
+
+    __setitem__ = __delitem__ = __ior__ = _immutable
+    update = pop = popitem = clear = setdefault = _immutable
+
+
+def canonicalize(obj: Any) -> Any:
+    """obj -> canonical immutable form (CanonicalDict / tuples), the
+    cached-and-shared twin of _sort_maps."""
+    if type(obj) is CanonicalDict:
+        return obj
+    if isinstance(obj, dict):
+        keys = list(obj)
+        if all(type(k) is str for k in keys):
+            keys.sort()
+        else:
+            keys.sort(key=lambda k: (type(k).__name__, str(k)))
+        return CanonicalDict(
+            (k, canonicalize(obj[k])
+             if isinstance(obj[k], (dict, list, tuple)) else obj[k])
+            for k in keys)
+    if isinstance(obj, (list, tuple)):
+        return tuple(canonicalize(v)
+                     if isinstance(v, (dict, list, tuple)) else v
+                     for v in obj)
+    return obj
+
+
 def _sort_maps(obj: Any) -> Any:
+    if type(obj) is CanonicalDict:
+        return obj
     if isinstance(obj, dict):
         keys = list(obj)
         if all(type(k) is str for k in keys):
