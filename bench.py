@@ -82,46 +82,81 @@ def _run_tcp_pool(n_nodes=4, n_txns=200, backend="cpu"):
         return None
 
 
+def _median_run(runs):
+    """-> (the run whose tps is the median, {min,max,n} spread) over the
+    completed runs; (None, None) when none completed. The headline rides
+    a ±15-20% host-noise band on single passes (VERDICT r4 weak #3) —
+    medians of 3 make round-over-round deltas meaningful."""
+    good = [r for r in runs if r and r.get("txns_ordered")]
+    if not good:
+        return None, None
+    good.sort(key=lambda r: r["tps"])
+    tps = [r["tps"] for r in good]
+    return good[len(good) // 2], {"min": min(tps), "max": max(tps),
+                                  "n": len(good)}
+
+
 def main():
     from plenum_tpu.tools.local_pool import run_load
 
-    cpu = run_load(n_nodes=4, n_txns=300, backend="cpu")
-    tcp = _run_tcp_pool()
+    REPEAT = int(os.environ.get("BENCH_REPEAT", "3"))
+    cpu, cpu_spread = _median_run(
+        [run_load(n_nodes=4, n_txns=300, backend="cpu")
+         for _ in range(REPEAT)])
+    tcp, tcp_spread = _median_run(
+        [_run_tcp_pool() for _ in range(REPEAT)])
     # the same 4-process pool verifying through the cross-process crypto
     # plane (parallel/crypto_service.py): host-wide verdict dedup collapses
     # the n-times-per-request verification of the propagate path
-    tcpsvc = _run_tcp_pool(n_txns=300, backend="service:cpu")
+    tcpsvc, tcpsvc_spread = _median_run(
+        [_run_tcp_pool(n_txns=300, backend="service:cpu")
+         for _ in range(REPEAT)])
     tcp7 = _run_tcp_pool(n_nodes=7, n_txns=100)   # f=2 scale datum
     jax_stats = _run_jax_pool_subprocess()
 
     REF_TPS = 74.0      # measured reference peak on this host (BASELINE.md)
     jax_ok = "tps" in jax_stats
     # headline: the best REAL-TRANSPORT 4-node figure (VERDICT r2: the TCP
-    # pool is the honest baseline; in-process double-counts parallelism).
+    # pool is the honest baseline; in-process double-counts parallelism),
+    # as a MEDIAN of REPEAT runs, with the winning config named so the
+    # trend line stays comparable run-to-run (ADVICE r4).
     # The jax pool is reported alongside — on this single tunneled chip it
     # matches one CPU core, so it informs the device story, not the
     # headline (docs/performance.md "TPU path").
-    tcp_ok = bool(tcp and tcp.get("txns_ordered"))
-    tcpsvc_ok = bool(tcpsvc and tcpsvc.get("txns_ordered"))
-    candidates = [t["tps"] for t, ok in ((tcp, tcp_ok), (tcpsvc, tcpsvc_ok))
-                  if ok]
-    value = max(candidates) if candidates else (
-        jax_stats["tps"] if jax_ok else cpu["tps"])
+    candidates = [(t["tps"], name, sp)
+                  for t, name, sp in ((tcp, "tcp", tcp_spread),
+                                      (tcpsvc, "tcpsvc", tcpsvc_spread))
+                  if t is not None]
+    if candidates:
+        value, headline_config, spread = max(candidates)
+    elif jax_ok:
+        value, headline_config, spread = jax_stats["tps"], "jax", None
+    elif cpu is not None:
+        value, headline_config, spread = cpu["tps"], "cpu", cpu_spread
+    else:
+        value, headline_config, spread = 0.0, "none", None
     result = {
         "metric": "pool_write_tps_4node",
         "value": value,
         "unit": "txns/s",
         "vs_baseline": round(value / REF_TPS, 3),
+        "headline_config": headline_config,
         "ref_tps": REF_TPS,
-        "cpu_tps": cpu["tps"],
-        "cpu_p50_ms": cpu["p50_latency_ms"],
     }
-    if tcp_ok:
+    if spread is not None:
+        result["spread"] = spread
+    if cpu is not None:
+        result["cpu_tps"] = cpu["tps"]
+        result["cpu_p50_ms"] = cpu["p50_latency_ms"]
+        result["cpu_spread"] = cpu_spread
+    if tcp is not None:
         result["tcp_tps"] = tcp["tps"]          # 4 OS processes, real TCP
         result["tcp_p50_ms"] = tcp.get("p50_latency_ms")
-    if tcpsvc_ok:
+        result["tcp_spread"] = tcp_spread
+    if tcpsvc is not None:
         result["tcpsvc_tps"] = tcpsvc["tps"]    # + shared crypto plane
         result["tcpsvc_p50_ms"] = tcpsvc.get("p50_latency_ms")
+        result["tcpsvc_spread"] = tcpsvc_spread
         svc = tcpsvc.get("crypto_service") or {}
         if svc.get("items"):
             result["tcpsvc_dedup"] = round(
@@ -138,7 +173,8 @@ def main():
             "jax_tps": jax_stats["tps"],    # real-device in-process pool
             "jax_p50_ms": jax_stats["p50_latency_ms"],
             "jax_ordered": jax_stats["txns_ordered"],
-            "ledgers_agree": bool(cpu["ledger_sizes_agree"]
+            "ledgers_agree": bool((cpu is None
+                                   or cpu["ledger_sizes_agree"])
                                   and jax_stats["ledger_sizes_agree"]),
         })
     else:

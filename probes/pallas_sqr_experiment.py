@@ -1,0 +1,138 @@
+"""Pallas-vs-XLA experiment: the Ed25519 ladder's serial squaring chain.
+
+docs/performance.md lists a "Pallas field kernel" as a future direction
+— the hypothesis is that a fused VMEM-resident ladder block removes XLA
+scheduling overhead from the serial-depth-bound chain. This script
+measures exactly that on the hottest primitive: z^(2^k) (the quarter
+ladder runs 64 such doublings; inversion runs ~254).
+
+Pallas kernel layout is limb-major [NLIMB, N] (lanes = batch), the
+transposed twin of ops.ed25519's batch-major [..., NLIMB]; the field
+math (radix-13 int32 schoolbook square + 2^260 fold + 3 carry passes)
+is copied bound-for-bound from ops/ed25519.py f_sqr/_fold_coeffs/_carry
+and differentially checked against it and against pure-int ground truth.
+
+Run on the real device:  python probes/pallas_sqr_experiment.py
+(probes the relay first; prints one JSON line per measurement).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+from plenum_tpu.ops.ed25519 import (FOLD, MASK, NLIMB, P, RADIX,
+                                    _pow2k, int_to_limbs, limbs_to_int)
+
+
+def _sqr_limb_major(x):
+    """f_sqr for [NLIMB, N] int32 (ops/ed25519.py:f_sqr transposed)."""
+    import jax.numpy as jnp
+    f2 = x + x
+    c = [None] * (2 * NLIMB - 1)
+    for i in range(NLIMB):
+        prod = x[i] * x[i]
+        c[2 * i] = prod if c[2 * i] is None else c[2 * i] + prod
+        for j in range(i + 1, NLIMB):
+            prod = f2[i] * x[j]
+            c[i + j] = prod if c[i + j] is None else c[i + j] + prod
+    for k in range(2 * NLIMB - 2, NLIMB - 1, -1):
+        lo = c[k] & MASK
+        hi = c[k] >> RADIX
+        c[k - NLIMB] = c[k - NLIMB] + lo * FOLD
+        c[k - NLIMB + 1] = c[k - NLIMB + 1] + hi * FOLD
+    acc = jnp.stack(c[:NLIMB], axis=0)
+    for _ in range(3):
+        lo = acc & MASK
+        hi = acc >> RADIX
+        acc = lo + jnp.concatenate([hi[NLIMB - 1:] * FOLD,
+                                    hi[:NLIMB - 1]], axis=0)
+    return acc
+
+
+def make_pallas_chain(k: int, n: int):
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        x = x_ref[...]
+        for _ in range(k):          # unrolled: k is a static chain length
+            x = _sqr_limb_major(x)
+        o_ref[...] = x
+
+    @jax.jit
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((NLIMB, n), x.dtype),
+        )(x)
+
+    return run
+
+
+def make_xla_chain(k: int):
+    import jax
+
+    @jax.jit
+    def run(x):                     # batch-major [N, NLIMB]
+        return _pow2k(x, k)
+
+    return run
+
+
+def main():
+    from plenum_tpu.tools.tpu_probe import probe_relay
+    if not probe_relay()["up"]:
+        print(json.dumps({"error": "device relay down"}))
+        return 1
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(json.dumps({"device": str(dev), "platform": dev.platform}),
+          flush=True)
+
+    rng = np.random.default_rng(5)
+    N, K = 2048, 64                 # the quarter ladder's doubling count
+    vals = [int(rng.integers(0, 1 << 62)) * int(rng.integers(0, 1 << 62))
+            % P for _ in range(N)]
+    batch_major = np.stack([int_to_limbs(v) for v in vals])     # [N, L]
+    limb_major = np.ascontiguousarray(batch_major.T)            # [L, N]
+
+    # ground truth on the first 4 lanes
+    truth = [pow(v, pow(2, K, P - 1), P) for v in vals[:4]]
+
+    results = {}
+    for name, fn, arg, back in (
+            ("xla", make_xla_chain(K), jnp.asarray(batch_major), "rows"),
+            ("pallas", make_pallas_chain(K, N), jnp.asarray(limb_major),
+             "cols")):
+        t0 = time.perf_counter()
+        out = np.asarray(fn(arg))
+        compile_s = time.perf_counter() - t0
+        lanes = out[:4] if back == "rows" else out[:, :4].T
+        for lane, want in zip(lanes, truth):
+            assert limbs_to_int(lane) % P == want, f"{name} wrong"
+        times = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(arg))
+            times.append(time.perf_counter() - t0)
+        results[name] = {"compile_s": round(compile_s, 2),
+                         "warm_best_ms": round(min(times) * 1e3, 3),
+                         "warm_median_ms": round(
+                             sorted(times)[3] * 1e3, 3)}
+        print(json.dumps({name: results[name], "batch": N, "chain": K}),
+              flush=True)
+    ratio = results["xla"]["warm_best_ms"] / results["pallas"]["warm_best_ms"]
+    print(json.dumps({"speedup_pallas_vs_xla": round(ratio, 3)}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
