@@ -20,7 +20,7 @@ from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
                                              CONFIG_LEDGER_ID,
                                              DOMAIN_LEDGER_ID, POOL_LEDGER_ID)
 from plenum_tpu.common.request import Request
-from plenum_tpu.common.serialization import pack, unpack
+from plenum_tpu.common.serialization import canonicalize, pack, unpack
 from plenum_tpu.execution import txn as txn_lib
 from plenum_tpu.execution.database_manager import (DatabaseManager,
                                                    SEQ_NO_DB_LABEL,
@@ -175,7 +175,11 @@ class WriteRequestManager:
             txn_lib.set_seq_no(txn, base_seq + len(txns) + 1)
             txn_lib.set_txn_time(txn, int(pp_time))
             handler.update_state(txn, is_committed=False)
-            txns.append(txn)
+            # final form: canonicalize ONCE so the merkle leaf, the txn-log
+            # write, and the client REPLY all pack without re-walking
+            # (serialization.CanonicalDict); mutation past this point
+            # raises instead of silently forking the ledger
+            txns.append(canonicalize(txn))
             valid.append(req)
         ledger.append_txns_to_uncommitted(txns)
 
@@ -188,7 +192,7 @@ class WriteRequestManager:
                 else self._resolve_primaries(view_no),
                 self._node_reg_provider(), last)
             txn_lib.set_seq_no(audit_txn, audit_ledger.uncommitted_size + 1)
-            audit_ledger.append_txns_to_uncommitted([audit_txn])
+            audit_ledger.append_txns_to_uncommitted([canonicalize(audit_txn)])
 
         self._batches.append(_Undo(ledger_id, len(txns), prev_roots, pp_seq_no))
         pool_state = self.db.get_state(POOL_LEDGER_ID)

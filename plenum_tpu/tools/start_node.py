@@ -23,13 +23,23 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
     """-> (prodable, node, registry) ready for a Looper."""
     from plenum_tpu.common.node_messages import POOL_LEDGER_ID
     from plenum_tpu.common.timer import QueueTimer
-    from plenum_tpu.config import Config, load_config
+    from plenum_tpu.config import load_config
     from plenum_tpu.network.tcp_stack import (ClientStack, NodeRegistry,
                                               TcpStack)
     from plenum_tpu.node import Node, NodeBootstrap
     from plenum_tpu.node.looper import Prodable
     from plenum_tpu.tools.genesis import load_genesis_files
     from plenum_tpu.tools.keygen import load_keys
+
+    # operator overrides ride one env var of JSON (the reference layers
+    # /etc + network + user config the same way, common/config_util.py);
+    # unknown keys fail loudly in load_config. Merged FIRST so every
+    # consumer below — data_dir, the bootstrap's crypto plane, the
+    # stacks — sees ONE config, never a CLI/env split.
+    overrides = json.loads(os.environ.get("PLENUM_CONFIG_JSON", "{}"))
+    config = load_config({"crypto_backend": backend, "kv_backend": kv},
+                         overrides)
+    backend, kv = config.crypto_backend, config.kv_backend
 
     keys = load_keys(base_dir, name)
     genesis = load_genesis_files(base_dir)
@@ -65,7 +75,6 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
         KvFile(os.path.join(base_dir, name, "metrics")))
     node_stack = TcpStack(name, my_ha[0], my_ha[1], registry,
                           seed=bytes.fromhex(keys["seed"]))
-    config = Config(crypto_backend=backend, kv_backend=kv)
     client_stack = ClientStack(name, my_client_ha[0], my_client_ha[1],
                                on_request=None,
                                max_connections=config.MAX_CONNECTED_CLIENTS,

@@ -103,7 +103,9 @@ async def drive_load(addrs, f, requests, window: int, timeout: float):
 def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                  base_dir: str | None = None, timeout: float = 120.0,
                  profile_dir: str | None = None,
-                 service_min_batch: int = 128) -> dict:
+                 service_min_batch: int = 128,
+                 window: int = 100,
+                 config_overrides: dict | None = None) -> dict:
     from plenum_tpu.client.wallet import Wallet
     from plenum_tpu.execution.txn import NYM
 
@@ -114,6 +116,12 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     specs = setup_pool_dir(tmp, names, trustee_seed)
 
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    # reproducibility: node config comes ONLY from the explicit param —
+    # a stray PLENUM_CONFIG_JSON in the operator shell must not silently
+    # reconfigure every bench node
+    env.pop("PLENUM_CONFIG_JSON", None)
+    if config_overrides:
+        env["PLENUM_CONFIG_JSON"] = json.dumps(config_overrides)
     procs = []
     service_proc = None
     # "service:<inner>" runs the cross-process crypto plane: ONE process
@@ -172,7 +180,7 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                  for name, spec in zip(names, specs)}
         t0 = time.perf_counter()
         done, submit_times = asyncio.run(
-            drive_load(addrs, f, requests, window=100, timeout=timeout))
+            drive_load(addrs, f, requests, window=window, timeout=timeout))
         t_total = (max(done.values()) - t0) if done else 0.0
         lat = sorted(done[k] - submit_times[k] for k in done)
         service_stats = None
