@@ -238,6 +238,17 @@ class JaxEd25519Verifier(Ed25519Verifier):
     Device: one verify_kernel dispatch over the padded batch.
     """
 
+    # Compressed dispatch (round 5): ship RAW BYTES (32 B S + 32 B h +
+    # 32 B R + 4 B key index per signature, 32 B per distinct verkey) and
+    # let the device decompress keys, unpack digits, and build the window
+    # tables per KEY instead of per signature. ~4.7x fewer bytes per
+    # signature and 40x per key on a link that is ~80% of dispatch cost —
+    # and the pure-Python per-new-verkey host work (modular sqrt + 192
+    # bigint doublings, ~1 ms) disappears from the 1-core host entirely.
+    # The sharded plane keeps the limb-staged path until its SPMD program
+    # is ported (it overrides _device_verify on the staged arrays).
+    _compressed_dispatch = True
+
     def __init__(self, min_batch: int = 1, cache_size: int = 65536):
         # verkeys are attacker-supplied; the cache must be bounded (FIFO
         # evict). value: int32[4, 4, NLIMB] quarter-point rows, or None
@@ -270,6 +281,86 @@ class JaxEd25519Verifier(Ed25519Verifier):
         return ((_ops.P - x) % _ops.P, y)
 
     def _dispatch(self, items: Sequence[VerifyItem]):
+        if self._compressed_dispatch:
+            return self._dispatch_bytes(items)
+        return self._dispatch_limbs(items)
+
+    def _dispatch_bytes(self, items: Sequence[VerifyItem]):
+        """Host staging for the compressed-dispatch kernel: per item one
+        sha512 + one mod-L reduction; everything ships as raw bytes.
+        Invalid verkeys are NOT screened here — the device's decompression
+        validity mask forces their verdicts False (same verdict the cpu
+        backend's host precheck gives, so backends can never disagree)."""
+        n = len(items)
+        verdict = np.zeros(n, dtype=bool)
+        if n == 0:
+            return verdict
+        idxs: list[int] = []
+        s_vals: list[bytes] = []
+        h_vals: list[bytes] = []
+        r_enc: list[bytes] = []
+        uniq: dict[bytes, int] = {}
+        u_keys: list[bytes] = []
+        a_idx: list[int] = []
+        for i, (msg, sig, vk) in enumerate(items):
+            try:
+                msg, sig, vk = bytes(msg), bytes(sig), bytes(vk)
+                if len(sig) != 64 or len(vk) != 32:
+                    continue
+                if int.from_bytes(sig[32:], "little") >= _ops.L:
+                    continue
+                h = int.from_bytes(
+                    hashlib.sha512(sig[:32] + vk + msg).digest(),
+                    "little") % _ops.L
+            except Exception:
+                continue    # contract: malformed input is a False verdict
+            u = uniq.get(vk)
+            if u is None:
+                u = uniq[vk] = len(u_keys)
+                u_keys.append(vk)
+            idxs.append(i)
+            s_vals.append(sig[32:])
+            h_vals.append(h.to_bytes(32, "little"))
+            r_enc.append(sig[:32])
+            a_idx.append(u)
+        if not idxs:
+            return verdict                     # all malformed: ready ndarray
+        m_pad, u_pad = self._pad_sizes(len(idxs), len(u_keys))
+        pad = m_pad - len(idxs)
+        # padding repeats the first row; its verdict is discarded
+        s_vals += [s_vals[0]] * pad
+        h_vals += [h_vals[0]] * pad
+        r_enc += [r_enc[0]] * pad
+        a_idx += [a_idx[0]] * pad
+        u_keys += [u_keys[0]] * (u_pad - len(u_keys))
+        s_u8 = np.frombuffer(b"".join(s_vals), np.uint8).reshape(m_pad, 32)
+        h_u8 = np.frombuffer(b"".join(h_vals), np.uint8).reshape(m_pad, 32)
+        r_u8 = np.frombuffer(b"".join(r_enc), np.uint8).reshape(m_pad, 32)
+        k_u8 = np.frombuffer(b"".join(u_keys), np.uint8).reshape(u_pad, 32)
+        idx = np.asarray(a_idx, dtype=np.int32)
+        ok = self._device_verify_bytes(s_u8, h_u8, k_u8, idx, r_u8)
+        return _JaxToken(ok, idxs, n)
+
+    def _pad_sizes(self, m: int, n_keys: int) -> tuple[int, int]:
+        """THE batch-shape bucketing policy, shared by both staging paths
+        (a divergence would double the compile-shape set): batch rows pad
+        to the next pow-2 >= min_batch; the unique-key table pads to
+        exactly TWO buckets per batch shape — {64-key, full} — so a
+        drifting active-client count costs at most two multi-minute
+        compiles, not one per pow-2 step."""
+        m_pad = 1
+        while m_pad < max(m, self._min_batch):
+            m_pad *= 2
+        small = min(64, m_pad)             # u <= m <= m_pad always holds
+        return m_pad, (small if n_keys <= small else m_pad)
+
+    def _device_verify_bytes(self, s_u8, h_u8, k_u8, idx, r_u8):
+        import jax.numpy as jnp
+        return _ops.verify_kernel_bytes(
+            jnp.asarray(s_u8), jnp.asarray(h_u8), jnp.asarray(k_u8),
+            jnp.asarray(idx), jnp.asarray(r_u8))
+
+    def _dispatch_limbs(self, items: Sequence[VerifyItem]):
         n = len(items)
         verdict = np.zeros(n, dtype=bool)
         if n == 0:
@@ -307,23 +398,13 @@ class JaxEd25519Verifier(Ed25519Verifier):
             r_enc.append(sig[:32])
         if not idxs:
             return verdict                     # all malformed: ready ndarray
-        m = len(idxs)
-        m_pad = 1
-        while m_pad < max(m, self._min_batch):
-            m_pad *= 2
-        pad = m_pad - m
+        m_pad, u_pad = self._pad_sizes(len(idxs), len(u_rows))
+        pad = m_pad - len(idxs)
         # padding repeats the first row; its verdict is discarded
         s_vals += [s_vals[0]] * pad
         h_vals += [h_vals[0]] * pad
         a_idx += [a_idx[0]] * pad
         r_enc += [r_enc[0]] * pad
-        # unique-key table padded to exactly TWO buckets per batch shape —
-        # {64-key, full} — so a drifting active-client count can cost at
-        # most two multi-minute compiles, not one per pow-2 step. The
-        # 64-row floor wastes <=40 KB per dispatch, noise next to the
-        # per-signature payload.
-        small = min(64, m_pad)             # u <= m <= m_pad always holds
-        u_pad = small if len(u_rows) <= small else m_pad
         u_rows += [u_rows[0]] * (u_pad - len(u_rows))
         qmask = (1 << _ops.QUARTER_SHIFT) - 1
         s_digits = _ops.scalar_windows(s_vals, _ops.N_COMB, _ops.CBITS)

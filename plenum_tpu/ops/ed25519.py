@@ -181,15 +181,9 @@ def _pow2k(z, k: int):
     return jax.lax.fori_loop(0, k, lambda i, v: f_sqr(v), z)
 
 
-def f_inv(z):
-    """z^(p-2) (Fermat inversion) via the standard curve25519 addition
-    chain: 254 squarings (grouped into pow2k fori_loops so the compiled
-    graph stays small) + 11 multiplies.
-
-    Needed to compress the recomputed R' on device (affine y = Y/Z), which
-    is what lets verification compare raw signature bytes instead of paying
-    a pure-Python modular sqrt per signature on host to decompress R.
-    """
+def _chain_250(z):
+    """Shared prefix of the curve25519 exponentiation chains:
+    -> (z^(2^250 - 1), z^11)."""
     z2 = f_sqr(z)                                     # 2
     z9 = f_mul(_pow2k(z2, 2), z)                      # 9
     z11 = f_mul(z9, z2)                               # 11
@@ -200,8 +194,27 @@ def f_inv(z):
     z_50 = f_mul(_pow2k(z_40, 10), z_10)              # 2^50 - 1
     z_100 = f_mul(_pow2k(z_50, 50), z_50)             # 2^100 - 1
     z_200 = f_mul(_pow2k(z_100, 100), z_100)          # 2^200 - 1
-    z_250 = f_mul(_pow2k(z_200, 50), z_50)            # 2^250 - 1
+    return f_mul(_pow2k(z_200, 50), z_50), z11        # 2^250 - 1
+
+
+def f_inv(z):
+    """z^(p-2) (Fermat inversion) via the standard curve25519 addition
+    chain: 254 squarings (grouped into pow2k fori_loops so the compiled
+    graph stays small) + 11 multiplies.
+
+    Needed to compress the recomputed R' on device (affine y = Y/Z), which
+    is what lets verification compare raw signature bytes instead of paying
+    a pure-Python modular sqrt per signature on host to decompress R.
+    """
+    z_250, z11 = _chain_250(z)
     return f_mul(_pow2k(z_250, 5), z11)               # 2^255 - 21 = p - 2
+
+
+def f_pow_p58(z):
+    """z^((p-5)/8) = z^(2^252 - 3) — the sqrt-candidate exponent of the
+    RFC 8032 §5.1.3 decompression for p = 5 mod 8. (2^250-1)*4 + 1."""
+    z_250, _ = _chain_250(z)
+    return f_mul(_pow2k(z_250, 2), z)
 
 
 def _carry_strict(c):
@@ -457,6 +470,145 @@ def verify_kernel_indexed(s_digits, h_digits, aq_unique, idx, ry, r_sign):
     return verify_kernel(s_digits, h_digits, aq, ry, r_sign)
 
 
+# --- device-side verkey decompression (the compressed dispatch path) ------
+
+_P_LIMBS = int_to_limbs(P)
+
+
+def _bytes_to_bits(u8):
+    """uint8[..., 32] -> int32[..., 256] little-endian bits."""
+    b = u8.astype(_I32)
+    bits = (b[..., :, None] >> jnp.arange(8, dtype=_I32)) & _I32(1)
+    return bits.reshape(*u8.shape[:-1], 256)
+
+
+def _bits_to_limbs(bits):
+    """int32[..., 256] bits -> int32[..., NLIMB] limbs of the low 255 bits.
+    One f32 matmul against the bit->limb weight matrix (weights < 2^13 and
+    each limb sums <= 13 bits -> exact in f32); bit 255 has zero weight."""
+    w = jnp.asarray(_BIT_TO_LIMB, jnp.float32)
+    return jnp.matmul(bits.astype(jnp.float32), w,
+                      precision=jax.lax.Precision.HIGHEST).astype(_I32)
+
+
+def _ge_p(y):
+    """Lexicographic y >= p over canonical-limbed y (non-canonical point
+    encodings must be REJECTED, matching host _precheck / RFC 8032)."""
+    p_limbs = jnp.asarray(_P_LIMBS)
+    gt = jnp.zeros(y.shape[:-1], bool)
+    eq = jnp.ones(y.shape[:-1], bool)
+    for i in range(NLIMB - 1, -1, -1):
+        gt = gt | (eq & (y[..., i] > p_limbs[i]))
+        eq = eq & (y[..., i] == p_limbs[i])
+    return gt | eq
+
+
+@jax.jit
+def decompress_kernel(keys_u8):
+    """Batched on-device verkey decompression -> quarter points of -A.
+
+    keys_u8: uint8[U, 32] raw compressed verkeys (32 B each — what the
+    host actually has; replaces the 1280 B/key limb rows of the indexed
+    dispatch, a 40x transfer cut where ~80% of a tunneled dispatch is
+    link time). Returns ((qx, qy, qz, qt) each int32[4, U, NLIMB] — the
+    quarter points [2^64k](-A) stacked quarter-major — plus valid bool[U]).
+
+    Math is RFC 8032 §5.1.3 (p = 5 mod 8): x = uv^3 (uv^7)^((p-5)/8),
+    corrected by sqrt(-1) when v x^2 = -u; rejects y >= p, off-curve
+    points, and x = 0 with the sign bit set — exactly the host-side
+    `decompress` (kept as the differential-test twin). The 192-doubling
+    quarter chain that the host used to pay in pure-Python bigints per
+    NEW verkey runs here too, batched over the deduped key table.
+    """
+    bits = _bytes_to_bits(keys_u8)                       # [U, 256]
+    sign = bits[..., 255]
+    y = _bits_to_limbs(bits)                             # [U, NLIMB]
+    noncanon = _ge_p(y)
+    u_ = keys_u8.shape[0]
+    one = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), (u_, NLIMB))
+    y2 = f_sqr(y)
+    u = f_sub(y2, one)
+    v = f_add(f_mul(y2, jnp.asarray(int_to_limbs(D))), one)
+    v3 = f_mul(f_sqr(v), v)
+    v7 = f_mul(f_sqr(v3), v)
+    x = f_mul(f_mul(u, v3), f_pow_p58(f_mul(u, v7)))
+    vxx = f_mul(v, f_sqr(x))
+    ok1 = jnp.all(f_canon(f_sub(vxx, u)) == 0, axis=-1)   # v x^2 =  u
+    ok2 = jnp.all(f_canon(f_add(vxx, u)) == 0, axis=-1)   # v x^2 = -u
+    x = jnp.where(ok1[..., None], x,
+                  f_mul(x, jnp.asarray(int_to_limbs(SQRT_M1))))
+    on_curve = ok1 | ok2
+    xc = f_canon(x)
+    x_zero = jnp.all(xc == 0, axis=-1)
+    neg_xc = f_canon(f_sub(jnp.asarray(_P_LIMBS), xc))
+    flip = (xc[..., 0] & _I32(1)) != sign
+    # A = (x flipped to the sign bit, y); the kernel wants -A = (-x, y)
+    negx = jnp.where(flip[..., None], xc, neg_xc)
+    valid = on_curve & ~noncanon & ~(x_zero & (sign == 1))
+    p0 = (negx, y, one, f_mul(negx, y))
+
+    def _dbl64(p):
+        return jax.lax.fori_loop(
+            0, QUARTER_SHIFT, lambda i, a: pt_double(a), p)
+
+    p1 = _dbl64(p0)
+    p2 = _dbl64(p1)
+    p3 = _dbl64(p2)
+    qx, qy, qz, qt = (jnp.stack([p0[c], p1[c], p2[c], p3[c]])
+                      for c in range(4))
+    return (qx, qy, qz, qt), valid
+
+
+def unpack_scalars_kernel(s_u8, h_u8, r_u8):
+    """Raw per-signature byte payloads -> the kernel's digit/limb arrays.
+
+    s_u8: uint8[N, 32] little-endian S (host-checked < L) -> the 8-bit
+          comb digits ARE the bytes.
+    h_u8: uint8[N, 32] little-endian h = SHA512(R||A||M) mod L; bytes
+          8q..8q+7 are quarter q, split into 16 nibble windows each.
+    r_u8: uint8[N, 32] raw R encoding -> (y limbs, sign bit).
+    Replaces 468 B/signature of host-staged int32 digit arrays with
+    100 B (s + h + R + idx) and moves the unpacking onto the device.
+    """
+    n = s_u8.shape[0]
+    s_digits = s_u8.astype(_I32).T                       # [32, N]
+    hb = h_u8.astype(_I32).reshape(n, N_QUARTERS, 8)
+    nib = jnp.stack([hb & _I32(0xF), hb >> _I32(4)], axis=-1)
+    h_digits = jnp.transpose(nib.reshape(n, N_QUARTERS, N_WIN),
+                             (2, 1, 0))                  # [16, 4, N]
+    rbits = _bytes_to_bits(r_u8)
+    ry = _bits_to_limbs(rbits)
+    return s_digits, h_digits, ry, rbits[..., 255]
+
+
+@jax.jit
+def verify_kernel_bytes(s_u8, h_u8, keys_u8, idx, r_u8):
+    """THE compressed dispatch: every payload in raw bytes, everything
+    else computed on device.
+
+    Host ships 32 B S + 32 B h + 32 B R + 4 B key index per signature
+    and 32 B per DISTINCT verkey; the device decompresses the keys,
+    builds the window tables ONCE PER KEY (the indexed path built them
+    per signature: 4N rows -> 4U rows, an N/U compute cut on top of the
+    transfer cut), gathers per-signature table banks, and runs the
+    double-scalar ladder. Signatures under an invalid key verify False.
+    """
+    n = idx.shape[0]
+    u_ = keys_u8.shape[0]
+    s_digits, h_digits, ry, r_sign = unpack_scalars_kernel(s_u8, h_u8, r_u8)
+    (qx, qy, qz, qt), valid = decompress_kernel(keys_u8)
+    tx, ty, tz, t2d = _build_a_tables(
+        qx.reshape(-1, NLIMB), qy.reshape(-1, NLIMB),
+        qz.reshape(-1, NLIMB), qt.reshape(-1, NLIMB))
+    tab = jnp.stack([tx, ty, tz, t2d])                   # [4c, 16, 4U, L]
+    tab = tab.reshape(4, 16, N_QUARTERS, u_, NLIMB)
+    tabf = jnp.transpose(tab, (2, 3, 1, 0, 4)).astype(jnp.float32)
+    tabf = tabf.reshape(N_QUARTERS, u_, 16, 4 * NLIMB)   # [q, U, d, 4L]
+    tabf = jnp.take(tabf, idx, axis=1)                   # [q, N, d, 4L]
+    ok = _banks_and_ladder(s_digits, h_digits, tabf, ry, r_sign, n)
+    return ok & jnp.take(valid, idx)
+
+
 @jax.jit
 def verify_kernel(s_digits, h_digits, aq, ry, r_sign):
     """Batched check compress([S]B + [h](-A)) == R-bytes.
@@ -479,24 +631,29 @@ def verify_kernel(s_digits, h_digits, aq, ry, r_sign):
     if s_digits.dtype != jnp.int32:
         raise TypeError("verify_kernel v3 takes int32 inputs")
     n = aq.shape[0]
-    ones = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), (n, NLIMB))
-    zeros = jnp.zeros((n, NLIMB), _I32)
-
     # quarter-major stacking: row k*n + i is quarter k of signature i
     qrows = jnp.moveaxis(aq, 0, 1)                     # [4, N, 4, NLIMB]
     tx, ty, tz, t2d = _build_a_tables(
         qrows[:, :, 0].reshape(-1, NLIMB), qrows[:, :, 1].reshape(-1, NLIMB),
         qrows[:, :, 2].reshape(-1, NLIMB), qrows[:, :, 3].reshape(-1, NLIMB))
+    tab = jnp.stack([tx, ty, tz, t2d])                 # [4c, 16, 4N, L]
+    tab = tab.reshape(4, 16, N_QUARTERS, n, NLIMB)
+    tabf = jnp.transpose(tab, (2, 3, 1, 0, 4)).astype(jnp.float32)
+    tabf = tabf.reshape(N_QUARTERS, n, 16, 4 * NLIMB)  # [q, N, d, 4L]
+    return _banks_and_ladder(s_digits, h_digits, tabf, ry, r_sign, n)
 
+
+def _banks_and_ladder(s_digits, h_digits, tabf, ry, r_sign, n):
+    """The shared back half of both kernels: select operand banks from
+    per-signature window tables (tabf [q, N, 16, 4L] f32), run the
+    split-window + comb ladder, compress, compare against R."""
+    ones = jnp.broadcast_to(jnp.asarray(int_to_limbs(1)), (n, NLIMB))
+    zeros = jnp.zeros((n, NLIMB), _I32)
     # ---- operand banks: table selections precomputed outside the loop
     # (they depend only on digits, never on the accumulator).
     # A-tables vary per signature -> f32 one-hot einsum on the VPU
     # (exact: carried limbs < 2^14 << 2^24). B comb tables are batch
     # constants -> one-hot MATMUL on the MXU.
-    tab = jnp.stack([tx, ty, tz, t2d])                 # [4c, 16, 4N, L]
-    tab = tab.reshape(4, 16, N_QUARTERS, n, NLIMB)
-    tabf = jnp.transpose(tab, (2, 3, 1, 0, 4)).astype(jnp.float32)
-    tabf = tabf.reshape(N_QUARTERS, n, 16, 4 * NLIMB)  # [q, N, d, 4L]
     oh_h = (h_digits[..., None] == jnp.arange(16, dtype=_I32)
             ).astype(jnp.float32)                      # [W, q, N, 16]
     bank_a = jnp.einsum('wqnd,qndl->wqnl', oh_h, tabf,

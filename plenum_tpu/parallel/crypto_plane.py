@@ -123,6 +123,11 @@ class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
     CoalescingVerifier wraps it unchanged and node traffic flows through
     `ShardedCryptoPlane.step` (SURVEY.md §2.3 distributed-comm row)."""
 
+    # the SPMD program consumes limb-staged arrays; the compressed byte
+    # dispatch is ported separately (the replicated unique-key table is
+    # already the deduped small payload here)
+    _compressed_dispatch = False
+
     def __init__(self, plane: ShardedCryptoPlane, min_batch: int = 1,
                  cache_size: int = 65536):
         inst = plane.mesh.shape["inst"]

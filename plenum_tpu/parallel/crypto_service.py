@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import collections
 import json
 import os
 import queue
@@ -98,62 +99,170 @@ class CryptoPlaneServer:
             except queue.Empty:
                 return jobs
 
+    # Up to 2 dispatch waves in flight: while wave k computes on the
+    # device, the worker drains the queue and STAGES wave k+1 (per-item
+    # sha512 + byte packing happen inside submit_batch), so host prep
+    # overlaps device compute instead of serializing behind it — the
+    # "double-buffer" lever from the round-4 tunnel decomposition
+    # (probes/tunnel_decomposition_r04.json: ~80% of a tunneled dispatch
+    # is link/staging time the device spends idle).
+    # Cross-wave dedup is preserved: a digest already computing in an
+    # in-flight wave is WAITED ON (the job attaches to that wave), never
+    # re-dispatched, so the co-hosted n-nodes-same-content case still
+    # costs one device verification.
+    _MAX_IN_FLIGHT = 2
+
     def _worker_loop(self) -> None:
-        while not self._stop.is_set():
+        waves: "collections.deque" = collections.deque()  # in flight, FIFO
+        pending: dict[bytes, int] = {}   # digest -> seq computing it
+        recent: dict[int, object] = {}   # landed seq -> verdicts | error str
+        next_seq = 1
+
+        def _finish(done, plan):
+            """Resolve one job from its plan: ('v', verdict) snapshots and
+            ('w', seq, digest) waits settled by landed waves. A wait on a
+            wave that is NOT in `recent` as a verdict dict (errored, or —
+            submit-failure path only — not yet landed) resolves the whole
+            job as an error: the job referenced a failed dispatch."""
+            self.stats["batches"] += 1
+            out, err = [], None
+            for entry in plan:
+                if entry[0] == "v":
+                    out.append(entry[1])
+                    continue
+                r = recent.get(entry[1])
+                if not isinstance(r, dict):
+                    err = r if isinstance(r, str) else \
+                        "dispatch failed before dependency landed"
+                    break
+                out.append(r[entry[2]])
             try:
-                first = self._q.get(timeout=0.2)
-            except queue.Empty:
-                continue
-            jobs = self._drain(first)   # coalesce everything queued
-            # unique uncached items across all jobs -> one dispatch
-            todo: dict[bytes, int] = {}
-            items: list[VerifyItem] = []
-            for _done, batch, digests in jobs:
-                for it, d in zip(batch, digests):
-                    if d not in self._cache and d not in todo:
-                        todo[d] = len(items)
-                        items.append(it)
-            new: dict[bytes, bool] = {}
-            error = None
-            if items:
-                try:
-                    verdicts = self._inner.verify_batch(items)
-                except Exception as e:
-                    # backend/device failure (e.g. the tunnel dropping
-                    # mid-dispatch) must surface as an ERROR to every
-                    # waiting client, not kill this thread — a dead
-                    # worker would silently wedge every co-hosted node
-                    error = f"{type(e).__name__}: {e}"
-                    self.stats["errors"] = self.stats.get("errors", 0) + 1
-                else:
-                    self.stats["dispatches"] += 1
-                    self.stats["dispatched_items"] += len(items)
-                    new = {d: bool(verdicts[idx])
-                           for d, idx in todo.items()}
-            # resolve every job from (new | pre-existing cache) BEFORE
-            # eviction can touch the entries these verdicts came from
-            for done, batch, digests in jobs:
-                self.stats["batches"] += 1
-                self.stats["items"] += len(batch)
-                try:
-                    if error is not None and any(d not in self._cache
-                                                 for d in digests):
-                        # this job actually needed the failed dispatch
-                        done(error)
-                    else:
-                        self.stats["cache_hits"] += sum(
-                            1 for d in digests if d not in new)
-                        done([new[d] if d in new
-                              else self._cache.get(d, False)
-                              for d in digests])
-                except Exception:
-                    pass   # loop closing mid-shutdown: nothing to notify
-            self._cache.update(new)
+                done(err if err is not None else out)
+            except Exception:
+                pass   # loop closing mid-shutdown: nothing to notify
+
+        def _land(block: bool) -> bool:
+            """Try to retire the oldest in-flight wave. -> landed?"""
+            wave = waves[0]
+            try:
+                verdicts = self._inner.collect_batch(wave["token"],
+                                                     wait=block)
+            except Exception as e:
+                # backend/device failure (e.g. the tunnel dropping
+                # mid-dispatch) must surface as an ERROR to every waiting
+                # client, not kill this thread — a dead worker would
+                # silently wedge every co-hosted node
+                verdicts = f"{type(e).__name__}: {e}"
+            if verdicts is None:
+                return False
+            waves.popleft()
+            if isinstance(verdicts, str):
+                self.stats["errors"] = self.stats.get("errors", 0) + 1
+                recent[wave["seq"]] = verdicts
+            else:
+                self.stats["dispatches"] += 1
+                self.stats["dispatched_items"] += len(wave["todo"])
+                new = {d: bool(verdicts[i])
+                       for d, i in wave["todo"].items()}
+                recent[wave["seq"]] = new
+                self._cache.update(new)
+            for d in wave["todo"]:
+                if pending.get(d) == wave["seq"]:
+                    del pending[d]
+            for done, plan in wave["jobs"]:
+                _finish(done, plan)
+            # a job attaches to the LAST wave it references, and references
+            # only waves in flight at its intake (>= seq - _MAX_IN_FLIGHT):
+            # anything 4 seqs back can no longer be referenced
+            for s in [s for s in recent if s <= wave["seq"] - 4]:
+                del recent[s]
             if len(self._cache) > self._cache_size:
                 # FIFO eviction in bulk; dict preserves insert order
                 drop = len(self._cache) - self._cache_size
                 for k in list(self._cache)[:drop]:
                     del self._cache[k]
+            return True
+
+        def _cycle() -> None:
+            while waves and _land(block=False):
+                pass
+            try:
+                first = self._q.get(timeout=0.2 if not waves else 0.002)
+            except queue.Empty:
+                return
+            nonlocal next_seq
+            jobs = self._drain(first)   # coalesce everything queued
+            seq = next_seq
+            todo: dict[bytes, int] = {}
+            items: list[VerifyItem] = []
+            wave_jobs: list = []
+            for done, batch, digests in jobs:
+                self.stats["items"] += len(batch)
+                plan: list = []
+                dep = 0
+                for it, d in zip(batch, digests):
+                    hit = self._cache.get(d)
+                    if hit is not None:
+                        self.stats["cache_hits"] += 1
+                        plan.append(("v", hit))
+                        continue
+                    w = pending.get(d)
+                    if w is None:
+                        if d not in todo:
+                            todo[d] = len(items)
+                            items.append(it)
+                            pending[d] = seq
+                        w = seq
+                    plan.append(("w", w, d))
+                    dep = max(dep, w)
+                if dep == 0:
+                    _finish(done, plan)        # pure cache hit
+                elif dep == seq:
+                    wave_jobs.append((done, plan))
+                else:
+                    for w in waves:            # ride an in-flight wave
+                        if w["seq"] == dep:
+                            w["jobs"].append((done, plan))
+                            break
+            if not items:
+                return
+            next_seq += 1
+            try:
+                token = self._inner.submit_batch(items)
+            except Exception as e:
+                recent[seq] = f"{type(e).__name__}: {e}"
+                self.stats["errors"] = self.stats.get("errors", 0) + 1
+                for d in todo:
+                    if pending.get(d) == seq:
+                        del pending[d]
+                for done, plan in wave_jobs:
+                    _finish(done, plan)
+                # prune here too: with a persistently broken backend _land
+                # never runs, and one error entry per failed dispatch must
+                # not grow `recent` without bound in the shared service
+                for s in [s for s in recent if s <= seq - 4]:
+                    del recent[s]
+                return
+            if waves:
+                self.stats["overlapped"] = self.stats.get(
+                    "overlapped", 0) + 1
+            waves.append({"seq": seq, "token": token, "todo": todo,
+                          "jobs": wave_jobs})
+            while len(waves) > self._MAX_IN_FLIGHT:
+                _land(block=True)
+
+        while not self._stop.is_set():
+            try:
+                _cycle()
+            except Exception:
+                # LAST-RESORT guard: a bug anywhere in the cycle must not
+                # kill this thread — a dead worker silently wedges every
+                # co-hosted node. Stats record the event for ops; the
+                # cycle's wave state is self-healing (jobs of a wave that
+                # never lands resolve as errors when it is pruned, and
+                # clients fall back locally on error replies).
+                self.stats["worker_faults"] = \
+                    self.stats.get("worker_faults", 0) + 1
 
     # --- asyncio front end ----------------------------------------------
 

@@ -23,6 +23,9 @@ jax.config.update("jax_num_cpu_devices", 8)
 # NOTE: x64 deliberately NOT enabled — the kernels are int32 (radix-13
 # limbs) and production runs with default dtypes; tests must match.
 
+# NOTE: the persistent compile cache is configured by plenum_tpu.ops
+# (~/.cache/plenum_tpu/jax) — kernels cache across runs automatically.
+
 import pytest  # noqa: E402
 
 

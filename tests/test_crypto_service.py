@@ -269,3 +269,141 @@ def test_bls_single_flight_survives_cancellation(tmp_path):
 
     assert asyncio.run(scenario()) is True
     assert server._bls_pending == {}
+
+
+# --- double-buffered worker (round 5) -------------------------------------
+
+class _SlowAsyncVerifier:
+    """Inner verifier with REAL async token semantics: submit returns
+    immediately, the 'device' resolves each token ~30 ms later in a
+    background thread — enough for the worker to stage the next wave."""
+
+    def __init__(self):
+        from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+        self._cpu = CpuEd25519Verifier()
+        self.submitted = []
+
+    def submit_batch(self, items):
+        import time
+        self.submitted.append([i[0] for i in items])
+        return {"t": time.monotonic() + 0.03,
+                "verdicts": self._cpu.verify_batch(items)}
+
+    def collect_batch(self, token, wait=True):
+        import time
+        while time.monotonic() < token["t"]:
+            if not wait:
+                return None
+            time.sleep(0.002)
+        return token["verdicts"]
+
+    def verify_batch(self, items):
+        return self.collect_batch(self.submit_batch(items), wait=True)
+
+
+def test_worker_overlaps_waves_and_dedupes_across_them(tmp_path):
+    """Wave k+1 must dispatch while wave k is still in flight (overlap),
+    and content already computing in wave k must NOT be re-dispatched by a
+    later wave — the job rides the in-flight wave."""
+    from plenum_tpu.parallel.crypto_service import (CryptoPlaneServer,
+                                                    ServiceEd25519Verifier)
+    sock = str(tmp_path / "crypto.sock")
+    inner = _SlowAsyncVerifier()
+    server = CryptoPlaneServer(inner, socket_path=sock)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def run():
+        await server.start()
+        started.set()
+        while not server._stop.is_set():
+            await asyncio.sleep(0.02)
+        await server.stop()
+
+    t = threading.Thread(target=lambda: loop.run_until_complete(run()),
+                         daemon=True)
+    t.start()
+    assert started.wait(5.0)
+    try:
+        c1 = ServiceEd25519Verifier(socket_path=sock)
+        c2 = ServiceEd25519Verifier(socket_path=sock)
+        a = _make_items(6, tag=b"waveA-")
+        b = _make_items(6, tag=b"waveB-")
+        # wave 1: client 1 ships A; then while it is in flight, client 2
+        # ships B (new content -> second wave overlapped) AND A again
+        # (must attach to wave 1, not re-dispatch)
+        t1 = c1.submit_batch(a)
+        import time
+        time.sleep(0.005)                  # let the worker pick up wave 1
+        t2 = c2.submit_batch(b)
+        t3 = c2.submit_batch(a)
+        ok1 = c1.collect_batch(t1)
+        ok2 = c2.collect_batch(t2)
+        ok3 = c2.collect_batch(t3)
+        assert ok1.all() and ok2.all() and ok3.all()
+        # A's messages were dispatched exactly once across all waves
+        flat = [m for batch in inner.submitted for m in batch]
+        assert len(flat) == len(set(flat)), "re-dispatched content"
+        assert server.stats.get("overlapped", 0) >= 1, server.stats
+        c1.close(); c2.close()
+    finally:
+        server._stop.set()
+        t.join(timeout=5.0)
+
+
+def test_submit_failure_with_cross_wave_dependency_is_loud(tmp_path):
+    """Regression (round-5 review): wave 1 in flight, a job referencing
+    wave-1 content plus new content attaches to wave 2; wave 2's submit
+    raises. The job must get an ERROR reply (not hang) and the worker
+    thread must survive to serve later requests."""
+    from plenum_tpu.parallel.crypto_service import (CryptoPlaneServer,
+                                                    ServiceEd25519Verifier)
+
+    class _FlakySubmit(_SlowAsyncVerifier):
+        def __init__(self):
+            super().__init__()
+            self.fail_next = False
+
+        def submit_batch(self, items):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("tunnel dropped")
+            return super().submit_batch(items)
+
+    sock = str(tmp_path / "crypto.sock")
+    inner = _FlakySubmit()
+    server = CryptoPlaneServer(inner, socket_path=sock)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def run():
+        await server.start()
+        started.set()
+        while not server._stop.is_set():
+            await asyncio.sleep(0.02)
+        await server.stop()
+
+    t = threading.Thread(target=lambda: loop.run_until_complete(run()),
+                         daemon=True)
+    t.start()
+    assert started.wait(5.0)
+    try:
+        c = ServiceEd25519Verifier(socket_path=sock)
+        a = _make_items(4, tag=b"dep-a-")
+        b = _make_items(4, tag=b"dep-b-")
+        t1 = c.submit_batch(a)
+        import time
+        time.sleep(0.005)            # wave 1 (a) now in flight
+        inner.fail_next = True
+        t2 = c.submit_batch(a + b)   # depends on wave 1 AND the failing wave
+        assert c.collect_batch(t1).all()
+        with pytest.raises(RuntimeError):
+            c.collect_batch(t2)      # loud error, not a hang
+        # worker alive: a fresh request still round-trips
+        t3 = c.submit_batch(_make_items(3, tag=b"dep-c-"))
+        assert c.collect_batch(t3).all()
+        assert server._worker.is_alive()
+        c.close()
+    finally:
+        server._stop.set()
+        t.join(timeout=5.0)

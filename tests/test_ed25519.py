@@ -184,7 +184,16 @@ def test_verkey_cache_hits():
     msgs = [b"m%d" % i for i in range(8)]
     items = [(m, s.sign(m), s.verkey) for m in msgs]
     assert v.verify_batch(items).all()
-    assert len(v._pt_cache) == 1
+    # the compressed dispatch ships raw key bytes and decompresses on
+    # device: the host-side point cache is never populated on the hot path
+    assert len(v._pt_cache) == 0
+
+    class _Limb(JaxEd25519Verifier):
+        _compressed_dispatch = False
+
+    lv = _Limb()
+    assert lv.verify_batch(items).all()
+    assert len(lv._pt_cache) == 1      # limb path still caches per verkey
 
 
 # --- base58 ---------------------------------------------------------------
@@ -271,3 +280,71 @@ def test_coalescing_verifier_staged_while_in_flight():
     t2 = plane.submit_batch([(b"b", s.sign(b"b"), s.verkey)])
     assert list(plane.collect_batch(t1, wait=True)) == [True]
     assert list(plane.collect_batch(t2, wait=True)) == [True]
+
+
+# --- compressed dispatch: device-side key decompression (round 5) ---------
+
+def test_decompress_kernel_matches_host():
+    """Device decompression must agree with the host `decompress` twin on
+    valid keys (producing the same -A quarter points as ext_quarters) and
+    on every adversarial encoding class."""
+    keys = [Ed25519Signer(bytes([i + 40]) * 32).verkey for i in range(3)]
+    bad = [
+        (ops.P + 1).to_bytes(32, "little"),          # y >= p (non-canonical)
+        (ops.P - 1).to_bytes(32, "little"),          # y = p-1: off curve?
+        bytes(32),                                   # y = 0
+        (1 | (1 << 255)).to_bytes(32, "little"),     # y = 1 -> x = 0, sign=1
+        (2).to_bytes(32, "little"),                  # y = 2
+    ]
+    all_keys = keys + bad
+    k_u8 = np.frombuffer(b"".join(all_keys), np.uint8).reshape(-1, 32)
+    import jax.numpy as jnp
+    (qx, qy, qz, qt), valid = ops.decompress_kernel(jnp.asarray(k_u8))
+    valid = np.asarray(valid)
+    for i, kb in enumerate(all_keys):
+        host = ops.decompress(kb)
+        assert valid[i] == (host is not None), (i, kb.hex())
+        if host is None:
+            continue
+        neg = ((ops.P - host[0]) % ops.P, host[1])
+        want = ops.ext_quarters(neg)                 # [4, 4, NLIMB]
+        for q in range(4):
+            got = [np.asarray(c)[q, i] for c in (qx, qy, qz, qt)]
+            x, y, z, t = (ops.limbs_to_int(np.asarray(ops.f_canon(
+                jnp.asarray(g[None, :])))[0]) for g in got)
+            zi = pow(z, ops.P - 2, ops.P)
+            wx = ops.limbs_to_int(want[q, 0])
+            wy = ops.limbs_to_int(want[q, 1])
+            wz = ops.limbs_to_int(want[q, 2])
+            wzi = pow(wz, ops.P - 2, ops.P)
+            assert x * zi % ops.P == wx * wzi % ops.P, (i, q)
+            assert y * zi % ops.P == wy * wzi % ops.P, (i, q)
+            # extended-coordinate invariant: T = X*Y/Z
+            assert t % ops.P == x * y % ops.P * zi % ops.P, (i, q)
+
+
+def test_bytes_and_limb_dispatch_agree():
+    """The compressed byte dispatch and the limb-staged dispatch are the
+    same verifier semantics — run both on a mixed batch and compare."""
+    rng = random.Random(11)
+    signers = [Ed25519Signer(bytes([i + 60]) * 32) for i in range(3)]
+    items = []
+    for i in range(19):
+        s = signers[i % 3]
+        msg = rng.randbytes(20)
+        sig = s.sign(msg)
+        if i % 4 == 0:
+            b = bytearray(sig); b[1] ^= 0x55; sig = bytes(b)
+        if i % 7 == 0:
+            sig = sig[:32] + (ops.L + i).to_bytes(32, "little")  # S >= L
+        items.append((msg, sig, s.verkey))
+    items.append((b"m", b"\x01" * 64, bytes(32)))      # y=0 verkey
+    items.append((b"m", b"\x01" * 64, (ops.P + 2).to_bytes(32, "little")))
+
+    class _Limb(JaxEd25519Verifier):
+        _compressed_dispatch = False
+
+    got_b = JaxEd25519Verifier().verify_batch(items)
+    got_l = _Limb().verify_batch(items)
+    cpu = CpuEd25519Verifier().verify_batch(items)
+    assert list(got_b) == list(got_l) == list(cpu)
