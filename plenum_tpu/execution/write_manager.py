@@ -61,6 +61,8 @@ class WriteRequestManager:
                  taa_acceptance_window: float = 2 * 24 * 3600):
         self.db = db
         self._handlers: dict[str, WriteRequestHandler] = {}
+        # (txn_type, version) -> handler for version-carrying payloads
+        self._versioned: dict[tuple[str, str], WriteRequestHandler] = {}
         self._batches: list[_Undo] = []
         self._primaries_provider = primaries_provider or (lambda: [])
         self._node_reg_provider = node_reg_provider or (lambda: [])
@@ -68,28 +70,56 @@ class WriteRequestManager:
         self.on_batch_committed: list[Callable[[ThreePcBatch, list[dict]], None]] = []
 
     # --- registry ---------------------------------------------------------
+    #
+    # Version-keyed dispatch (ref txn_version_controller.py:1 +
+    # write_request_manager.py:113): a handler registered with a version
+    # string serves only payloads carrying that version; payloads without
+    # one (and versions with no specific registration) fall back to the
+    # default handler. This is the seam txn-format evolution builds on —
+    # a pool can roll out a v2 payload format handler-first, with no flag
+    # day: old-format txns keep applying through the default handler.
 
-    def register_handler(self, handler: WriteRequestHandler) -> None:
-        self._handlers[handler.txn_type] = handler
+    def register_handler(self, handler: WriteRequestHandler,
+                         version: Optional[str] = None) -> None:
+        if version is None:
+            self._handlers[handler.txn_type] = handler
+        else:
+            self._versioned[(handler.txn_type, str(version))] = handler
 
-    def handler_for(self, txn_type: Optional[str]) -> WriteRequestHandler:
+    def handler_for(self, txn_type: Optional[str],
+                    version: Optional[str] = None) -> WriteRequestHandler:
+        if version is not None:
+            h = self._versioned.get((txn_type, str(version)))
+            if h is not None:
+                return h
         if txn_type not in self._handlers:
             raise InvalidClientRequest(reason=f"unknown txn type {txn_type!r}")
         return self._handlers[txn_type]
 
+    @staticmethod
+    def request_version(request: Request) -> Optional[str]:
+        """Payload format version carried by the request's operation
+        (ref get_payload_txn_version; absent means the default format)."""
+        ver = request.operation.get("ver")
+        return str(ver) if ver is not None else None
+
     def is_write_type(self, txn_type: Optional[str]) -> bool:
-        return txn_type in self._handlers
+        return txn_type in self._handlers or any(
+            t == txn_type for t, _ in self._versioned)
 
     def ledger_id_for(self, request: Request) -> int:
-        return self.handler_for(request.txn_type).ledger_id
+        return self.handler_for(request.txn_type,
+                                self.request_version(request)).ledger_id
 
     # --- validation -------------------------------------------------------
 
     def static_validation(self, request: Request) -> None:
-        self.handler_for(request.txn_type).static_validation(request)
+        self.handler_for(request.txn_type,
+                         self.request_version(request)).static_validation(request)
 
     def dynamic_validation(self, request: Request, pp_time: Optional[float]) -> None:
-        handler = self.handler_for(request.txn_type)
+        handler = self.handler_for(request.txn_type,
+                                   self.request_version(request))
         if handler.ledger_id == DOMAIN_LEDGER_ID:
             self._validate_taa_acceptance(request, pp_time)
         handler.dynamic_validation(request, pp_time)
@@ -170,8 +200,20 @@ class WriteRequestManager:
             except (InvalidClientRequest, UnauthorizedClientRequest) as e:
                 rejected.append((req, e.reason))
                 continue
-            handler = self.handler_for(req.txn_type)
+            version = self.request_version(req)
+            handler = self.handler_for(req.txn_type, version)
             txn = handler.gen_txn(req)
+            if version is not None and (req.txn_type, version) \
+                    in self._versioned:
+                # stamp the PAYLOAD format version a versioned handler
+                # minted, so catchup/observer replay dispatches to the
+                # same handler. This is the payload-level field (ref
+                # txn_util.get_payload_txn_version: txn["txn"]["ver"]) —
+                # NOT the top-level envelope version, which is "1" on
+                # every txn and must never key handler dispatch (a
+                # version-"1" registration would otherwise route live
+                # ordering and replay differently -> state fork)
+                txn["txn"]["ver"] = version
             txn_lib.set_seq_no(txn, base_seq + len(txns) + 1)
             txn_lib.set_txn_time(txn, int(pp_time))
             handler.update_state(txn, is_committed=False)
@@ -246,7 +288,11 @@ class WriteRequestManager:
         """Replay an already-validated committed txn into state (the
         catchup/observer path — no dynamic validation, no audit txn; the
         txn's provenance is the caller's verified ledger transfer)."""
-        handler = self._handlers.get(txn_lib.txn_type_of(txn))
+        ver = txn.get("txn", {}).get("ver")     # payload format version
+        handler = self._versioned.get((txn_lib.txn_type_of(txn), str(ver))) \
+            if ver is not None else None
+        if handler is None:
+            handler = self._handlers.get(txn_lib.txn_type_of(txn))
         state = self.db.get_state(ledger_id)
         if handler is not None and state is not None:
             handler.update_state(txn, is_committed=committed)
