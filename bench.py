@@ -194,7 +194,11 @@ def main():
         result["distinct_signers_tps"] = c1b.get("tps", c1b.get("error"))
         c2 = bc.config2_three_instances_mixed(n_txns=200)
         c3 = bc.config3_bls_proof_reads(n_reads=1500)
-        c4 = bc.config4_viewchange_under_load(n_txns=150)
+        # 1000 txns: the VC stall is a FIXED cost (published as stall_s
+        # with its phase decomposition), so the run must be long enough
+        # that "TPS across the fault" reflects a representative load
+        # window (~3.5s steady + the stall), not 1s of pre-kill ramp
+        c4 = bc.config4_viewchange_under_load(n_txns=1000)
         c5 = bc.config5_sim25(n_txns=60)
         result["config2_mixed_3inst_tps"] = c2.get("tps", c2.get("error"))
         result["config3_proof_reads_per_s"] = c3.get("reads_per_s",
@@ -202,6 +206,11 @@ def main():
         result["config4_vc_under_load_tps"] = c4.get("tps_across_fault",
                                                      c4.get("error"))
         result["config4_recovered"] = c4.get("recovered", False)
+        result["config4_stall_s"] = c4.get("stall_s")
+        for k in ("vc_detect_to_vote_s", "vc_vote_to_start_s",
+                  "vc_start_to_new_view_s", "vc_new_view_to_order_s"):
+            if k in c4:
+                result[f"config4_{k}"] = c4[k]
         result["config5_sim25_tps"] = c5.get("tps", c5.get("error"))
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"

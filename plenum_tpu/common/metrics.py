@@ -45,6 +45,13 @@ class MetricsName:
     PREPARE_PHASE_TIME = "consensus.prepare_phase_time"
     COMMIT_PHASE_TIME = "consensus.commit_phase_time"
     ORDERING_TIME = "consensus.ordering_time"
+    # view-change stall decomposition (VERDICT r4 item 5): where does the
+    # ordering gap go when the primary dies — detection wait, IC quorum
+    # wait, the VC protocol itself, or post-NewView re-ordering
+    VC_DETECT_TO_VOTE = "consensus.vc_detect_to_vote"
+    VC_VOTE_TO_START = "consensus.vc_vote_to_start"
+    VC_START_TO_NEW_VIEW = "consensus.vc_start_to_new_view"
+    VC_NEW_VIEW_TO_ORDER = "consensus.vc_new_view_to_order"
     # queue depths sampled at each metrics flush
     CLIENT_INBOX_DEPTH = "node.client_inbox_depth"
     PROPAGATE_INBOX_DEPTH = "node.propagate_inbox_depth"

@@ -69,7 +69,16 @@ class Config:
     # waiting out the (much longer) ordering-stall / freshness windows
     # (ref ToleratePrimaryDisconnection config.py:184 + primary_connection_
     # monitor_service.py)
-    PRIMARY_DISCONNECT_TIMEOUT: float = 3.0
+    # how long a lost primary connection must persist before this node's
+    # InstanceChange vote (ref ToleratePrimaryDisconnection = 60s!). The
+    # dialer's retry backoff tops out at 1.0s (tcp_stack.RETRY_MAX), so a
+    # transient drop re-establishes within at most one full backoff plus
+    # a handshake — comfortably inside this window; and a premature lone
+    # vote is harmless anyway (starting a view change needs a strong
+    # quorum of votes). 1.5s halves the measured crash-recovery stall
+    # (the detect->vote wait dominates it; see docs/performance.md
+    # view-change stall decomposition).
+    PRIMARY_DISCONNECT_TIMEOUT: float = 1.5
 
     # --- faulty backup instances (ref backup_instance_faulty_processor +
     #     ReplicasRemovingWithDegradation config) ---

@@ -58,7 +58,12 @@ MAGIC = b"PTPU\x01\x00\x00\x00"
 MAX_FRAME = 8 * 1024 * 1024          # reference caps ZMQ frames similarly
 OUTBOX_CAP = 10_000                  # queued msgs per disconnected peer
 WRITE_HWM = 8 * 1024 * 1024          # drop a peer that stops reading (ZMQ HWM)
-RETRY_MIN, RETRY_MAX = 0.1, 2.0      # dialer backoff (kit_zstack retries)
+# dialer backoff (kit_zstack retries). RETRY_MAX bounds how long a
+# transient drop stays down: it must sit BELOW the pool's
+# PRIMARY_DISCONNECT_TIMEOUT (config.py) or a blip at max backoff could
+# outlast the tolerance on every peer at once and force a needless view
+# change. A down peer being redialed every second by n-1 nodes is noise.
+RETRY_MIN, RETRY_MAX = 0.1, 1.0
 
 
 class HandshakeError(Exception):

@@ -22,6 +22,7 @@ from plenum_tpu.catchup import NodeLeecherService, SeederService
 from plenum_tpu.common.event_bus import ExternalBus
 from plenum_tpu.common.internal_messages import (MissingMessage,
                                                  NeedMasterCatchup,
+                                                 NeedViewChange,
                                                  NewViewAccepted,
                                                  RaisedSuspicion, ReqKey,
                                                  RequestPropagates,
@@ -285,6 +286,25 @@ class Node:
         self._backup_check_timer = RepeatingTimer(
             timer, self.config.BACKUP_INSTANCE_FAULTY_CHECK_FREQ,
             self._check_backup_instances)
+
+        # quorum-connectivity self-check (ref inconsistency_watchers.py:5):
+        # having once seen strong-quorum connectivity, dropping below weak
+        # quorum means we cannot distinguish pool failure from our own
+        # partition — resynchronize via catchup when connectivity returns
+        from plenum_tpu.node.inconsistency_watcher import \
+            NetworkInconsistencyWatcher
+        self.network_watcher = NetworkInconsistencyWatcher(
+            self._on_lost_quorum_connectivity, network=self.node_bus)
+        self.network_watcher.set_nodes(self.validators)
+        self._needs_resync = False
+        self.node_bus.subscribe(ExternalBus.Connected,
+                                self._maybe_resync_after_partition)
+        # VC stall decomposition: detection stamp on primary disconnect
+        self._vc_phase_ts: dict[str, float] = {}
+        self.node_bus.subscribe(
+            ExternalBus.Disconnected,
+            lambda m, frm="": self._vc_mark("detect")
+            if m.name == self.master_replica.data.primary_name else None)
 
         # crash-restart: a node rebuilt over durable storage resumes at the
         # audit ledger's 3PC position and primaries instead of view 0 / seq 0
@@ -576,7 +596,49 @@ class Node:
                 NeedMasterCatchup, lambda _msg: self.start_catchup())
             replica.internal_bus.subscribe(NewViewAccepted,
                                            self._on_master_new_view)
+            # VC stall decomposition: stamp the vote and the IC-quorum
+            # start as they pass through the master's bus
+            replica.internal_bus.subscribe(
+                VoteForViewChange,
+                lambda _m: self._vc_mark("vote"))
+            replica.internal_bus.subscribe(
+                NeedViewChange,
+                lambda _m: self._vc_mark("start"))
         return replica
+
+    # --- view-change stall decomposition (VERDICT r4 item 5) ------------
+    # Phase stamps ride the node timer: primary-disconnect detection ->
+    # our IC vote -> IC quorum (NeedViewChange) -> NewViewAccepted ->
+    # first post-VC order. Durations are emitted as metrics events so
+    # tools/metrics_report can print the breakdown of a fault's cost.
+
+    _VC_PHASES = (("detect", "vote", MetricsName.VC_DETECT_TO_VOTE),
+                  ("vote", "start", MetricsName.VC_VOTE_TO_START),
+                  ("start", "new_view", MetricsName.VC_START_TO_NEW_VIEW),
+                  ("new_view", "order", MetricsName.VC_NEW_VIEW_TO_ORDER))
+
+    _VC_ORDER = ("detect", "vote", "start", "new_view", "order")
+
+    def _vc_mark(self, phase: str) -> None:
+        """A stamp REFRESHES (latest wins) as long as no later phase has
+        been stamped: a transient blip's 'detect' or a degradation vote's
+        'vote' from an episode that never progressed must not anchor the
+        durations of the real episode that follows. Once a later phase
+        exists, earlier stamps freeze; phase metrics are emitted when the
+        later endpoint of each pair is stamped."""
+        ts = self._vc_phase_ts
+        rank = self._VC_ORDER.index(phase)
+        if any(p in ts for p in self._VC_ORDER[rank + 1:]):
+            return                      # episode already past this phase
+        ts[phase] = self.timer.get_current_time()
+        if phase == "order":
+            # metrics emit ONCE, at completion (refreshed stamps would
+            # otherwise emit duplicate, drifting durations)
+            for frm, to, metric in self._VC_PHASES:
+                if frm in ts and to in ts:
+                    self.metrics.add_event(metric, ts[to] - ts[frm])
+            self.spylog.append(("vc_stall_phases", dict(ts)))
+            ts.clear()                  # episode complete
 
     def _on_request_propagates(self, msg: RequestPropagates) -> None:
         """Ordering stashed a pre-prepare on MISSING_REQUESTS: fetch the
@@ -610,6 +672,7 @@ class Node:
             replica.adopt_new_view(msg.view_no, primaries)
         self.monitor.reset()
         self.metrics.add_event(MetricsName.VIEW_CHANGES)
+        self._vc_mark("new_view")
         self.notifier.send(TOPIC_VIEW_CHANGE, {
             "node": self.name, "view_no": msg.view_no,
             "primaries": primaries,
@@ -637,6 +700,25 @@ class Node:
                 self.spylog.append(("blacklisted", msg.sender))
 
     # --- catchup ----------------------------------------------------------
+
+    def _on_lost_quorum_connectivity(self) -> None:
+        """The watcher fired: we HAD consensus connectivity and now sit
+        below the weak quorum. The reference restarts the node here; the
+        payload of that restart is a resync, so mark one and run it as
+        soon as enough peers are back (catching up with no peers would
+        just time out)."""
+        self.metrics.add_event(MetricsName.SUSPICIONS)
+        self.spylog.append(("lost_quorum_connectivity",
+                            sorted(self.node_bus.connecteds)))
+        self._needs_resync = True
+        self._maybe_resync_after_partition()
+
+    def _maybe_resync_after_partition(self, *_a) -> None:
+        if (getattr(self, "_needs_resync", False)
+                and self.network_watcher.has_weak_connectivity()):
+            self._needs_resync = False
+            self.spylog.append(("resync_after_partition", None))
+            self.start_catchup()
 
     def start_catchup(self) -> None:
         """Pause ordering, revert uncommitted work, sync all ledgers
@@ -690,6 +772,10 @@ class Node:
             replica.internal_bus.send(ReqKey(digest))
 
     def _on_ordered(self, msg: Ordered) -> None:
+        if msg.inst_id == 0 and "new_view" in self._vc_phase_ts:
+            # first post-VC MASTER order closes the episode (backups'
+            # ordering is not client-visible recovery)
+            self._vc_mark("order")
         self._ordered_queue.append(msg)
 
     def _on_pool_changed(self) -> None:
@@ -698,6 +784,7 @@ class Node:
         self.validators = self.pool_manager.node_names or [self.name]
         self.quorums = self.pool_manager.quorums
         self.propagator.set_quorums(self.quorums)
+        self.network_watcher.set_nodes(self.validators)
         for replica in self.replicas:
             replica.set_validators(self.validators)
         self._adjust_replicas()
