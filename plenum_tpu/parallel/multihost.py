@@ -18,10 +18,11 @@ Usage (one call per host process, before any other JAX API):
     plane = ShardedCryptoPlane(mesh)      # same code as single-host
 
 Host-side inputs must be globally sharded arrays
-(jax.make_array_from_process_local_data) — helpers below wrap that. This
-module is exercised on a single process by the test suite (JAX's
-distributed runtime with num_processes=1); multi-process runs need one
-process per host, as with any jax.distributed deployment.
+(jax.make_array_from_process_local_data) — helpers below wrap that. The
+suite exercises this end-to-end with TWO real OS processes joining one
+distributed job over a localhost coordinator (gloo collectives on the
+CPU backend, 4 virtual devices per process -> one 8-device global mesh):
+tests/test_multihost.py.
 """
 from __future__ import annotations
 
