@@ -103,6 +103,12 @@ class NodeBootstrap:
         path = os.path.join(self.data_dir, label)
         has_native = os.path.exists(os.path.join(path, "kv.kvn"))
         has_file = os.path.exists(os.path.join(path, "kv.kvlog"))
+        if self.storage_backend == "chunked" and not (has_native or has_file):
+            # unbounded append logs split across sealed chunk files
+            # (ref chunked_file_store.py); existing single-file/native
+            # data keeps its on-disk format
+            from plenum_tpu.storage.kv_chunked import KvChunked
+            return KvChunked(path)
         if self.storage_backend == "native" or has_native:
             from plenum_tpu.storage.kv_native import (KvNative,
                                                       native_available)

@@ -49,6 +49,30 @@ def _reduce_roots(roots: jax.Array) -> jax.Array:
     return sha_ops.merkle_reduce_pow2(roots)
 
 
+def _local_step_bytes(s_u8, h_u8, keys_u8, idx, r_u8, leaves):
+    """Per-shard body of the COMPRESSED dispatch (the production path):
+    raw byte payloads arrive sharded over the grid, the 32 B/key verkey
+    table is REPLICATED (it IS the deduped payload — on multi-host
+    tunneled hardware the link dominates dispatch cost, so the transfer
+    win must survive sharding), and each shard decompresses the keys it
+    needs on device. Key decompression is redundant across shards by
+    design: ~0.5 signature-equivalents of compute per distinct key vs
+    an all-to-all of 1280 B/key quarter-point rows."""
+    i_loc, n_loc = idx.shape[0], idx.shape[1]
+    m = i_loc * n_loc
+    ok = ed_ops.verify_kernel_bytes(
+        s_u8.reshape(m, 32), h_u8.reshape(m, 32), keys_u8,
+        idx.reshape(m), r_u8.reshape(m, 32))
+    ok = ok.reshape(i_loc, n_loc)
+
+    local_root = sha_ops.merkle_reduce_pow2(leaves)               # [8]
+    roots = jax.lax.all_gather(local_root, ("inst", "sig"))       # [S, 8]
+    root = _reduce_roots(roots)                                   # [8]
+
+    n_ok = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), ("inst", "sig"))
+    return ok, root, n_ok
+
+
 def _local_step(s_dig, h_dig, aq_unique, idx, ry, r_sign, leaves):
     """Per-shard body. Signature grid arrives as [I_loc, N_loc, ...]; the
     local grid flattens into one kernel batch. The verkey quarter-point
@@ -101,6 +125,13 @@ class ShardedCryptoPlane:
                       spec_scalar, spec_leaf),
             out_specs=(P("inst", "sig"), P(), P()),
             check_vma=False))
+        spec_bytes = P("inst", "sig", None)       # u8 payloads [I, N, 32]
+        self._step_bytes = jax.jit(_shard_map(
+            _local_step_bytes, mesh=mesh,
+            in_specs=(spec_bytes, spec_bytes, P(None, None), spec_idx,
+                      spec_bytes, spec_leaf),
+            out_specs=(P("inst", "sig"), P(), P()),
+            check_vma=False))
 
     def step(self, s_dig, h_dig, aq_unique, idx, ry, r_sign, leaves):
         """-> (ok[I, N] bool, root uint32[8], n_ok int32).
@@ -113,6 +144,12 @@ class ShardedCryptoPlane:
         """
         return self._step(s_dig, h_dig, aq_unique, idx, ry, r_sign, leaves)
 
+    def step_bytes(self, s_u8, h_u8, keys_u8, idx, r_u8, leaves):
+        """Compressed-dispatch twin of `step` (the production path):
+        -> (ok[I, N] bool, root uint32[8], n_ok int32). Byte payloads
+        [I, N, 32] shard over the grid; keys_u8 [U, 32] is replicated."""
+        return self._step_bytes(s_u8, h_u8, keys_u8, idx, r_u8, leaves)
+
 
 class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
     """JaxEd25519Verifier whose device program is the SPMD crypto plane:
@@ -122,11 +159,6 @@ class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
     production seam for `crypto_backend="jax-sharded"` — the
     CoalescingVerifier wraps it unchanged and node traffic flows through
     `ShardedCryptoPlane.step` (SURVEY.md §2.3 distributed-comm row)."""
-
-    # the SPMD program consumes limb-staged arrays; the compressed byte
-    # dispatch is ported separately (the replicated unique-key table is
-    # already the deduped small payload here)
-    _compressed_dispatch = False
 
     def __init__(self, plane: ShardedCryptoPlane, min_batch: int = 1,
                  cache_size: int = 65536):
@@ -142,6 +174,25 @@ class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
         self._plane = plane
         self._grid = (inst, sig)
         self.dispatches = 0          # observability for tests/metrics
+
+    def _device_verify_bytes(self, s_u8, h_u8, k_u8, idx, r_u8):
+        """The compressed staging reshaped onto the plane's grid; the
+        unique-key byte table rides replicated (32 B/key, the whole
+        point of the dispatch)."""
+        import jax.numpy as jnp
+        inst, sig = self._grid
+        m = s_u8.shape[0]
+        n = m // inst
+        leaves = jnp.zeros((inst * sig, 8), jnp.uint32)
+        ok, _root, _n_ok = self._plane.step_bytes(
+            jnp.asarray(s_u8).reshape(inst, n, 32),
+            jnp.asarray(h_u8).reshape(inst, n, 32),
+            jnp.asarray(k_u8),
+            jnp.asarray(idx).reshape(inst, n),
+            jnp.asarray(r_u8).reshape(inst, n, 32),
+            leaves)
+        self.dispatches += 1
+        return ok.reshape(m)
 
     def _device_verify(self, s_digits, h_digits, aq_unique, idx, ry, r_sign):
         import jax.numpy as jnp

@@ -18,6 +18,33 @@ _PUT, _DEL = 0, 1
 _HDR = struct.Struct(">BII")  # op, key_len, value_len
 
 
+def scan_records(data: bytes) -> tuple[list[tuple[int, bytes, bytes]], int]:
+    """THE record-scan for this on-disk format, shared by every reader
+    (KvFile replay, read-only replay, KvChunked replay — a format or
+    validation change happens HERE once). Parses until the first corrupt
+    header or truncated (torn-tail) record. -> ([(op, key, value)],
+    good_prefix_length)."""
+    entries = []
+    off, n = 0, len(data)
+    while off + _HDR.size <= n:
+        op, klen, vlen = _HDR.unpack_from(data, off)
+        if op not in (_PUT, _DEL) or off + _HDR.size + klen + vlen > n:
+            break
+        off += _HDR.size
+        key = data[off:off + klen]; off += klen
+        val = data[off:off + vlen]; off += vlen
+        entries.append((op, key, val))
+    return entries, off
+
+
+def apply_records(mem: KvMemory, entries) -> None:
+    for op, key, val in entries:
+        if op == _PUT:
+            mem.put(key, val)
+        else:
+            mem.remove(key)
+
+
 def read_log_readonly(path: str, name: str = "kv") -> list[tuple[bytes, bytes]]:
     """Replay a KvFile log WITHOUT opening it for append, truncating a torn
     tail, or compacting — safe against a store another process is writing.
@@ -28,18 +55,7 @@ def read_log_readonly(path: str, name: str = "kv") -> list[tuple[bytes, bytes]]:
         return []
     with open(file_path, "rb") as fh:
         data = fh.read()
-    off, n = 0, len(data)
-    while off + _HDR.size <= n:
-        op, klen, vlen = _HDR.unpack_from(data, off)
-        if op not in (_PUT, _DEL) or off + _HDR.size + klen + vlen > n:
-            break
-        off += _HDR.size
-        key = data[off:off + klen]; off += klen
-        val = data[off:off + vlen]; off += vlen
-        if op == _PUT:
-            mem.put(key, val)
-        else:
-            mem.remove(key)
+    apply_records(mem, scan_records(data)[0])
     return list(mem.iterator())
 
 
@@ -57,20 +73,9 @@ class KvFile(KeyValueStorage):
             return
         with open(self._file_path, "rb") as fh:
             data = fh.read()
-        off, n = 0, len(data)
-        while off + _HDR.size <= n:
-            op, klen, vlen = _HDR.unpack_from(data, off)
-            if op not in (_PUT, _DEL):   # corrupt header: stop, keep prefix
-                break
-            if off + _HDR.size + klen + vlen > n:   # torn tail write
-                break
-            off += _HDR.size
-            key = data[off:off + klen]; off += klen
-            val = data[off:off + vlen]; off += vlen
-            if op == _PUT:
-                self._mem.put(key, val)
-            else:
-                self._mem.remove(key)
+        entries, off = scan_records(data)
+        apply_records(self._mem, entries)
+        n = len(data)
         if off < n:
             # Drop the torn record so appended records aren't misparsed by the
             # next replay.

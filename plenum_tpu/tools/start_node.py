@@ -16,6 +16,41 @@ import asyncio
 import json
 import os
 import time
+from collections import deque
+
+
+class _DurableSpylog(deque):
+    """The node's bounded in-memory event trace, made durable: every
+    append also writes a JSONL row {"t", "event", "data"} that
+    tools.log_analyzer reads back for per-view postmortem timelines."""
+
+    def __init__(self, path: str, now=time.time, seed=()):
+        super().__init__(maxlen=1000)
+        self._now = now
+        self._fh = open(path, "a", buffering=1)   # line-buffered
+        # a crash mid-write leaves a torn line with no newline; start on
+        # a fresh line so the first post-restart event stays parseable
+        try:
+            if os.path.getsize(path) > 0:
+                with open(path, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        self._fh.write("\n")
+        except OSError:
+            pass
+        for item in seed:
+            self.append(item)
+
+    def append(self, item) -> None:
+        super().append(item)
+        try:
+            event, data = item if isinstance(item, tuple) and \
+                len(item) == 2 else (str(item), None)
+            self._fh.write(json.dumps(
+                {"t": self._now(), "event": event, "data": data},
+                default=repr) + "\n")
+        except Exception:
+            pass          # a full disk must not take down consensus
 
 
 def build_node(name: str, base_dir: str, backend: str = "cpu",
@@ -57,10 +92,17 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
     if my_ha is None:
         raise SystemExit(f"{name} is not in the pool genesis")
 
-    data_dir = os.path.join(base_dir, name, "data") if kv == "file" else None
+    if kv not in ("file", "memory", "native", "chunked"):
+        raise SystemExit(f"unknown kv backend {kv!r}")
+    data_dir = os.path.join(base_dir, name, "data") if kv != "memory" \
+        else None
+    # "file" keeps the historical meaning "durable, best engine" (the
+    # bootstrap's default picks the native store with file fallback);
+    # "native"/"chunked" select those engines explicitly
+    storage_backend = kv if kv in ("native", "chunked") else "native"
     components = NodeBootstrap(
         name, genesis_txns=genesis, data_dir=data_dir,
-        crypto_backend=backend,
+        crypto_backend=backend, storage_backend=storage_backend,
         bls_seed=bytes.fromhex(keys["bls_seed"])).build()
     timer = QueueTimer(time.perf_counter)
     # durable metrics history next to the node's keys so operators can run
@@ -73,6 +115,15 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
     from plenum_tpu.storage.kv_file import KvFile
     metrics = KvMetricsCollector(
         KvFile(os.path.join(base_dir, name, "metrics")))
+    # durable text log (WARNING+ from transport/services) next to the
+    # keys: the error-clustering half of tools.log_analyzer reads it
+    # (the reference analyzes node logs with scripts/process_logs)
+    import logging
+    lh = logging.FileHandler(os.path.join(base_dir, name, "node.log"))
+    lh.setLevel(logging.WARNING)
+    lh.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s %(message)s"))
+    logging.getLogger().addHandler(lh)
     node_stack = TcpStack(name, my_ha[0], my_ha[1], registry,
                           seed=bytes.fromhex(keys["seed"]))
     client_stack = ClientStack(name, my_client_ha[0], my_client_ha[1],
@@ -82,6 +133,13 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
     node = Node(name, timer, node_stack.bus, components,
                 client_send=client_stack.send, config=config,
                 metrics=metrics)
+    # durable structured event log: every spylog entry (view changes,
+    # catchups, suspicions, VC stall phases) appends a JSONL row that
+    # tools.log_analyzer turns into per-view timelines. Seeded with the
+    # entries the constructor already traced (audit restore etc.).
+    node.spylog = _DurableSpylog(
+        os.path.join(base_dir, name, "events.jsonl"),
+        now=time.time, seed=node.spylog)
     # late-bound: the recorder may wrap handle_client_message below, and the
     # client stack must call through the WRAPPED method
     client_stack._on_request = \
@@ -125,7 +183,8 @@ def main(argv=None):
     ap.add_argument("--base-dir", required=True)
     ap.add_argument("--backend", default="cpu",
                     choices=["cpu", "jax", "service"])
-    ap.add_argument("--kv", default="file", choices=["file", "memory"])
+    ap.add_argument("--kv", default="file",
+                    choices=["file", "memory", "native", "chunked"])
     ap.add_argument("--record", action="store_true",
                     help="record all ingress for offline replay")
     ap.add_argument("--profile", default=None, metavar="PATH",

@@ -366,3 +366,81 @@ def test_stashing_duplicate_subscribe_raises():
     router.subscribe(Checkpoint, lambda m, frm: PROCESS)
     with pytest.raises(ValueError):
         router.subscribe(Checkpoint, lambda m, frm: PROCESS)
+
+
+# --- chunked append-log store (ref chunked_file_store.py) ------------------
+
+def test_kv_chunked_rotates_and_resumes(tdir):
+    from plenum_tpu.storage.kv_chunked import KvChunked
+    kv = KvChunked(tdir, "c", chunk_records=10)
+    for i in range(35):
+        kv.put(i, b"v%d" % i)
+    kv.remove(7)
+    assert kv.chunk_count == 4            # 36 records / 10 per chunk
+    del kv._fh                            # crash, no close
+    kv2 = KvChunked(tdir, "c", chunk_records=10)
+    assert kv2.size == 34
+    assert kv2.get(34) == b"v34"
+    assert kv2.try_get(7) is None
+    # appends continue in the live tail chunk, sealing at the boundary
+    for i in range(35, 50):
+        kv2.put(i, b"v%d" % i)
+    assert kv2.chunk_count == 6
+    kv2.close()
+    kv3 = KvChunked(tdir, "c", chunk_records=10)
+    assert kv3.size == 49
+    kv3.close()
+
+
+def test_kv_chunked_torn_tail_only_affects_last_chunk(tdir):
+    import os, struct
+    from plenum_tpu.storage.kv_chunked import KvChunked
+    kv = KvChunked(tdir, "c", chunk_records=5)
+    for i in range(12):
+        kv.put(i, b"x%d" % i)
+    kv.close()
+    # tear the TAIL chunk: replay drops only the torn record
+    with open(os.path.join(tdir, "c.000003.chunk"), "ab") as fh:
+        fh.write(struct.pack(">BII", 0, 4, 4) + b"ke")
+    kv2 = KvChunked(tdir, "c", chunk_records=5)
+    assert kv2.size == 12
+    kv2.put(99, b"after")
+    kv2.close()
+    kv3 = KvChunked(tdir, "c", chunk_records=5)
+    assert kv3.get(99) == b"after" and kv3.size == 13
+    kv3.close()
+    # a SEALED chunk failing to parse is corruption and must be loud
+    with open(os.path.join(tdir, "c.000001.chunk"), "r+b") as fh:
+        fh.truncate(7)
+    with pytest.raises(IOError):
+        KvChunked(tdir, "c", chunk_records=5)
+
+
+def test_kv_chunked_backs_a_ledger(tdir):
+    """The chunked store slots in as a Ledger txn log unchanged."""
+    from plenum_tpu.storage.kv_chunked import KvChunked
+    from plenum_tpu.ledger.ledger import Ledger
+    led = Ledger(txn_log=KvChunked(tdir, "txns", chunk_records=8))
+    for i in range(20):
+        led.append({"txn": {"type": "1", "data": {"i": i}},
+                    "txnMetadata": {}, "ver": "1"})
+    root = led.root_hash
+    led.close()
+    led2 = Ledger(txn_log=KvChunked(tdir, "txns", chunk_records=8))
+    assert led2.size == 20
+    assert led2.root_hash == root
+    assert led2.get_by_seq_no(13)["txn"]["data"]["i"] == 12
+
+
+def test_kv_chunked_drop_sealed_chunks(tdir):
+    from plenum_tpu.storage.kv_chunked import KvChunked
+    kv = KvChunked(tdir, "c", chunk_records=4)
+    for i in range(20):
+        kv.put(i, b"d%d" % i)
+    assert kv.chunk_count == 5
+    assert kv.drop_sealed_chunks_before(3) == 2
+    assert kv.chunk_count == 3
+    # live view unaffected; the tail chunk is never dropped
+    assert kv.get(0) == b"d0"
+    assert kv.drop_sealed_chunks_before(999) == 2   # all sealed, not tail
+    kv.close()

@@ -260,3 +260,76 @@ def test_distinct_signers_config_orders_owner_writes():
     r = config1b_distinct_signers(n_txns=40, timeout=60.0)
     assert r.get("txns_ordered") == 40, r
     assert r["distinct_signers"] == 40
+
+
+def test_log_analyzer_unit(tmp_path):
+    """Analyzer halves: error clustering over text, per-view timeline
+    over structured events (ref scripts/process_logs redesign)."""
+    import json as _json
+    from plenum_tpu.tools.log_analyzer import analyze_node
+    d = tmp_path / "NodeX"
+    d.mkdir()
+    (d / "node.log").write_text(
+        "2026-01-01 WARNING stack undecodable message from Node2\n"
+        "2026-01-01 WARNING stack undecodable message from Node3\n"
+        "2026-01-01 ERROR svc handler failed for PrePrepare 17 from Node4\n"
+        "plain info noise that must be ignored\n"
+        "2026-01-01 ERROR svc handler failed for PrePrepare 99 from Node4\n")
+    rows = [
+        {"t": 10.0, "event": "restored_from_audit", "data": [0, 0]},
+        {"t": 11.0, "event": "suspicion", "data": [21, "Node1"]},
+        {"t": 12.5, "event": "vc_stall_phases",
+         "data": {"detect": 11.0, "vote": 12.5, "start": 12.56,
+                  "new_view": 12.58, "order": 12.9}},
+        {"t": 13.0, "event": "view_change_complete", "data": 1},
+        {"t": 14.0, "event": "catchup_started", "data": None},
+    ]
+    with open(d / "events.jsonl", "w") as fh:
+        for r in rows:
+            fh.write(_json.dumps(r) + "\n")
+        fh.write('{"t": 15.0, "event": "torn')   # torn tail: tolerated
+    rep = analyze_node(str(d))
+    assert rep["event_counts"]["suspicion"] == 1
+    # two clusters: the repeated undecodable (x2) and the failed handler
+    # (x2, seq-no digits normalized into one template)
+    levels = {(c["level"], c["count"]) for c in rep["error_clusters"]}
+    assert levels == {("WARNING", 2), ("ERROR", 2)}
+    views = rep["views"]
+    assert [v["view_no"] for v in views] == [0, 1]
+    assert views[0]["vc_stall"]["total_s"] == 1.9
+    assert views[0]["vc_stall"]["phases"]["order"] == 1.9
+    assert views[1]["events"] == {"catchup_started": 1}
+
+
+def test_durable_spylog_survives_torn_tail(tmp_path):
+    """Crash mid-write tears a line; the restarted log starts on a fresh
+    line and the analyzer skips ONLY the torn line (review findings)."""
+    from plenum_tpu.tools.log_analyzer import read_events
+    from plenum_tpu.tools.start_node import _DurableSpylog
+    p = str(tmp_path / "events.jsonl")
+    log = _DurableSpylog(p, now=lambda: 1.0)
+    log.append(("view_change_complete", 1))
+    log._fh.close()
+    with open(p, "a") as fh:
+        fh.write('{"t": 2.0, "event": "torn')      # crash mid-write
+    log2 = _DurableSpylog(p, now=lambda: 3.0)      # restart
+    log2.append(("catchup_started", None))
+    log2._fh.close()
+    rows = read_events(p)
+    assert [r["event"] for r in rows] == ["view_change_complete",
+                                          "catchup_started"]
+
+
+def test_start_node_chunked_backend_is_durable(tmp_path):
+    """--kv chunked must build a node on KvChunked ledgers (review
+    finding: it silently fell back to in-memory storage)."""
+    from plenum_tpu.storage.kv_chunked import KvChunked
+    from plenum_tpu.tools.start_node import build_node
+    from plenum_tpu.tools.tcp_pool import setup_pool_dir
+    base = str(tmp_path)
+    setup_pool_dir(base, ["N1", "N2", "N3", "N4"], b"t" * 32)
+    prodable, node, _reg = build_node("N1", base, kv="chunked")
+    lid = 1
+    log = node.c.db.get_ledger(lid)._log
+    assert isinstance(log, KvChunked), type(log)
+    node.c.db.close()
