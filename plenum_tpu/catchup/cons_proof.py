@@ -13,6 +13,7 @@ from typing import Callable, Optional
 
 from plenum_tpu.common.node_messages import ConsistencyProof, LedgerStatus
 from plenum_tpu.common.quorums import Quorums
+from plenum_tpu.common.timer import TimerService
 from plenum_tpu.execution.database_manager import DatabaseManager
 
 
@@ -20,7 +21,9 @@ class ConsProofService:
     def __init__(self, ledger_id: int, db: DatabaseManager,
                  quorums_provider: Callable[[], Quorums],
                  send: Callable,
-                 on_target: Callable[[int, Optional[tuple[int, str, tuple[int, int]]]], None]):
+                 on_target: Callable[[int, Optional[tuple[int, str, tuple[int, int]]]], None],
+                 timer: Optional[TimerService] = None,
+                 retry_timeout: float = 5.0):
         """on_target(ledger_id, None) = already up to date;
         on_target(ledger_id, (size, root_hex, (view_no, pp_seq_no)))."""
         self.ledger_id = ledger_id
@@ -29,6 +32,9 @@ class ConsProofService:
         self._send = send
         self._on_target = on_target
         self._running = False
+        self._timer = timer
+        self._retry_timeout = retry_timeout
+        self._retry_armed = False
         self._same_status: set[str] = set()
         self._proofs: dict[tuple[int, str], set[str]] = {}
         # (size, root) -> {(view_no, pp_seq_no) -> voters}: the 3PC position
@@ -43,14 +49,44 @@ class ConsProofService:
         self._same_status.clear()
         self._proofs.clear()
         self._last_3pc_votes.clear()
+        self._broadcast_status()
+        # re-broadcast until a quorum forms (ref ConsistencyProofsTimeout
+        # re-request): lost replies or peers that were themselves mid-sync
+        # when we asked must not stall this catchup forever — the leecher
+        # has no other wakeup (found by the partition-heal fuzz: a second
+        # catchup whose one-shot LedgerStatus went unanswered hung the
+        # node in is_running=True with ordering paused)
+        self._arm_retry()
+
+    def _broadcast_status(self) -> None:
         ledger = self._db.get_ledger(self.ledger_id)
         self._send(LedgerStatus(ledger_id=self.ledger_id,
                                 txn_seq_no=ledger.size,
                                 merkle_root=ledger.root_hash.hex(),
                                 view_no=None, pp_seq_no=None), None)
 
+    def _arm_retry(self) -> None:
+        if self._timer is None:
+            return
+        self._cancel_retry()
+        self._timer.schedule(self._retry_timeout, self._on_retry)
+        self._retry_armed = True
+
+    def _cancel_retry(self) -> None:
+        if self._retry_armed and self._timer is not None:
+            self._timer.cancel(self._on_retry)
+            self._retry_armed = False
+
+    def _on_retry(self) -> None:
+        self._retry_armed = False
+        if not self._running:
+            return
+        self._broadcast_status()
+        self._arm_retry()
+
     def stop(self) -> None:
         self._running = False
+        self._cancel_retry()
 
     def process_ledger_status(self, msg: LedgerStatus, frm: str) -> None:
         """A peer telling us ITS status in response to ours."""
@@ -88,4 +124,5 @@ class ConsProofService:
 
     def _finish(self, target) -> None:
         self._running = False
+        self._cancel_retry()
         self._on_target(self.ledger_id, target)

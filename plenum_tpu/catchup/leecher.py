@@ -39,7 +39,8 @@ class LedgerLeecherService:
         self._on_complete = on_complete
         self._last_3pc: Optional[tuple[int, int]] = None
         self.cons_proof = ConsProofService(
-            ledger_id, db, quorums_provider, send, self._on_target)
+            ledger_id, db, quorums_provider, send, self._on_target,
+            timer=timer)
         self.rep = CatchupRepService(
             ledger_id, db, send, timer, peers_provider, on_txn_added,
             self._on_rep_complete)

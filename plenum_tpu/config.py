@@ -83,6 +83,11 @@ class Config:
     # --- faulty backup instances (ref backup_instance_faulty_processor +
     #     ReplicasRemovingWithDegradation config) ---
     BACKUP_INSTANCE_FAULTY_CHECK_FREQ: float = 10.0
+    # straggler self-check cadence: a node whose master ordering shows a
+    # commit QUORUM ahead of a position that made no progress across one
+    # full interval resyncs via catchup (below CHK_FREQ there is no
+    # checkpoint-lag signal, and its lone IC vote can't reach quorum)
+    STUCK_BEHIND_CHECK_FREQ: float = 5.0
     BACKUP_INSTANCE_FAULTY_TIMEOUT: float = 60.0
 
     # --- catchup (ref config.py:297) ---

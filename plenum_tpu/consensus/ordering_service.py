@@ -21,6 +21,7 @@ from typing import Callable, Optional
 from plenum_tpu.common.event_bus import ExternalBus, InternalBus
 from plenum_tpu.common.metrics import MetricsName
 from plenum_tpu.common.internal_messages import (MissingMessage,
+                                                 NeedMasterCatchup,
                                                  NewViewCheckpointsApplied,
                                                  RaisedSuspicion, ReqKey,
                                                  RequestPropagates,
@@ -631,6 +632,51 @@ class OrderingService:
     # ordering                                                           #
     # ------------------------------------------------------------------ #
 
+    def behind_evidence(self) -> Optional[int]:
+        """Highest pp_seq_no with a full COMMIT quorum of votes strictly
+        ahead of our next orderable position — proof a live pool committed
+        past this replica (it can never order those without recovering the
+        gap). None when no such evidence exists."""
+        last = self._data.last_ordered_3pc[1]
+        best = None
+        for k, votes in self.commits.items():
+            if k[1] > last + 1 and \
+                    self._data.quorums.commit.is_reached(len(votes)):
+                best = k[1] if best is None else max(best, k[1])
+        return best
+
+    def _stage_batch(self, pp: PrePrepare) -> bool:
+        """Re-stage an in-flight batch's uncommitted apply (the catchup
+        re-apply twin of _process_valid_preprepare's admission apply):
+        fetch requests, apply under the ORIGINAL view, cross-check every
+        root the pre-prepare claims, consume the requests from the queues.
+        -> False (with the apply reverted) when the batch cannot be staged
+        faithfully — missing requests or non-reproducing roots."""
+        reqs = [self._get_request(d) for d in pp.req_idr]
+        if any(r is None for r in reqs):
+            return False
+        orig = _orig_view(pp)
+        applied = self._executor.apply_batch(
+            pp.ledger_id, reqs, pp.pp_time, orig, pp.pp_seq_no,
+            primaries=(list(self._data.primaries)
+                       if orig == self._data.view_no else None))
+        if (applied.state_root != pp.state_root
+                or applied.txn_root != pp.txn_root
+                or (pp.audit_txn_root
+                    and applied.audit_txn_root != pp.audit_txn_root)):
+            self._executor.revert_last_batch(pp.ledger_id)
+            return False
+        self._applied_unordered.append(
+            (pp.ledger_id, BatchID(pp.view_no, orig,
+                                   pp.pp_seq_no, pp.digest)))
+        # catchup_started's revert re-queued these requests; they ride
+        # THIS re-applied batch — leaving them queued would double-order
+        # them in a later fresh batch (fuzz seed 45)
+        for queue in self.request_queues.values():
+            for d in pp.req_idr:
+                queue.pop(d, None)
+        return True
+
     def _can_order(self, key: tuple[int, int]) -> bool:
         if key in self.ordered:
             return False
@@ -763,7 +809,17 @@ class OrderingService:
             self._data.low_watermark = max(self._data.low_watermark, boundary)
             self._data.stable_checkpoint = max(self._data.stable_checkpoint,
                                                boundary)
-        # Everything at or below the new position is history.
+        # Everything at or below the new position is history — but the
+        # pre-prepares themselves stay fetchable as old-view material: a
+        # later NewView below a stable checkpoint may cite these exact
+        # batches, and a pool where every retainer pruned them wedges all
+        # re-proposal at the first unfetchable citation (found by the
+        # partition-heal fuzz: catchup-then-VC deleted the PPs everywhere).
+        for k, pp in list(self.prePrepares.items()):
+            if k[1] <= last_3pc[1]:
+                orig = pp.original_view_no \
+                    if pp.original_view_no is not None else k[0]
+                self.old_view_preprepares[(orig, k[1])] = pp
         for store in (self.prePrepares, self.sent_preprepares,
                       self.prepares, self.commits):
             for k in [k for k in store if k[1] <= last_3pc[1]]:
@@ -771,9 +827,47 @@ class OrderingService:
         self._stashed_ooo_commits = {
             k: v for k, v in self._stashed_ooo_commits.items()
             if k[1] > last_3pc[1]}
+        # In-flight batches ABOVE the caught-up position lost their staged
+        # applies when catchup_started reverted the uncommitted stack; the
+        # stashed commits about to process would otherwise order them with
+        # nothing staged to commit ("commit with no applied batches" —
+        # partition-heal fuzz). Re-apply them in seq order via the shared
+        # staging helper (same root cross-check as first admission).
+        # ONLY current-view entries: a view-jump catchup (the node view is
+        # adopted before this runs) leaves old-view pre-prepares that can
+        # never order directly in this view — re-staging one would corrupt
+        # the fresh uncommitted stack and make every later honest batch's
+        # roots mismatch. They stay fetchable as old-view material.
+        if self._data.is_master and self._executor is not None:
+            applied_ids = {b for (_l, b) in self._applied_unordered}
+            for key in sorted(self.prePrepares, key=lambda k: k[1]):
+                pp = self.prePrepares[key]
+                if key in self.ordered or key[0] != self._data.view_no:
+                    continue
+                bid = BatchID(pp.view_no, _orig_view(pp),
+                              pp.pp_seq_no, pp.digest)
+                if bid in applied_ids:
+                    continue
+                if not self._stage_batch(pp):
+                    # cannot re-stage (requests gone, or roots no longer
+                    # reproduce): this and every later in-flight batch is
+                    # unrecoverable locally — drop them; the normal
+                    # missing-PP recovery or the next NewView re-supplies
+                    for k in [k for k in self.prePrepares
+                              if k[1] >= key[1]
+                              and k[0] == self._data.view_no
+                              and k not in self.ordered]:
+                        del self.prePrepares[k]
+                    break
         self._data.is_participating = True
         self._stasher.process_all_stashed(StashReason.CATCHING_UP)
         self._stasher.process_all_stashed(StashReason.OUTSIDE_WATERMARKS)
+        # a catchup can JUMP views (audit adoption): messages stashed as
+        # future-view are now current-view material — without this drain a
+        # straggler that caught up mid-view never processes the 3PC
+        # messages for the batches it missed (partition-heal fuzz); still-
+        # future ones simply re-stash through _validate
+        self._stasher.process_all_stashed(StashReason.FUTURE_VIEW)
 
     def process_view_change_started(self, msg: ViewChangeStarted) -> None:
         """Entering a view change: revert uncommitted work, remember old-view
@@ -860,6 +954,23 @@ class OrderingService:
         # uncommitted applies (commit then crashed; found by the fuzz).
         for (orig_view, pp_seq_no, digest, old_pp) in todo:
             if old_pp is None:
+                if pp_seq_no <= self._data.last_ordered_3pc[1]:
+                    # Cited batch is unfetchable (e.g. the whole pool
+                    # crash-restarted past it) but its effects are already
+                    # in OUR committed state: nothing to re-run for us —
+                    # skipping cannot fork us, PROVIDED what we ordered at
+                    # this seq matches the citation when we still know it.
+                    known = self._ordered_originals.get(
+                        (orig_view, pp_seq_no))
+                    if known is not None and known != digest:
+                        # we ordered a DIFFERENT batch than the quorum
+                        # certified (beyond-f damage): resync, don't vote
+                        self._awaited_old_view.pop(
+                            (orig_view, pp_seq_no), None)
+                        self._bus.send(NeedMasterCatchup())
+                        break
+                    self._awaited_old_view.pop((orig_view, pp_seq_no), None)
+                    continue
                 break
             # These requests ride the re-ordered batch; don't re-batch them.
             for queue in self.request_queues.values():

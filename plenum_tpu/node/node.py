@@ -32,10 +32,13 @@ from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
                                              BackupInstanceFaulty,
                                              BatchCommitted,
                                              CatchupRep, CatchupReq,
-                                             ConsistencyProof, LedgerStatus,
+                                             Commit, ConsistencyProof,
+                                             LedgerStatus, NewView,
                                              Ordered, POOL_LEDGER_ID,
+                                             Prepare, PrePrepare,
                                              Propagate, Reject, Reply,
-                                             RequestAck, RequestNack)
+                                             RequestAck, RequestNack,
+                                             ViewChange)
 from plenum_tpu.common.serialization import pack, unpack
 from plenum_tpu.execution.database_manager import (NODE_STATUS_DB_LABEL,
                                                    SEQ_NO_DB_LABEL)
@@ -299,6 +302,25 @@ class Node:
         self._needs_resync = False
         self.node_bus.subscribe(ExternalBus.Connected,
                                 self._maybe_resync_after_partition)
+        # straggler self-check: a node stuck in an old view while the pool
+        # moved on (it was mid-catchup through the view change; its lone
+        # InstanceChange vote can never reach quorum, and below CHK_FREQ
+        # no checkpoint-lag signal exists) would wait forever on stashed
+        # FUTURE_VIEW messages. Once f+1 DISTINCT peers are seen talking
+        # in higher views, the pool has provably moved on without us:
+        # resync via catchup, which adopts the audit ledger's view (found
+        # by the partition-heal fuzz; ref: the f+1 future-view lag checks
+        # in the reference's message stashing/CurrentState handling).
+        self._ahead_views: dict[str, int] = {}
+        self._straggler_fired_view = -1
+        for mt in (PrePrepare, Prepare, Commit, ViewChange, NewView):
+            self.node_bus.subscribe(mt, self._note_peer_view)
+        # seq-lag twin of the view-lag check: a commit quorum sitting
+        # ahead of a position that made no progress across one interval
+        self._behind_marker: Optional[int] = None
+        self._behind_check_timer = RepeatingTimer(
+            timer, self.config.STUCK_BEHIND_CHECK_FREQ,
+            self._check_stuck_behind)
         # VC stall decomposition: detection stamp on primary disconnect
         self._vc_phase_ts: dict[str, float] = {}
         self.node_bus.subscribe(
@@ -701,6 +723,62 @@ class Node:
 
     # --- catchup ----------------------------------------------------------
 
+    def _check_stuck_behind(self) -> None:
+        """A live pool committed past us and we made no ordering progress
+        for a full check interval: resync. Covers the mid-view straggler
+        (rejoined after missing batches; no checkpoint below CHK_FREQ, no
+        quorum behind its lone InstanceChange vote)."""
+        r = self.master_replica
+        evidence = r.ordering.behind_evidence()
+        if evidence is None or self.leecher.is_running:
+            self._behind_marker = None
+            return
+        last = r.last_ordered_3pc[1]
+        if self._behind_marker == last:
+            self._behind_marker = None
+            self.spylog.append(("stuck_behind_resync", (last, evidence)))
+            self.start_catchup()
+        else:
+            self._behind_marker = last
+
+    def _note_peer_view(self, msg, frm: str) -> None:
+        """Track the highest view each peer is demonstrably IN (master-
+        instance consensus messages only); f+1 peers ahead -> resync.
+        ViewChange/NewView for exactly my+1 do NOT count: during an
+        ordinary view change every peer broadcasts those moments before
+        we enter the view ourselves — only 3PC traffic (proof a higher
+        view is ORDERING) or a jump of >= 2 views is straggler evidence."""
+        view = getattr(msg, "view_no", None)
+        if view is None or getattr(msg, "inst_id", 0) != 0:
+            return
+        my = self.master_replica.data.view_no
+        if view <= my:
+            self._ahead_views.pop(frm, None)
+            return
+        if isinstance(msg, (ViewChange, NewView)) and view == my + 1:
+            return
+        self._ahead_views[frm] = view
+        ahead = [s for s, v in self._ahead_views.items() if v > my]
+        if (len(ahead) >= self.quorums.propagate.value
+                and my > self._straggler_fired_view
+                and not self.leecher.is_running):
+            self._straggler_fired_view = my        # once per stuck view
+            # DEFERRED: this handler runs inside consensus message
+            # dispatch — starting catchup here would revert uncommitted
+            # state under the 3PC processing stack mid-message. The
+            # callback RE-VERIFIES the lag: a view change that completed
+            # in the gap (we caught up on our own) must not pay a
+            # needless catchup.
+            self.timer.schedule(0.0, self._straggler_catchup)
+
+    def _straggler_catchup(self) -> None:
+        my = self.master_replica.data.view_no
+        ahead = [s for s, v in self._ahead_views.items() if v > my]
+        if (len(ahead) >= self.quorums.propagate.value
+                and not self.leecher.is_running):
+            self.spylog.append(("straggler_resync", (my, sorted(ahead))))
+            self.start_catchup()
+
     def _on_lost_quorum_connectivity(self) -> None:
         """The watcher fired: we HAD consensus connectivity and now sit
         below the weak quorum. The reference restarts the node here; the
@@ -725,6 +803,12 @@ class Node:
         (ref node.py:2610 start_catchup → NodeLeecherService.start)."""
         if self.leecher.is_running:
             return
+        # Quorum-ordered batches awaiting execution MUST execute before
+        # catchup reverts the uncommitted stack they sit on (ref
+        # force_process_ordered before starting the leecher): popping
+        # them later against a reverted stack raised "commit with no
+        # applied batches" and dropped ordered work (partition-heal fuzz).
+        self._service_ordered()
         self.metrics.add_event(MetricsName.CATCHUPS)
         self.spylog.append(("catchup_started", None))
         for replica in self.replicas:
@@ -743,6 +827,14 @@ class Node:
         digest = txn_lib.txn_digest(txn)
         if digest:
             self.propagator.requests.mark_executed(digest)
+            # the request may sit RE-QUEUED in a replica (catchup_started's
+            # revert returns unordered batches' requests to the queues, and
+            # the pool ordered this one without us): leaving it queued lets
+            # a primary re-batch an already-committed request (fuzz seed 45
+            # double-order)
+            for replica in self.replicas:
+                for q in replica.ordering.request_queues.values():
+                    q.pop(digest, None)
 
     def _on_catchup_complete(self, last_3pc) -> None:
         """All ledgers synced: adopt the audit ledger's 3PC position and
