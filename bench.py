@@ -71,15 +71,15 @@ def _run_jax_pool_subprocess():
     return {"error": (out.stderr or "no output").strip()[-300:]}
 
 
-def _run_tcp_pool(n_nodes=4, n_txns=200, backend="cpu", window=250):
+def _run_tcp_pool(n_nodes=4, n_txns=200, backend="cpu", window=300):
     """Real-transport color for the bench line (guarded: a broken spawn
     environment must degrade to the in-process numbers, never fail).
 
-    window=250: the round-5 sweep showed TPS ~= window/p50 until the
-    pool goes CPU-bound around 400-500 TPS; 100 left the pool
-    latency-bound at ~310 (docs/performance.md round-5 table). The
-    reference's own best (74 TPS) was at ITS best window (100; it got
-    worse at 256/512 — BASELINE.md), so each system runs its best."""
+    window=300: the round-5 sweeps showed TPS ~= window/p50 until the
+    pool goes CPU-bound ~550 TPS (quiet host; 250 -> 510-538, 300/400
+    -> ~550 with p50 rising past 300). The reference's own best
+    (74 TPS) was at ITS best window (100; it got worse at 256/512 —
+    BASELINE.md), so each system runs its best."""
     try:
         from plenum_tpu.tools.tcp_pool import run_tcp_pool
         return run_tcp_pool(n_nodes=n_nodes, n_txns=n_txns, timeout=90.0,
