@@ -390,3 +390,58 @@ def test_vc_stall_phases_are_recorded():
         <= ts["new_view"] <= ts["order"]
     # detection wait ~= the configured tolerance (MockTimer steps 0.1s)
     assert 1.9 <= ts["vote"] - ts["detect"] <= 2.7, ts
+
+
+def test_straggler_recheck_avoids_spurious_catchup():
+    """An ordinary view change must NOT trigger the straggler catchup:
+    ViewChange/NewView chatter for my+1 is excluded from evidence, and
+    the deferred callback re-verifies the lag at fire time."""
+    pool = fast_pool(seed=41)
+    primary = pool.nodes["Alpha"].master_replica.data.primary_name
+    cut_off(pool, primary)
+    user = Ed25519Signer(seed=b"recheck".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, user, req_id=1),
+                to=healthy(pool, primary))
+    pool.run(20.0)
+    for n in healthy(pool, primary):
+        node = pool.nodes[n]
+        assert node.master_replica.view_no >= 1
+        # the single-step view change produced no straggler resync
+        assert not [e for e in node.spylog if e[0] == "straggler_resync"], n
+        assert node.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2, n
+
+
+def test_stuck_behind_resync_rejoins_mid_view():
+    """A node isolated while the pool orders PAST it (same view, below
+    CHK_FREQ) must detect the commit quorum ahead of its stagnant
+    position and resync without any view change."""
+    pool = fast_pool(seed=43,
+                     STUCK_BEHIND_CHECK_FREQ=1.0,
+                     ORDERING_PROGRESS_TIMEOUT=300.0,
+                     STATE_FRESHNESS_UPDATE_INTERVAL=300.0,
+                     PRIMARY_DISCONNECT_TIMEOUT=300.0)
+    primary = pool.nodes["Alpha"].master_replica.data.primary_name
+    assert primary == "Alpha"
+    victim = "Delta"
+    rules = cut_off(pool, victim)
+    users = [Ed25519Signer(seed=(b"sb%d" % i).ljust(32, b"\0"))
+             for i in range(3)]
+    for i, u in enumerate(users):
+        pool.submit(signed_nym(pool.trustee, u, req_id=i + 1),
+                    to=healthy(pool, victim))
+        pool.run(2.0)
+    # pool ordered 3 txns without the victim
+    assert pool.nodes["Alpha"].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 4
+    assert pool.nodes[victim].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 1
+    for r in rules:
+        pool.net.remove_rule(r)
+    # heal: new traffic flows; the victim sees commits ahead of its
+    # stagnant position and resyncs WITHOUT a view change
+    u4 = Ed25519Signer(seed=b"sb-late".ljust(32, b"\0"))
+    pool.submit(signed_nym(pool.trustee, u4, req_id=9))
+    pool.run(15.0)
+    node = pool.nodes[victim]
+    assert [e for e in node.spylog if e[0] == "stuck_behind_resync"], \
+        "victim never detected the quorum ahead of it"
+    assert node.master_replica.view_no == 0      # no view change happened
+    assert node.c.db.get_ledger(DOMAIN_LEDGER_ID).size == 5
