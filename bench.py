@@ -122,13 +122,15 @@ def main():
     # decompress, double-buffered waves) exists to make this config beat
     # service:cpu THROUGH the tunnel. Two passes: the first pays any
     # uncached compile, the second is the warm figure we publish.
+    # both passes run unconditionally: the first may time out mid-compile
+    # (a fresh service process pays the kernel compiles), the second rides
+    # the persistent XLA disk cache and is the warm figure; keep the last
+    # COMPLETE run
     tcpsvcjax = None
     for _ in range(2):
         got = _run_tcp_pool(n_txns=600, backend="service:jax")
-        if got and got.get("txns_ordered"):
+        if got and got.get("txns_ordered") == 600:
             tcpsvcjax = got
-        else:
-            break
     tcp7 = _run_tcp_pool(n_nodes=7, n_txns=100)   # f=2 scale datum
     jax_stats = _run_jax_pool_subprocess()
 
