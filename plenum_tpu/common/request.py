@@ -162,7 +162,13 @@ class Request:
     @classmethod
     def from_dict(cls, d: dict) -> "Request":
         try:
-            raw = msgpack.packb(d, use_bin_type=True)
+            # UNSIGNED requests (reads: GET_*) skip the cache entirely:
+            # the cache exists for the propagate path, where every node
+            # re-parses the same SIGNED request n times — each read is
+            # unique and node-local, so caching it only churns the write
+            # entries out (and pays canonicalize+digests nobody reuses)
+            raw = msgpack.packb(d, use_bin_type=True) \
+                if (d.get("signature") or d.get("signatures")) else None
         except Exception:
             raw = None          # unpackable content: validate the long way
         if raw is not None:
