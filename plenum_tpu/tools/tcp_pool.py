@@ -103,7 +103,7 @@ async def drive_load(addrs, f, requests, window: int, timeout: float):
 def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                  base_dir: str | None = None, timeout: float = 120.0,
                  profile_dir: str | None = None,
-                 service_min_batch: int = 128,
+                 service_min_batch: int | None = None,
                  window: int = 100,
                  config_overrides: dict | None = None) -> dict:
     from plenum_tpu.client.wallet import Wallet
@@ -140,7 +140,13 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
             service_proc = subprocess.Popen(
                 [sys.executable, "-m", "plenum_tpu.parallel.crypto_service",
                  "--socket", sock_path, "--backend", inner,
-                 "--min-batch", str(service_min_batch)],
+                 # device dispatches pay a fixed tunnel round-trip that
+                 # dwarfs padded compute (48 ms RTT vs ~4 ms at 512), so
+                 # the jax plane pads to ONE large bucket; min_batch only
+                 # pads — it never waits — so latency is unaffected
+                 "--min-batch", str(service_min_batch if service_min_batch
+                                    else (512 if inner.startswith("jax")
+                                          else 128))],
                 env=service_env, cwd=REPO,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
             deadline = time.perf_counter() + 240.0   # jax init can compile
