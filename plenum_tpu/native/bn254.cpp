@@ -19,6 +19,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 
 typedef uint64_t u64;
 typedef __uint128_t u128;
@@ -985,25 +986,20 @@ static void f12_mul_line(Fp12 &f, const Fp2 &A, const Fp2 &B, const Fp2 &C) {
     f6_sub(f.c1, m, t1);
 }
 
-// Doubling step: computes the tangent line at T evaluated at P AND advances
-// T <- 2T, sharing one lambda (and thus one field inversion) between them.
-static void dbl_step(Fp2 &A, Fp2 &B, Fp2 &C, G2 &t, const Fp &xp,
-                     const Fp &yp) {
-    Fp2 lam, num, den, x2;
+// P-independent half of a doubling step: the tangent's (lambda, C) at T
+// — which depend ONLY on T — plus the T <- 2T advance. The expensive
+// part (one Fp2 inversion, ~a Fermat exponentiation) lives here, which
+// is what makes precomputing these per distinct Q worthwhile.
+static void dbl_coeff(Fp2 &lam, Fp2 &C, G2 &t) {
+    Fp2 num, den, x2;
     f2_sqr(x2, t.x);
     f2_mul_small(num, x2, 3);
     f2_dbl(den, t.y);
     f2_inv(den, den);
     f2_mul(lam, num, den);
-    // line: A = -yP, B = lam*xP, C = yT - lam*xT
-    A.c0 = FP_ZERO; A.c1 = FP_ZERO;
-    fp_neg(A.c0, yp);
-    fp_mul(B.c0, lam.c0, xp);
-    fp_mul(B.c1, lam.c1, xp);
     Fp2 lx;
     f2_mul(lx, lam, t.x);
     f2_sub(C, t.y, lx);
-    // T <- 2T with the same lambda
     Fp2 x3, yy;
     f2_sqr(x3, lam);
     f2_sub(x3, x3, t.x);
@@ -1015,21 +1011,34 @@ static void dbl_step(Fp2 &A, Fp2 &B, Fp2 &C, G2 &t, const Fp &xp,
     t.y = yy;
 }
 
-// Addition step: chord line through T and Q at P; T <- T+Q; shares lambda.
-// Returns false for the degenerate vertical case (T = -Q), where the line is
-// xP - xT*w^2 and T becomes infinity — callers fall back to a generic mul.
-static bool add_step(Fp2 &A, Fp2 &B, Fp2 &C, G2 &t, const G2 &q,
-                     const Fp &xp, const Fp &yp) {
-    if (f2_eq(t.x, q.x)) return false;
-    Fp2 lam, num, den;
-    f2_sub(num, q.y, t.y);
-    f2_sub(den, q.x, t.x);
-    f2_inv(den, den);
-    f2_mul(lam, num, den);
+// P-dependent half: scale the line to the G1 point (2 fp_mul, no inversion).
+static inline void line_eval(Fp2 &A, Fp2 &B, const Fp2 &lam, const Fp &xp,
+                             const Fp &yp) {
     A.c0 = FP_ZERO; A.c1 = FP_ZERO;
     fp_neg(A.c0, yp);
     fp_mul(B.c0, lam.c0, xp);
     fp_mul(B.c1, lam.c1, xp);
+}
+
+// Doubling step: computes the tangent line at T evaluated at P AND advances
+// T <- 2T, sharing one lambda (and thus one field inversion) between them.
+static void dbl_step(Fp2 &A, Fp2 &B, Fp2 &C, G2 &t, const Fp &xp,
+                     const Fp &yp) {
+    Fp2 lam;
+    dbl_coeff(lam, C, t);
+    line_eval(A, B, lam, xp, yp);
+}
+
+// Addition step: chord line through T and Q at P; T <- T+Q; shares lambda.
+// Returns false for the degenerate vertical case (T = -Q), where the line is
+// xP - xT*w^2 and T becomes infinity — callers fall back to a generic mul.
+static bool add_coeff(Fp2 &lam, Fp2 &C, G2 &t, const G2 &q) {
+    if (f2_eq(t.x, q.x)) return false;
+    Fp2 num, den;
+    f2_sub(num, q.y, t.y);
+    f2_sub(den, q.x, t.x);
+    f2_inv(den, den);
+    f2_mul(lam, num, den);
     Fp2 lx;
     f2_mul(lx, lam, t.x);
     f2_sub(C, t.y, lx);
@@ -1042,6 +1051,14 @@ static bool add_step(Fp2 &A, Fp2 &B, Fp2 &C, G2 &t, const G2 &q,
     f2_sub(yy, yy, t.y);
     t.x = x3;
     t.y = yy;
+    return true;
+}
+
+static bool add_step(Fp2 &A, Fp2 &B, Fp2 &C, G2 &t, const G2 &q,
+                     const Fp &xp, const Fp &yp) {
+    Fp2 lam;
+    if (!add_coeff(lam, C, t, q)) return false;
+    line_eval(A, B, lam, xp, yp);
     return true;
 }
 
@@ -1088,6 +1105,126 @@ static void miller_loop(Fp12 &f, const G2 &q, const G1 &p) {
         if (add_step(A, B, C, t, nq2, p.x, p.y)) f12_mul_line(f, A, B, C);
         else mul_vertical(f, t, p.x);
     }
+}
+
+// ------------------------------------------------- prepared pairings
+//
+// Every dbl/add step above pays an Fp2 inversion (a Fermat
+// exponentiation — by far the step's dominant cost), and the (lam, C)
+// coefficients those inversions produce depend ONLY on the G2 argument.
+// A BLS verification pairs (G2 generator, -sig) and (aggregated pool
+// key, H(m)): the generator is fixed forever and the aggregate repeats
+// per participant set, so both Miller loops run inversion-free once
+// their coefficient sequences are cached (keyed by the raw 128-byte G2
+// encoding; a small mutex-guarded table — ctypes callers release the
+// GIL, so concurrent pairing checks are real).
+
+#define PREP_MAX_STEPS 136        // 64 dbl + <=65 add + 2 frobenius adds
+struct PreparedG2 {
+    uint8_t key[128];
+    int n_steps;
+    bool used;
+    Fp2 lam[PREP_MAX_STEPS];
+    Fp2 c[PREP_MAX_STEPS];
+};
+
+static bool prepare_g2(PreparedG2 &pre, const G2 &q0) {
+    pre.n_steps = 0;
+    if (q0.inf) return false;
+    G2 t = q0;
+    int s = 0;
+    for (int i = ATE_TOP_BIT - 1; i >= 0; i--) {
+        if (t.inf || s + 2 > PREP_MAX_STEPS) return false;
+        dbl_coeff(pre.lam[s], pre.c[s], t);
+        s++;
+        bool bit = (i < 64) ? ((ATE_LOOP >> i) & 1) : true;
+        if (bit) {
+            if (t.inf) return false;
+            if (!add_coeff(pre.lam[s], pre.c[s], t, q0)) return false;
+            s++;
+        }
+    }
+    G2 q1, q2, nq2;
+    g2_frob_pt(q1, q0);
+    g2_frob_pt(q2, q1);
+    g2_neg_pt(nq2, q2);
+    if (t.inf || s + 2 > PREP_MAX_STEPS) return false;
+    if (!add_coeff(pre.lam[s], pre.c[s], t, q1)) return false;
+    s++;
+    if (t.inf) return false;
+    if (!add_coeff(pre.lam[s], pre.c[s], t, nq2)) return false;
+    s++;
+    pre.n_steps = s;
+    return true;
+}
+
+// Same loop structure as miller_loop, consuming cached coefficients:
+// zero inversions, two fp_mul per line.
+static void miller_loop_prepared(Fp12 &f, const PreparedG2 &pre,
+                                 const G1 &p) {
+    f12_one(f);
+    if (p.inf) return;
+    Fp2 A, B;
+    int s = 0;
+    for (int i = ATE_TOP_BIT - 1; i >= 0; i--) {
+        f12_sqr(f, f);
+        line_eval(A, B, pre.lam[s], p.x, p.y);
+        f12_mul_line(f, A, B, pre.c[s]);
+        s++;
+        bool bit = (i < 64) ? ((ATE_LOOP >> i) & 1) : true;
+        if (bit) {
+            line_eval(A, B, pre.lam[s], p.x, p.y);
+            f12_mul_line(f, A, B, pre.c[s]);
+            s++;
+        }
+    }
+    line_eval(A, B, pre.lam[s], p.x, p.y);
+    f12_mul_line(f, A, B, pre.c[s]);
+    s++;
+    line_eval(A, B, pre.lam[s], p.x, p.y);
+    f12_mul_line(f, A, B, pre.c[s]);
+}
+
+#define PREP_CACHE_SLOTS 8
+static PreparedG2 g_prep_cache[PREP_CACHE_SLOTS];
+static uint64_t g_prep_last_hit[PREP_CACHE_SLOTS];
+static uint64_t g_prep_tick = 0;
+static std::mutex g_prep_mu;
+
+// Copy only the LIVE coefficients (n_steps of PREP_MAX_STEPS) so the
+// critical section stays short for concurrent pairing callers.
+static void prep_copy(PreparedG2 &dst, const PreparedG2 &src) {
+    memcpy(dst.key, src.key, sizeof src.key);
+    dst.n_steps = src.n_steps;
+    dst.used = src.used;
+    memcpy(dst.lam, src.lam, sizeof(Fp2) * src.n_steps);
+    memcpy(dst.c, src.c, sizeof(Fp2) * src.n_steps);
+}
+
+static bool prep_cache_get(const uint8_t *key, PreparedG2 &out) {
+    std::lock_guard<std::mutex> lock(g_prep_mu);
+    for (int i = 0; i < PREP_CACHE_SLOTS; i++) {
+        if (g_prep_cache[i].used &&
+                memcmp(g_prep_cache[i].key, key, 128) == 0) {
+            prep_copy(out, g_prep_cache[i]);
+            g_prep_last_hit[i] = ++g_prep_tick;   // LRU: hits keep the
+            return true;                          // generator resident
+        }
+    }
+    return false;
+}
+
+static void prep_cache_put(const uint8_t *key, const PreparedG2 &pre) {
+    std::lock_guard<std::mutex> lock(g_prep_mu);
+    int slot = 0;
+    for (int i = 1; i < PREP_CACHE_SLOTS; i++) {
+        if (!g_prep_cache[i].used) { slot = i; break; }
+        if (g_prep_last_hit[i] < g_prep_last_hit[slot]) slot = i;
+    }
+    prep_copy(g_prep_cache[slot], pre);
+    memcpy(g_prep_cache[slot].key, key, 128);
+    g_prep_cache[slot].used = true;
+    g_prep_last_hit[slot] = ++g_prep_tick;
 }
 
 static void final_exp(Fp12 &r, const Fp12 &f) {
@@ -1216,7 +1353,19 @@ int pc_pairing_check(const uint8_t *g2s, const uint8_t *g1s, int n) {
         if (!decode_g2(q, g2s + 128 * i)) return -1;
         if (!decode_g1(p, g1s + 64 * i)) return -1;
         Fp12 f;
-        miller_loop(f, q, p);
+        // prepared path: reuse (or build) the coefficient sequence for
+        // this G2 — inversion-free Miller loop on every cache hit. A
+        // degenerate structure (infinity/vertical mid-ladder; impossible
+        // for valid subgroup points) falls back to the generic loop.
+        PreparedG2 pre;
+        if (prep_cache_get(g2s + 128 * i, pre)) {
+            miller_loop_prepared(f, pre, p);
+        } else if (prepare_g2(pre, q)) {
+            prep_cache_put(g2s + 128 * i, pre);
+            miller_loop_prepared(f, pre, p);
+        } else {
+            miller_loop(f, q, p);
+        }
         f12_mul(acc, acc, f);
     }
     Fp12 res;
