@@ -1,6 +1,7 @@
 from .sim_random import SimRandom
-from .sim_network import SimNetwork, Discard, Deliver, Stash, Rule
+from .sim_network import SimNetwork, Discard, Deliver, Stash, Mutate, Rule
 from .sim_network import match_frm, match_dst, match_type
 
-__all__ = ["SimRandom", "SimNetwork", "Discard", "Deliver", "Stash", "Rule",
+__all__ = ["SimRandom", "SimNetwork", "Discard", "Deliver", "Stash",
+           "Mutate", "Rule",
            "match_frm", "match_dst", "match_type"]

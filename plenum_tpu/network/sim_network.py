@@ -32,7 +32,17 @@ class Stash(NamedTuple):
     pass
 
 
-Action = Union[Discard, Deliver, Stash]
+class Mutate(NamedTuple):
+    """Byzantine fault injection: transform the message before delivery
+    (the transform returns the replacement message, or None to drop).
+    Mutated traffic still pays the normal wire roundtrip, so a mutation
+    that breaks the message SCHEMA surfaces as a parse reject at the
+    receiver — exactly like a real byzantine peer's frame would."""
+    transform: Callable[[Any], Any]
+    probability: float = 1.0
+
+
+Action = Union[Discard, Deliver, Stash, Mutate]
 Selector = Callable[[Any, str, str], bool]   # (msg, frm, dst) -> bool
 
 
@@ -144,6 +154,12 @@ class SimNetwork:
             if isinstance(rule.action, Stash):
                 self._stashed.append((msg, frm, dst))
                 return
+            if isinstance(rule.action, Mutate):
+                if self._random.float(0.0, 1.0) <= rule.action.probability:
+                    msg = rule.action.transform(msg)
+                    if msg is None:
+                        return
+                continue        # mutated message keeps flowing down the chain
             if isinstance(rule.action, Deliver):
                 delay = self._random.float(rule.action.min_delay, rule.action.max_delay)
                 self._schedule(delay, msg, frm, dst)

@@ -55,7 +55,7 @@ def run_scenario(seed: int) -> None:
     # draw the scenario FIRST: scenario 3 needs a durable pool (crash-
     # recovery with stable storage), the rest an in-memory one — building
     # both would double every seed's setup cost
-    scenario = rng.integer(0, 4)
+    scenario = rng.integer(0, 5)
     durable = None
     if scenario == 3:
         import tempfile
@@ -159,6 +159,49 @@ def run_scenario(seed: int) -> None:
                         f"seed {seed}: {n} still marked inconsistent"
         finally:
             shutil.rmtree(durable, ignore_errors=True)
+    elif scenario == 4:
+        # BYZANTINE LIES: one non-primary node's outbound 3PC messages are
+        # randomly mutated in flight (type-preserving field corruption —
+        # digests, seq/view numbers, roots — exactly what a malicious
+        # peer's process could emit). f=1 tolerates one liar: SAFETY must
+        # hold unconditionally and the pool must keep ordering.
+        from plenum_tpu.common.node_messages import (Commit, PrePrepare,
+                                                     Prepare)
+        from plenum_tpu.network import Mutate
+        import dataclasses
+        liar = [n for n in pool.names if n != primary][rng.integer(0, 2)]
+
+        def corrupt(msg, rng=rng):
+            kind = rng.integer(0, 3)
+            try:
+                if kind == 0 and hasattr(msg, "digest") and msg.digest:
+                    return dataclasses.replace(
+                        msg, digest="f" * len(msg.digest))
+                if kind == 1 and hasattr(msg, "pp_seq_no"):
+                    return dataclasses.replace(
+                        msg, pp_seq_no=msg.pp_seq_no + rng.integer(1, 3))
+                if kind == 2 and hasattr(msg, "state_root") and \
+                        getattr(msg, "state_root", ""):
+                    return dataclasses.replace(msg, state_root="0" * 64)
+                if hasattr(msg, "view_no"):
+                    return dataclasses.replace(
+                        msg, view_no=msg.view_no + rng.integer(1, 2))
+            except Exception:
+                return None     # unmutable shape: drop it (also byzantine)
+            return msg
+
+        pool.net.add_rule(Mutate(corrupt, probability=rng.float(0.3, 0.9)),
+                          match_frm(liar),
+                          lambda m, _f, _d: isinstance(
+                              m, (PrePrepare, Prepare, Commit)))
+        pool.submit(reqs[0])
+        pool.run(10.0)
+        pool.submit(reqs[1])
+        pool.run(20.0)
+        honest = [n for n in pool.names if n != liar]
+        sizes = {len(_domain_txns(pool.nodes[n])) for n in honest}
+        assert sizes == {3}, \
+            f"seed {seed}: honest nodes failed to order under lies: {sizes}"
     else:
         # lagging node crawls through the whole view change (multi-second
         # random delays both ways — it cannot block the VC quorum, only
@@ -208,9 +251,9 @@ def test_sim_fuzz_smoke():
     """One scenario of each kind always runs in the default suite."""
     seen: set[int] = set()
     seed = 0
-    while len(seen) < 5 and seed < 60:
+    while len(seen) < 6 and seed < 80:
         rng = SimRandom(seed * 7919 + 17)
-        kind = rng.integer(0, 4)
+        kind = rng.integer(0, 5)
         if kind not in seen:
             seen.add(kind)
             run_scenario(seed)
