@@ -118,6 +118,9 @@ class OrderingService:
         # (orig_view, pp_seq_no) -> cited digest: NewView batches we lack
         # locally and have re-requested from peers
         self._awaited_old_view: dict[tuple[int, int], str] = {}
+        # request digests a NewView re-proposal is blocked on (the new
+        # primary lacked them); fresh batch minting pauses until resolved
+        self._awaiting_reproposal: set = set()
         # the last accepted NewView payload, re-run when an awaited old-view
         # pre-prepare arrives
         self._last_new_view_msg: Optional[NewViewCheckpointsApplied] = None
@@ -145,6 +148,16 @@ class OrderingService:
         self._queue_first_ts.setdefault(ledger_id,
                                         self._timer.get_current_time())
         self._stasher.process_all_stashed(StashReason.MISSING_REQUESTS)
+        # a NewView re-proposal deferred on THIS request (the primary
+        # lacked it): resume the pass — idempotent, skips batches already
+        # re-proposed. Gating on the pending set matters: an unconditional
+        # re-entry would rerun the pass during normal post-view-change
+        # operation and reset pp_seq_no under in-flight fresh batches.
+        if (msg.digest in self._awaiting_reproposal
+                and self._last_new_view_msg is not None
+                and self.is_primary):
+            self.process_new_view_checkpoints_applied(
+                self._last_new_view_msg)
 
     # ------------------------------------------------------------------ #
     # batch creation (primary)                                           #
@@ -161,7 +174,7 @@ class OrderingService:
             return
         if not self._data.is_participating:
             return
-        if self._awaited_old_view:
+        if self._awaited_old_view or self._awaiting_reproposal:
             # a new primary must finish re-proposing the NewView's cited
             # batches before cutting fresh ones — a fresh batch slotted
             # between pending re-proposals applies out of seq order and
@@ -888,6 +901,7 @@ class OrderingService:
         self._commits_sent.clear()
         self._stashed_ooo_commits.clear()
         self._awaited_old_view.clear()
+        self._awaiting_reproposal.clear()
         self._last_new_view_msg = None
         if not self._data.is_master:
             self._needs_last_ordered_setup = True
@@ -912,6 +926,7 @@ class OrderingService:
         """Re-order the prepared batches carried into the new view
         (ref process_new_view_checkpoints_applied :2380)."""
         self._last_new_view_msg = msg
+        self._awaiting_reproposal.clear()   # recomputed by this pass
         # Continue the sequence from what actually survives into the new view:
         # ordered prefix, selected checkpoint, re-ordered batches — and EVERY
         # seq_no the NewView cites, held locally or not. Minting a fresh batch
@@ -988,6 +1003,22 @@ class OrderingService:
                      or self._ordered_originals.get(
                          (orig_view, pp_seq_no)) == digest)
             if self.is_primary:
+                if self._data.is_master and self._executor is not None \
+                        and not rerun:
+                    # the primary must HOLD every request to re-apply the
+                    # cited batch faithfully; a gap (never propagated to
+                    # us, or swept) is fetched and the re-proposal resumes
+                    # from this seq when the requests land (process_req_key
+                    # re-enters; strict order forbids skipping ahead) —
+                    # applying with None holes crashed the write manager
+                    # (byzantine fuzz seed 2453)
+                    missing = tuple(d for d in new_pp.req_idr
+                                    if self._get_request(d) is None)
+                    if missing:
+                        self._awaiting_reproposal = set(missing)
+                        self._bus.send(
+                            RequestPropagates(bad_requests=missing))
+                        break
                 self.sent_preprepares[key] = new_pp
                 self.prePrepares[key] = new_pp
                 self._data.pp_seq_no = max(self._data.pp_seq_no, pp_seq_no)

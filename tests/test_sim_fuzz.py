@@ -192,8 +192,7 @@ def run_scenario(seed: int) -> None:
 
         pool.net.add_rule(Mutate(corrupt, probability=rng.float(0.3, 0.9)),
                           match_frm(liar),
-                          lambda m, _f, _d: isinstance(
-                              m, (PrePrepare, Prepare, Commit)))
+                          match_type((PrePrepare, Prepare, Commit)))
         pool.submit(reqs[0])
         pool.run(10.0)
         pool.submit(reqs[1])
