@@ -313,6 +313,7 @@ class Node:
         # in the reference's message stashing/CurrentState handling).
         self._ahead_views: dict[str, int] = {}
         self._straggler_fired_view = -1
+        self._straggler_fired_at = float("-inf")
         for mt in (PrePrepare, Prepare, Commit, ViewChange, NewView):
             self.node_bus.subscribe(mt, self._note_peer_view)
         # seq-lag twin of the view-lag check: a commit quorum sitting
@@ -759,10 +760,18 @@ class Node:
             return
         self._ahead_views[frm] = view
         ahead = [s for s, v in self._ahead_views.items() if v > my]
+        now = self.timer.get_current_time()
+        # damping: once per stuck view, UNLESS a previous attempt already
+        # came and went without unsticking us (a catchup that raced the
+        # rest of the pool's own recovery can conclude at a stale target;
+        # the lag evidence persisting past a cooldown earns a retry)
+        cooldown = 2 * self.config.STUCK_BEHIND_CHECK_FREQ
         if (len(ahead) >= self.quorums.propagate.value
-                and my > self._straggler_fired_view
+                and (my > self._straggler_fired_view
+                     or now - self._straggler_fired_at > cooldown)
                 and not self.leecher.is_running):
-            self._straggler_fired_view = my        # once per stuck view
+            self._straggler_fired_view = my
+            self._straggler_fired_at = now
             # DEFERRED: this handler runs inside consensus message
             # dispatch — starting catchup here would revert uncommitted
             # state under the 3PC processing stack mid-message. The
