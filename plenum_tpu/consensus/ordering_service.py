@@ -822,24 +822,30 @@ class OrderingService:
             self._data.low_watermark = max(self._data.low_watermark, boundary)
             self._data.stable_checkpoint = max(self._data.stable_checkpoint,
                                                boundary)
-        # Everything at or below the new position is history — but the
-        # pre-prepares themselves stay fetchable as old-view material: a
-        # later NewView below a stable checkpoint may cite these exact
+        # Everything at or below the RESULTING position is history. The
+        # bound is our CURRENT last_ordered, not the raw catchup target:
+        # ordering can keep advancing while a (possibly stale-quorum)
+        # catchup is in flight, and cleaning/re-staging against the lower
+        # target re-staged batches whose effects were already committed —
+        # the write manager then held phantom applies and crashed at the
+        # next real commit ("commit out of order", partition-heal fuzz).
+        # The pre-prepares themselves stay fetchable as old-view material:
+        # a later NewView below a stable checkpoint may cite these exact
         # batches, and a pool where every retainer pruned them wedges all
-        # re-proposal at the first unfetchable citation (found by the
-        # partition-heal fuzz: catchup-then-VC deleted the PPs everywhere).
+        # re-proposal at the first unfetchable citation.
+        pos = self._data.last_ordered_3pc[1]
         for k, pp in list(self.prePrepares.items()):
-            if k[1] <= last_3pc[1]:
+            if k[1] <= pos:
                 orig = pp.original_view_no \
                     if pp.original_view_no is not None else k[0]
                 self.old_view_preprepares[(orig, k[1])] = pp
         for store in (self.prePrepares, self.sent_preprepares,
                       self.prepares, self.commits):
-            for k in [k for k in store if k[1] <= last_3pc[1]]:
+            for k in [k for k in store if k[1] <= pos]:
                 del store[k]
         self._stashed_ooo_commits = {
             k: v for k, v in self._stashed_ooo_commits.items()
-            if k[1] > last_3pc[1]}
+            if k[1] > pos}
         # In-flight batches ABOVE the caught-up position lost their staged
         # applies when catchup_started reverted the uncommitted stack; the
         # stashed commits about to process would otherwise order them with
@@ -857,6 +863,9 @@ class OrderingService:
                 pp = self.prePrepares[key]
                 if key in self.ordered or key[0] != self._data.view_no:
                     continue
+                if self._ordered_originals.get(
+                        (_orig_view(pp), pp.pp_seq_no)) == pp.digest:
+                    continue    # re-certified content: executed already
                 bid = BatchID(pp.view_no, _orig_view(pp),
                               pp.pp_seq_no, pp.digest)
                 if bid in applied_ids:
@@ -873,6 +882,12 @@ class OrderingService:
                         del self.prePrepares[k]
                     break
         self._data.is_participating = True
+        if self._last_new_view_msg is not None:
+            # a NewView accepted mid-catchup deferred its re-proposal
+            # pass (see process_new_view_checkpoints_applied); run it on
+            # the caught-up state before releasing the stashed traffic
+            self.process_new_view_checkpoints_applied(
+                self._last_new_view_msg)
         self._stasher.process_all_stashed(StashReason.CATCHING_UP)
         self._stasher.process_all_stashed(StashReason.OUTSIDE_WATERMARKS)
         # a catchup can JUMP views (audit adoption): messages stashed as
@@ -926,6 +941,15 @@ class OrderingService:
         """Re-order the prepared batches carried into the new view
         (ref process_new_view_checkpoints_applied :2380)."""
         self._last_new_view_msg = msg
+        if not self._data.is_participating:
+            # a view change can complete WHILE this replica catches up
+            # (internal-bus traffic bypasses the wire stasher). Applying
+            # re-proposals now would stage batches underneath a catchup
+            # that writes the same txns straight to the ledgers — phantom
+            # applies that crash the next real commit (partition-heal
+            # fuzz seed 4175). Defer: caught_up_till_3pc re-enters with
+            # the saved NewView once participation resumes.
+            return
         self._awaiting_reproposal.clear()   # recomputed by this pass
         # Continue the sequence from what actually survives into the new view:
         # ordered prefix, selected checkpoint, re-ordered batches — and EVERY

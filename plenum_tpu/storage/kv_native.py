@@ -140,3 +140,18 @@ class KvNative(KeyValueStorage):
                     pass                 # compaction is an optimization
             _LIB.kvn_close(self._h)
             self._h = None
+
+    def __del__(self):
+        # a dropped store must release its native handle (an open fd + C
+        # buffers) even without an explicit close: a long-lived process
+        # cycling stores — the crash-restart fuzz runs hundreds of node
+        # lifecycles in one interpreter — exhausted the fd table through
+        # GC'd-but-never-closed handles. Skip compaction: __del__ runs at
+        # unpredictable times (interpreter teardown included) and must
+        # only release resources.
+        try:
+            if getattr(self, "_h", None):
+                _LIB.kvn_close(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -159,6 +159,10 @@ def run_scenario(seed: int) -> None:
                         f"seed {seed}: {n} still marked inconsistent"
         finally:
             shutil.rmtree(durable, ignore_errors=True)
+            import gc
+            gc.collect()    # crash_node leaks handles by design (real
+            #                 crashes do); a multi-thousand-seed sweep in
+            #                 one interpreter needs them reaped promptly
     elif scenario == 4:
         # BYZANTINE LIES: one non-primary node's outbound 3PC messages are
         # randomly mutated in flight (type-preserving field corruption —
