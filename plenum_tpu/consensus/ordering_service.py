@@ -646,15 +646,19 @@ class OrderingService:
     # ------------------------------------------------------------------ #
 
     def behind_evidence(self) -> Optional[int]:
-        """Highest pp_seq_no with a full COMMIT quorum of votes strictly
-        ahead of our next orderable position — proof a live pool committed
-        past this replica (it can never order those without recovering the
-        gap). None when no such evidence exists."""
+        """Highest pp_seq_no with COMMITs from a weak quorum (f+1 distinct
+        senders — at least one honest) strictly ahead of our next orderable
+        position: proof a live pool is committing past this replica (it
+        can never order those without recovering the gap). Weak, not full:
+        a node that was down or syncing while the commits flew holds only
+        a partial vote record (partition-heal fuzz seed 3362 sat forever
+        behind a pool whose full-quorum messages it had half-missed).
+        None when no such evidence exists."""
         last = self._data.last_ordered_3pc[1]
         best = None
         for k, votes in self.commits.items():
             if k[1] > last + 1 and \
-                    self._data.quorums.commit.is_reached(len(votes)):
+                    self._data.quorums.weak.is_reached(len(votes)):
                 best = k[1] if best is None else max(best, k[1])
         return best
 
