@@ -34,6 +34,7 @@ class CatchupRepService:
         self._retry_timeout = retry_timeout
         self._verifier = MerkleVerifier()
         self._running = False
+        self.diverged = False    # set when every peer conflicts (see below)
         self._target_size = 0
         self._target_root = ""
         # pending reps: start_seq -> (end_seq, [txns], proof, frm)
@@ -49,6 +50,8 @@ class CatchupRepService:
     def start(self, target_size: int, target_root_hex: str) -> None:
         ledger = self._db.get_ledger(self.ledger_id)
         self._running = True
+        self.diverged = False
+        self._blacklisted_peers.clear()   # fresh round, fresh chances
         self._target_size = target_size
         self._target_root = target_root_hex
         self._reps.clear()
@@ -195,6 +198,29 @@ class CatchupRepService:
             if not ok:
                 ledger.discard_txns(len(txns))
                 self._blacklisted_peers.add(frm)
+                usable = [p for p in self._peers()
+                          if p not in self._blacklisted_peers]
+                if not usable:
+                    # EVERY peer's chunk fails verification against the
+                    # f+1-agreed target: our own committed prefix conflicts
+                    # with the pool's chain. This is divergence beyond
+                    # append-repair — it can only arise outside the fault
+                    # model (e.g. >f simultaneous crash-restarts evaporate
+                    # the in-memory prepared certificates a lone commit
+                    # relied on; found by the partition-heal fuzz). Loud
+                    # and terminal for this catchup round: operators must
+                    # repair (resync from a snapshot / truncate the
+                    # divergent suffix), not watch a silent retry loop.
+                    import logging
+                    logging.getLogger(__name__).error(
+                        "ledger %s: committed prefix (size %d) conflicts "
+                        "with the quorum target (size %d, root %s) — "
+                        "divergence beyond append-repair; catchup aborted",
+                        self.ledger_id, ledger.size, self._target_size,
+                        self._target_root)
+                    self.diverged = True
+                    self._finish()
+                    return
                 self._request_missing()
                 return
             committed, _ = ledger.commit_txns(len(txns))

@@ -314,26 +314,49 @@ class ViewChangeService:
                      if a == self._data.node_name or self._acked(view_no, a, vc)}
         if not self._data.quorums.view_change.is_reached(len(confirmed)):
             return
-        # Iterate votes in the SAME author-sorted order process_new_view will
-        # reconstruct from the published view_changes tuple: the builder's
-        # selection is iteration-order-sensitive, and any divergence makes
-        # validators reject a correct NewView.
-        ordered = sorted(confirmed.items())
-        vcs = [vc for _, vc in ordered]
-        cp = self._builder.calc_checkpoint(vcs)
-        if cp is None:
+        # The primary may cite ANY view-change quorum (PBFT: n-f suffice).
+        # Try the full confirmed set first; if the builder cannot produce a
+        # consistent selection — one diverged member's conflicting batch
+        # citations can poison calc_batches FOREVER, storming view changes
+        # with a healthy quorum present (partition-heal fuzz seed 15906) —
+        # fall back to subsets that exclude possible outliers.
+        need = self._data.quorums.view_change.value
+        authors = sorted(confirmed)
+        candidates: list[list] = [authors]
+        if len(authors) > need:
+            for drop in authors:                       # leave-one-out
+                candidates.append([a for a in authors if a != drop])
+            if len(authors) <= 8:                      # exact quorums
+                import itertools
+                candidates.extend(
+                    list(c) for c in itertools.combinations(authors, need))
+        seen: set = set()
+        for subset in candidates:
+            key = tuple(subset)
+            if len(subset) < need or key in seen:
+                continue
+            seen.add(key)
+            ordered = sorted((a, confirmed[a]) for a in subset)
+            # Iterate votes in the SAME author-sorted order process_new_view
+            # will reconstruct from the published view_changes tuple: the
+            # builder's selection is iteration-order-sensitive, and any
+            # divergence makes validators reject a correct NewView.
+            vcs = [vc for _, vc in ordered]
+            cp = self._builder.calc_checkpoint(vcs)
+            if cp is None:
+                continue
+            batches = self._builder.calc_batches(cp, vcs)
+            if batches is None:
+                continue
+            nv = NewView(view_no=view_no,
+                         view_changes=tuple(
+                             (a, view_change_digest(vc)) for a, vc in ordered),
+                         checkpoint=cp,
+                         batches=tuple(b.to_list() for b in batches))
+            self._new_view = nv
+            self._network.send(nv)
+            self._finish(nv)
             return
-        batches = self._builder.calc_batches(cp, vcs)
-        if batches is None:
-            return
-        nv = NewView(view_no=view_no,
-                     view_changes=tuple(
-                         (a, view_change_digest(vc)) for a, vc in ordered),
-                     checkpoint=cp,
-                     batches=tuple(b.to_list() for b in batches))
-        self._new_view = nv
-        self._network.send(nv)
-        self._finish(nv)
 
     # --- everyone: accepting NEW_VIEW -------------------------------------
 
