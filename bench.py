@@ -195,6 +195,13 @@ def main():
         result["tcp7_p50_ms"] = tcp7.get("p50_latency_ms")
     elif tcp7 and tcp7.get("txns_ordered"):
         result["tcp7_partial"] = tcp7["txns_ordered"]
+    if tcp7:
+        # digest-gossip acceptance: measured bytes-on-wire per ordered txn
+        # + the propagate backlog, from the node's per-type byte counters
+        for k in ("tx_bytes_per_txn", "propagate_tx_bytes_per_txn",
+                  "propagate_inbox_depth_max", "dropped_frames"):
+            if tcp7.get(k) is not None:
+                result[f"tcp7_{k}"] = tcp7[k]
     if jax_ok:
         result.update({
             "jax_tps": jax_stats["tps"],    # real-device in-process pool
@@ -233,6 +240,9 @@ def main():
             if k in c4:
                 result[f"config4_{k}"] = c4[k]
         result["config5_sim25_tps"] = c5.get("tps", c5.get("error"))
+        if c5.get("propagate_bytes_per_txn") is not None:
+            result["config5_propagate_bytes_per_txn"] = \
+                c5["propagate_bytes_per_txn"]
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))

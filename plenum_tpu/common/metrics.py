@@ -62,6 +62,13 @@ class MetricsName:
     # transport
     NODE_MSGS_IN = "transport.node_msgs_in"
     NODE_FRAMES_OUT = "transport.node_frames_out"
+    # silent-loss accounting + byte totals, sampled from TcpStack.stats as
+    # cumulative gauges (read back via max, like gc_pause_time); per-type
+    # rows flush under dynamic names "transport.tx.<OP>" / "transport.rx.<OP>"
+    TRANSPORT_DROPPED_FRAMES = "transport.dropped_frames"
+    TRANSPORT_DROPPED_SESSIONS = "transport.dropped_sessions"
+    TRANSPORT_TX_BYTES = "transport.tx_bytes"
+    TRANSPORT_RX_BYTES = "transport.rx_bytes"
     # process memory / GC (ref common/gc_trackers.py + node.py:180,2283 —
     # long-soak leaks must be visible in the flushed metrics history)
     PROCESS_RSS_BYTES = "process.rss_bytes"

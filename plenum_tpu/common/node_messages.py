@@ -144,9 +144,38 @@ class Ordered(MessageBase):
 
 @wire_message
 class Propagate(MessageBase):
+    """Request-dissemination vote. Two shapes share the op (wire compat):
+    full-body (`request` set — the legacy form, still what the digest-
+    designated disseminator and MessageRep fetch replies carry) and
+    digest-only (`digest` set, no body — every other node's vote under
+    digest-gossip; the digest is the sha256 request digest, so a vote is
+    ~100 B instead of a full re-serialized request body)."""
     typename = "PROPAGATE"
-    request: dict                     # full client request dict
+    request: Optional[dict] = None    # full client request dict (body form)
     sender_client: Optional[str] = None
+    digest: str = ""                  # request digest (digest-only form)
+
+    def validate(self) -> None:
+        self._require(self.request is not None or self.digest != "",
+                      "needs a request body or a digest")
+
+
+@wire_message
+class PropagateBatch(MessageBase):
+    """One prod tick's propagate traffic coalesced into a single envelope:
+    digest-only votes ride as compact (digest, sender_client) pairs,
+    full bodies as nested Propagate dicts — so the n^2 propagate *message
+    count* (framing, from_dict, inbox handling) amortizes across every
+    request in flight in the same tick."""
+    typename = "PROPAGATE_BATCH"
+    votes: tuple[tuple[str, Optional[str]], ...] = ()
+    bodies: tuple[dict, ...] = ()
+
+    def validate(self) -> None:
+        self._require(bool(self.votes) or bool(self.bodies),
+                      "empty propagate batch")
+        for d, _client in self.votes:
+            self._require(bool(d), "vote with empty digest")
 
 
 @wire_message

@@ -104,6 +104,24 @@ class Config:
 
     # --- propagation ---
     PROPAGATE_REQUEST_DELAY: float = 0.0
+    # digest-gossip: at most ONE node (digest-designated) broadcasts the
+    # full request body; every other propagate is a ~100-byte digest vote,
+    # with on-demand body fetch through MessageReq. False restores the
+    # reference's full-body flooding (n*(n-1) body sends per txn) — kept
+    # as a measurement/compat switch.
+    DIGEST_GOSSIP: bool = True
+    # grace before fetching a body we only hold digest votes for (the
+    # client's own broadcast or the disseminator's body usually outruns
+    # it), and the per-candidate retry cadence of the fetch loop
+    PROPAGATE_BODY_FETCH_DELAY: float = 0.5
+    PROPAGATE_BODY_FETCH_RETRY: float = 1.0
+    # states holding only digest VOTES (no verified body) are swept on a
+    # much shorter leash than the general unfinalized TTL: they cost a
+    # transport-authenticated peer nothing to mint (~100 B, no client
+    # signature behind them), so an hours-scale retention would hand one
+    # faulty validator a memory-exhaustion lever. Long enough for any
+    # honest fetch cycle (grace delay + a full voter rotation) to resolve.
+    PROPAGATE_BODYLESS_REQ_TIMEOUT: float = 60.0
     # requests that never reach the propagate quorum are freed after this
     # (ref config.py PROPAGATES_PHASE_REQ_TIMEOUT)
     PROPAGATES_PHASE_REQ_TIMEOUT: float = 3600.0

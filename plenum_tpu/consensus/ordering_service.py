@@ -232,8 +232,23 @@ class OrderingService:
                         >= self._config.Max3PCBatchesInFlight):
                     break
                 digests = []
+                bodyless = []
                 while queue and len(digests) < self._config.Max3PCBatchSize:
-                    digests.append(queue.popitem(last=False)[0])
+                    digest = queue.popitem(last=False)[0]
+                    # finalize-without-body guard (digest-gossip): a batch
+                    # must never cite a request whose body this primary
+                    # does not hold — re-queue it and pull the body
+                    if self._get_request(digest) is None:
+                        bodyless.append(digest)
+                    else:
+                        digests.append(digest)
+                for digest in bodyless:
+                    queue[digest] = None
+                if bodyless:
+                    self._bus.send(RequestPropagates(
+                        bad_requests=tuple(bodyless)))
+                if not digests and not force_empty:
+                    break        # everything queued is awaiting its body
                 self._send_one_batch(lid, digests)
                 sent += 1
                 if force_empty:

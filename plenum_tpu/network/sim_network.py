@@ -82,6 +82,16 @@ class SimNetwork:
         self.max_latency = 0.5
         self.sent_count = 0
         self.delivered_count = 0
+        # per-message-type [count, bytes] over every scheduled delivery —
+        # the sim twin of TcpStack.stats["tx_msgs"], so wire-cost claims
+        # (digest-gossip) are measurable on the deterministic fabric too
+        self.tx_msgs: dict[str, list] = {}
+
+    def bytes_summary(self) -> dict:
+        total = sum(c[1] for c in self.tx_msgs.values())
+        return {"total_bytes": total,
+                "by_type": {op: {"count": c[0], "bytes": c[1]}
+                            for op, c in sorted(self.tx_msgs.items())}}
 
     # --- peers -----------------------------------------------------------
 
@@ -171,7 +181,11 @@ class SimNetwork:
         if self._wire_roundtrip and isinstance(msg, MessageBase):
             # Serialize now (sender's view), deserialize at delivery — exactly
             # what a real wire does, so schema violations fail loudly in sims.
-            data = pack(msg.to_dict())
+            d = msg.to_dict()
+            data = pack(d)
+            row = self.tx_msgs.setdefault(d.get("op", "?"), [0, 0])
+            row[0] += 1
+            row[1] += len(data)
             deliver = lambda: self._deliver_wire(data, frm, dst)
         else:
             deliver = lambda: self._deliver(msg, frm, dst)

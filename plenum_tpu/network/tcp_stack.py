@@ -39,14 +39,18 @@ import time
 from collections import deque
 from typing import Any, Callable, Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey, Ed25519PublicKey)
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey, Ed25519PublicKey)
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    _HAVE_CRYPTOGRAPHY = True
+except Exception:  # pragma: no cover — gated again in TcpStack.__init__
+    _HAVE_CRYPTOGRAPHY = False
 
 from plenum_tpu.common.event_bus import ExternalBus
 from plenum_tpu.common.message_base import MessageBase, message_from_dict
@@ -156,6 +160,12 @@ class TcpStack:
     def __init__(self, name: str, host: str, port: int,
                  registry: NodeRegistry, seed: bytes,
                  max_inbound_per_drain: int = 1000):
+        if not _HAVE_CRYPTOGRAPHY:
+            # the handshake needs X25519 + ChaCha20-Poly1305; unlike the
+            # request-signing seam there is no pure-Python fallback here
+            raise ImportError(
+                "the `cryptography` package is required for the TCP node "
+                "stack (sim fabric and client stack run without it)")
         self.name = name
         self.host, self.port = host, port
         self.registry = registry
@@ -172,7 +182,22 @@ class TcpStack:
         self._flush_scheduled = False
         self._quota = max_inbound_per_drain
         self._stopped = False
-        self.stats = {"sent_frames": 0, "recv_frames": 0, "rejected": 0}
+        # dropped_frames/dropped_sessions: silent-loss accounting — outbox
+        # trimming and HWM disconnects previously discarded traffic with no
+        # trace (surfaced via tools.metrics_report through the node's
+        # metrics store). tx/rx maps: per-message-type [count, bytes] so
+        # wire-cost claims (digest-gossip) are measured, not asserted.
+        self.stats = {"sent_frames": 0, "recv_frames": 0, "rejected": 0,
+                      "dropped_frames": 0, "dropped_sessions": 0,
+                      "tx_msgs": {}, "rx_msgs": {}}
+
+    @staticmethod
+    def _count_msg(table: dict, op: str, nbytes: int, n: int = 1) -> None:
+        row = table.get(op)
+        if row is None:
+            row = table[op] = [0, 0]
+        row[0] += n
+        row[1] += nbytes * n
 
     # --- lifecycle -------------------------------------------------------
 
@@ -222,17 +247,31 @@ class TcpStack:
     # --- outgoing --------------------------------------------------------
 
     def _enqueue_send(self, msg: Any, dst) -> None:
+        # pack ONCE per message, even for a broadcast — the per-peer loop
+        # below only appends the shared bytes (guarded by the wire-fuzz
+        # pack-once test; a per-peer pack() here is the n^2 serde tax the
+        # reference pays in its per-remote serialization)
         if isinstance(msg, MessageBase):
-            data = pack(msg.to_dict())
+            d = msg.to_dict()
+            data = pack(d)
+            op = d.get("op", type(msg).__name__)
         else:
             data = pack(msg)
+            op = msg.get("op", "?") if isinstance(msg, dict) else "?"
         targets = dst if dst is not None else [
             p for p in self.registry.names() if p != self.name]
+        self._count_msg(self.stats["tx_msgs"], op, len(data), len(targets))
         for peer in targets:
             box = self._outboxes.setdefault(peer, [])
             box.append(data)
             if len(box) > OUTBOX_CAP:          # quota: drop oldest
-                del box[:len(box) - OUTBOX_CAP]
+                trimmed = len(box) - OUTBOX_CAP
+                del box[:trimmed]
+                self.stats["dropped_frames"] += trimmed
+                logger.warning(
+                    "outbox to %s over cap: dropped %d oldest queued "
+                    "messages (%d total dropped)", peer, trimmed,
+                    self.stats["dropped_frames"])
         self._schedule_flush()
 
     def _schedule_flush(self) -> None:
@@ -253,6 +292,7 @@ class TcpStack:
             if sess is None or not box:
                 continue                       # keep queued until connected
             frame_payload = pack(box)
+            n_msgs = len(box)
             box.clear()
             try:
                 # backpressure: a peer that stopped reading is dead to us —
@@ -264,6 +304,13 @@ class TcpStack:
                 sess.writer.write(sess.encrypt_frame(frame_payload))
                 self.stats["sent_frames"] += 1
             except Exception:
+                # the cleared box's messages die with the session — count
+                # them; silent loss here cost a debugging session once
+                self.stats["dropped_sessions"] += 1
+                self.stats["dropped_frames"] += n_msgs
+                logger.warning(
+                    "dropping session to %s (write failed or over HWM); "
+                    "%d queued messages lost", peer, n_msgs)
                 self._drop_session(peer)
 
     # --- incoming --------------------------------------------------------
@@ -415,10 +462,16 @@ class TcpStack:
                 # broadcasts, then batched per peer at flush)
                 for raw in unpack(payload):
                     try:
-                        self._inbound.append(
-                            (message_from_dict(unpack(raw)), peer))
+                        d = unpack(raw)
+                        msg = message_from_dict(d)
                     except Exception:
                         logger.warning("undecodable message from %s", peer)
+                        continue
+                    self._count_msg(
+                        self.stats["rx_msgs"],
+                        d.get("op", "?") if isinstance(d, dict) else "?",
+                        len(raw))
+                    self._inbound.append((msg, peer))
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
                 asyncio.CancelledError, Exception):
             pass
@@ -502,10 +555,29 @@ class ClientStack:
         return n
 
     def send(self, msg: Any, client_id: str) -> None:
+        if self._conns.get(client_id) is None:
+            return                             # client gone; reply dropped
+        self._send_packed(
+            pack(msg.to_dict() if isinstance(msg, MessageBase) else msg),
+            client_id)
+
+    def send_many(self, msg: Any, client_ids) -> None:
+        """Broadcast to several clients packing the message ONCE (mirror of
+        the node stack's pack-once broadcast): the observer push previously
+        re-serialized the same BatchCommitted per registered observer."""
+        data = None
+        for cid in client_ids:
+            if self._conns.get(cid) is None:
+                continue
+            if data is None:
+                data = pack(msg.to_dict()
+                            if isinstance(msg, MessageBase) else msg)
+            self._send_packed(data, cid)
+
+    def _send_packed(self, data: bytes, client_id: str) -> None:
         writer = self._conns.get(client_id)
         if writer is None:
-            return                             # client gone; reply dropped
-        data = pack(msg.to_dict() if isinstance(msg, MessageBase) else msg)
+            return
         try:
             if writer.transport.get_write_buffer_size() > WRITE_HWM:
                 raise ConnectionError("client write buffer over HWM")

@@ -44,7 +44,11 @@ class MessageReqProcessor:
     # ------------------------------------------------------------------ #
 
     def request(self, msg_type: str, params: dict, dst=None) -> None:
-        key = (msg_type, tuple(sorted(params.items())))
+        # dst is part of the throttle key: the body-fetch loop cycles
+        # through CANDIDATE responders, and asking the next peer must not
+        # be suppressed because the previous one was just asked
+        key = (msg_type, tuple(sorted(params.items())),
+               tuple(dst) if dst is not None else None)
         now = self._node.timer.get_current_time()
         if now - self._recent.get(key, float("-inf")) < self.THROTTLE:
             return
@@ -84,7 +88,9 @@ class MessageReqProcessor:
 
     def _serve_propagate(self, params: dict) -> Optional[Propagate]:
         state = self._node.propagator.requests.get(str(params["digest"]))
-        if state is None:
+        if state is None or state.request is None:
+            # digest-gossip: we may hold only digest VOTES for this request
+            # — never answer a body fetch with a bodyless state
             return None
         return Propagate(request=state.request.to_dict(),
                          sender_client=state.client_name)

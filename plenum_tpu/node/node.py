@@ -36,7 +36,8 @@ from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
                                              LedgerStatus, NewView,
                                              Ordered, POOL_LEDGER_ID,
                                              Prepare, PrePrepare,
-                                             Propagate, Reject, Reply,
+                                             Propagate, PropagateBatch,
+                                             Reject, Reply,
                                              RequestAck, RequestNack,
                                              ViewChange)
 from plenum_tpu.common.serialization import pack, unpack
@@ -179,7 +180,13 @@ class Node:
             name, self.quorums,
             send_to_nodes=lambda msg: self.node_bus.send(msg),
             forward_to_replicas=self._forward_to_replicas,
-            now=timer.get_current_time)
+            now=timer.get_current_time,
+            validators=lambda: self.validators,
+            request_body=self._request_body,
+            digest_gossip=self.config.DIGEST_GOSSIP)
+        # digest -> targeted body-fetch tries so far (digest-gossip: a
+        # quorum can complete before any body-carrying propagate arrives)
+        self._body_fetches: dict[str, int] = {}
 
         # RBFT: f+1 protocol instances by default (ref replicas.py:19),
         # recomputed as pool membership changes f; an explicit
@@ -217,10 +224,14 @@ class Node:
         self._client_inbox: list[tuple[dict, str]] = []
         self._propagate_inbox: list[tuple[Propagate, str]] = []
         self._ordered_queue: list[Ordered] = []
-        # digest -> senders whose propagate we already counted; the whole
-        # entry is freed when the request executes (durable dedup then lives
-        # in the seq-no DB keyed by payload digest)
-        self._seen_propagates: dict[str, set[str]] = {}
+        # digest -> {sender: body_seen}: which propagates we already counted
+        # per sender, and whether that sender has delivered a BODY yet (a
+        # digest-only vote may legitimately be followed by the same peer's
+        # body-carrying MessageRep fetch reply — that upgrade must not be
+        # dropped as a duplicate). The whole entry is freed when the request
+        # executes (durable dedup then lives in the seq-no DB keyed by
+        # payload digest).
+        self._seen_propagates: dict[str, dict[str, bool]] = {}
         # digest -> entries parked while that digest's signature dispatch
         # is in flight (client or propagate path): each node verifies a
         # given request's signature at most once per arrival wave. Entries
@@ -248,6 +259,7 @@ class Node:
         self.node_bus.subscribe(CatchupRep, self.leecher.process_catchup_rep)
 
         self.node_bus.subscribe(Propagate, self._receive_propagate)
+        self.node_bus.subscribe(PropagateBatch, self._receive_propagate_batch)
         # "ask peers for a missing message" (ref message_req_processor.py:13)
         self.message_req = MessageReqProcessor(self)
         # observers are remote followers addressed like clients
@@ -509,15 +521,21 @@ class Node:
     def _clean_outdated_reqs(self) -> None:
         now = self.timer.get_current_time()
         ttl = self.config.PROPAGATES_PHASE_REQ_TIMEOUT
+        bodyless_ttl = self.config.PROPAGATE_BODYLESS_REQ_TIMEOUT
         retention = self.config.EXECUTED_REQ_RETENTION
         for digest, state in list(self.propagator.requests.items()):
             expired = (
                 (state.executed and state.executed_at is not None
                  and now - state.executed_at > retention)
-                or (not state.finalised and now - state.added_at > ttl))
+                or (not state.finalised and now - state.added_at > ttl)
+                # digest votes with no verified body behind them are the
+                # one state a peer can mint for free: short leash
+                or (state.request is None
+                    and now - state.added_at > bodyless_ttl))
             if expired:
                 self.propagator.requests.free(digest)
                 self._seen_propagates.pop(digest, None)
+                self._body_fetches.pop(digest, None)
         # _seen_propagates entries whose request never made it into the
         # propagator (failed signature, late propagate of an executed txn)
         # have no RequestState carrying a timestamp — they are orphans the
@@ -664,11 +682,67 @@ class Node:
             ts.clear()                  # episode complete
 
     def _on_request_propagates(self, msg: RequestPropagates) -> None:
-        """Ordering stashed a pre-prepare on MISSING_REQUESTS: fetch the
-        requests from peers (previously this event had no subscriber and a
-        dropped PROPAGATE could wedge a replica until catchup)."""
+        """Ordering stashed a pre-prepare (or the primary skipped batching)
+        on MISSING_REQUESTS: pull the request bodies from peers. Digests
+        with known voters go through the targeted fetch loop; digests
+        nobody has vouched for yet fall back to a broadcast MessageReq."""
         for digest in msg.bad_requests:
-            self.message_req.request("PROPAGATE", {"digest": digest})
+            if self.propagator.requests.has_body(digest):
+                continue
+            state = self.propagator.requests.get(digest)
+            if state is not None and any(s != self.name
+                                         for s in state.propagates):
+                self._request_body(digest, urgent=True)
+            else:
+                self.message_req.request("PROPAGATE", {"digest": digest})
+
+    # --- targeted request-body fetch (digest-gossip) --------------------
+
+    def _request_body(self, digest: str, urgent: bool) -> None:
+        """Arm the per-digest body-fetch loop. Non-urgent arms it on a
+        grace delay (the client's own broadcast or the disseminator's body
+        usually outruns it); urgent (quorum reached / ordering blocked)
+        fires NOW — escalating an already-armed-but-still-delayed loop by
+        bumping its generation, so exactly one retry chain stays live."""
+        fetch = self._body_fetches.get(digest)
+        if fetch is not None:
+            if urgent and fetch["tries"] == 0:
+                fetch["gen"] += 1           # orphan the delayed first tick
+                self.timer.schedule(
+                    0.0, lambda: self._body_fetch_tick(digest, fetch["gen"]))
+            return
+        fetch = self._body_fetches[digest] = {"tries": 0, "gen": 0}
+        delay = 0.0 if urgent else self.config.PROPAGATE_BODY_FETCH_DELAY
+        self.timer.schedule(delay,
+                            lambda: self._body_fetch_tick(digest, 0))
+
+    def _body_fetch_tick(self, digest: str, gen: int) -> None:
+        """One fetch attempt: ask the NEXT propagate voter for the body,
+        re-arming until the body lands (bad/garbage replies simply leave
+        the body absent, so the retry covers both timeout and lies)."""
+        fetch = self._body_fetches.get(digest)
+        if fetch is None or fetch["gen"] != gen:
+            return                          # stood down or escalated past us
+        state = self.propagator.requests.get(digest)
+        if state is None or state.request is not None:
+            del self._body_fetches[digest]
+            if state is not None:
+                state.fetch_started = False
+            return
+        senders = sorted(s for s in state.propagates if s != self.name)
+        if fetch["tries"] >= 2 * max(len(senders), 1) + 2:
+            # every voter tried twice and nobody produced a body that
+            # verifies: give up; a fresh vote re-arms the loop, and the
+            # bodyless-state TTL sweeps the orphan
+            del self._body_fetches[digest]
+            state.fetch_started = False
+            self.spylog.append(("body_fetch_exhausted", digest))
+            return
+        dst = [senders[fetch["tries"] % len(senders)]] if senders else None
+        fetch["tries"] += 1
+        self.message_req.request("PROPAGATE", {"digest": digest}, dst=dst)
+        self.timer.schedule(self.config.PROPAGATE_BODY_FETCH_RETRY,
+                            lambda: self._body_fetch_tick(digest, gen))
 
     def _on_master_new_view(self, msg: NewViewAccepted) -> None:
         """The master completed a view change: every backup instance follows
@@ -971,6 +1045,19 @@ class Node:
     def _receive_propagate(self, msg: Propagate, frm: str) -> None:
         self._propagate_inbox.append((msg, frm))
 
+    def _receive_propagate_batch(self, msg: PropagateBatch, frm: str) -> None:
+        """Unpack a coalesced propagate envelope into the ordinary inbox:
+        each entry pays the normal quota/dedup/auth pipeline."""
+        for digest, sender_client in msg.votes:
+            self._propagate_inbox.append(
+                (Propagate(digest=digest, sender_client=sender_client), frm))
+        for body in msg.bodies:
+            try:
+                inner = Propagate.from_dict(dict(body))
+            except Exception:
+                continue                   # one bad entry must not void the rest
+            self._propagate_inbox.append((inner, frm))
+
     # --- the prod loop ----------------------------------------------------
 
     def prod(self) -> int:
@@ -986,6 +1073,9 @@ class Node:
         count += n
         self.replicas.service_all()
         count += self._service_ordered()
+        # one PropagateBatch per tick instead of one wire message per vote:
+        # the n^2 propagate message COUNT amortizes across the whole tick
+        self.propagator.flush_outbox()
         return count
 
     # --- client pipeline --------------------------------------------------
@@ -1172,16 +1262,39 @@ class Node:
         verified: list[tuple[Propagate, str, Request]] = []
         to_auth: list[tuple[Propagate, str, Request]] = []
         for msg, frm in batch:
+            if msg.request is None:
+                # digest-only vote: nothing to authenticate (the sender is
+                # transport-authenticated; the CONTENT is vouched for only
+                # once a verified body lands) — count it directly
+                digest = msg.digest
+                if not digest:
+                    continue
+                seen = self._seen_propagates.setdefault(digest, {})
+                if frm in seen:
+                    continue
+                seen[frm] = False
+                state = self.propagator.requests.get(digest)
+                if state is not None and state.executed:
+                    continue     # late vote for an already-executed request
+                self.propagator.process_digest_vote(digest, frm,
+                                                    msg.sender_client)
+                continue
             try:
                 request = Request.from_dict(msg.request)
             except Exception:
                 continue
-            seen = self._seen_propagates.setdefault(request.digest, set())
-            if frm in seen:
+            if msg.digest and msg.digest != request.digest:
+                # body does not hash to the claimed digest: a lying
+                # fetch responder or relay — drop, the fetch loop retries
+                self.spylog.append(("suspicious_propagate", frm))
                 continue
-            seen.add(frm)
-            if request.digest in self.propagator.requests:
-                # signature was already verified when first seen
+            seen = self._seen_propagates.setdefault(request.digest, {})
+            if seen.get(frm):
+                continue         # this sender already delivered a body
+            seen[frm] = True
+            state = self.propagator.requests.get(request.digest)
+            if state is not None and state.request is not None:
+                # signature was already verified when the body first landed
                 verified.append((msg, frm, request))
             elif request.digest in self._authing:
                 # same digest = same signed bytes (digest covers the

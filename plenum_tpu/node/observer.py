@@ -36,11 +36,15 @@ class Observable:
     MAX_OBSERVERS = 16
 
     def __init__(self, send: Callable[[Any, str], None],
-                 close: Optional[Callable[[str], None]] = None):
+                 close: Optional[Callable[[str], None]] = None,
+                 send_many: Optional[Callable[[Any, list], None]] = None):
         self._send = send
         self._close = close          # drops the evicted CONNECTION so the
         # observer's redial+re-register loop fires; without it an evicted
         # follower would sit on a silent socket forever
+        # pack-once broadcast seam (ClientStack.send_many); falls back to
+        # per-observer send when the transport offers none
+        self._send_many = send_many
         self._observers: dict[str, str] = {}      # observer id -> policy
 
     def add_observer(self, observer_id: str,
@@ -66,6 +70,9 @@ class Observable:
         return list(self._observers)
 
     def append_input(self, batch: BatchCommitted) -> None:
+        if self._send_many is not None:
+            self._send_many(batch, list(self._observers))
+            return
         for observer_id in self._observers:
             self._send(batch, observer_id)
 

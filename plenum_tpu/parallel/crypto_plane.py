@@ -30,9 +30,25 @@ from plenum_tpu.ops import ed25519 as ed_ops
 from plenum_tpu.ops import sha256 as sha_ops
 
 try:  # moved to jax.shard_map in newer releases
-    _shard_map = jax.shard_map
+    _shard_map_impl = jax.shard_map
 except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def _shard_map(*args, **kwargs):
+    """shard_map across jax versions: the replication checker's flag was
+    renamed check_rep -> check_vma; translate (then drop) rather than pin
+    jax."""
+    try:
+        return _shard_map_impl(*args, **kwargs)
+    except TypeError:
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+            try:
+                return _shard_map_impl(*args, **kwargs)
+            except TypeError:
+                kwargs.pop("check_rep", None)
+        return _shard_map_impl(*args, **kwargs)
 
 
 def _reduce_roots(roots: jax.Array) -> jax.Array:

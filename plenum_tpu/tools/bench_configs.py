@@ -142,7 +142,7 @@ def config3_bls_proof_reads(n_reads: int = 2000,
 
     try:
         (names, nodes, timer, trustee,
-         replies, ReplyCls, DOMAIN, plane) = lp.build_pool(4, "cpu")
+         replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(4, "cpu")
         # commit a handful of NYMs so the BLS store holds multi-sigs
         users = []
         reqs = []
@@ -319,7 +319,7 @@ def config5_sim25(n_txns: int = 60, timeout: float = 180.0) -> dict:
 
     try:
         (names, nodes, timer, trustee,
-         replies, ReplyCls, DOMAIN, plane) = lp.build_pool(25, "cpu")
+         replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(25, "cpu")
         from plenum_tpu.common.request import Request
         from plenum_tpu.crypto.ed25519 import Ed25519Signer
         from plenum_tpu.execution.txn import NYM
@@ -333,8 +333,15 @@ def config5_sim25(n_txns: int = 60, timeout: float = 180.0) -> dict:
             reqs.append(req)
         done, dt = _drive_inprocess(names, nodes, timer, replies, ReplyCls,
                                     plane, reqs, timeout)
+        wire = net.bytes_summary()
+        prop = sum(c["bytes"] for op, c in wire["by_type"].items()
+                   if op in ("PROPAGATE", "PROPAGATE_BATCH"))
         return {"nodes": 25, "txns_ordered": done, "txns_requested": n_txns,
-                "tps": round(done / dt, 1) if dt else 0.0}
+                "tps": round(done / dt, 1) if dt else 0.0,
+                "wire_bytes_per_txn": round(wire["total_bytes"] / done)
+                if done else None,
+                "propagate_bytes_per_txn": round(prop / done)
+                if done else None}
     except Exception as e:                       # pragma: no cover
         return {"error": f"{type(e).__name__}: {e}"}
 
@@ -361,7 +368,7 @@ def config1b_distinct_signers(n_txns: int = 200,
 
     try:
         (names, nodes, timer, trustee,
-         replies, ReplyCls, DOMAIN, plane) = lp.build_pool(4, "cpu")
+         replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(4, "cpu")
         users = [Ed25519Signer(seed=(b"ds%08d" % i).ljust(32, b"\0")[:32])
                  for i in range(n_txns)]
         nyms = []

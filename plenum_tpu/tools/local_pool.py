@@ -97,7 +97,8 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1):
                 (time.perf_counter(), msg, client)),
             config=config)
     net.connect_all()
-    return names, nodes, timer, trustee, replies, Reply, DOMAIN_LEDGER_ID, plane
+    return (names, nodes, timer, trustee, replies, Reply, DOMAIN_LEDGER_ID,
+            plane, net)
 
 
 def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
@@ -107,7 +108,7 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     from plenum_tpu.execution.txn import NYM
 
     (names, nodes, timer, trustee,
-     replies, Reply, DOMAIN_LEDGER_ID, plane) = build_pool(n_nodes, backend)
+     replies, Reply, DOMAIN_LEDGER_ID, plane, net) = build_pool(n_nodes, backend)
 
     # pre-sign the whole workload so client-side signing isn't measured
     requests = []

@@ -101,6 +101,29 @@ def derive_summary(folds: dict[str, dict], span_s: float,
         "gc_pause_pct": round(100 * gc_pause / span_s, 2) if span_s else None,
         "rss_mb_last": (last("process.rss_bytes") or 0) / 1e6 or None,
     }
+
+    def cum(name):          # cumulative gauge: latest value = max
+        return folds.get(name, {}).get("max")
+
+    # transport silent-loss + byte totals (cumulative TcpStack gauges);
+    # dropped counters are reported even at 0 ONCE the stack emits them —
+    # "no drops recorded" and "drops metric absent" must read differently
+    if "transport.dropped_frames" in folds:
+        out["transport_dropped_frames"] = int(cum("transport.dropped_frames"))
+        out["transport_dropped_sessions"] = int(
+            cum("transport.dropped_sessions") or 0)
+    for direction in ("tx", "rx"):
+        total = cum(f"transport.{direction}_bytes")
+        if total is not None:
+            out[f"transport_{direction}_bytes"] = int(total)
+            if txns:
+                out[f"transport_{direction}_bytes_per_txn"] = round(
+                    total / txns)
+    propagate_tx = cum("transport.tx.PROPAGATE")
+    batch_tx = cum("transport.tx.PROPAGATE_BATCH")
+    if (propagate_tx is not None or batch_tx is not None) and txns:
+        out["propagate_tx_bytes_per_txn"] = round(
+            ((propagate_tx or 0) + (batch_tx or 0)) / txns)
     return {k: v for k, v in out.items() if v is not None}
 
 

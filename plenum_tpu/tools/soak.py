@@ -27,7 +27,7 @@ def run_soak(seconds: float = 600.0, wave: int = 200,
     from plenum_tpu.tools.local_pool import build_pool
 
     (names, nodes, timer, trustee,
-     replies, Reply, DOMAIN_LEDGER_ID, plane) = build_pool(n_nodes, "cpu")
+     replies, Reply, DOMAIN_LEDGER_ID, plane, net) = build_pool(n_nodes, "cpu")
 
     def sample() -> dict:
         c = MetricsCollector()

@@ -146,6 +146,35 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
         lambda msg, frm: node.handle_client_message(msg, frm)
     # observer eviction must close the connection so the follower redials
     node.observable._close = client_stack._drop_client
+    # observer pushes pack the batch once, not once per registered observer
+    node.observable._send_many = client_stack.send_many
+
+    # transport stats -> metrics history: dropped frames/sessions (silent
+    # loss) and per-type tx/rx byte counters, flushed as cumulative gauges
+    # that tools.metrics_report reads back (max = total)
+    from plenum_tpu.common.metrics import MetricsName
+    from plenum_tpu.common.timer import RepeatingTimer
+
+    def sample_transport_stats():
+        s = node_stack.stats
+        metrics.add_event(MetricsName.TRANSPORT_DROPPED_FRAMES,
+                          s["dropped_frames"])
+        metrics.add_event(MetricsName.TRANSPORT_DROPPED_SESSIONS,
+                          s["dropped_sessions"])
+        for direction, table in (("tx", s["tx_msgs"]), ("rx", s["rx_msgs"])):
+            total = 0
+            for op, (count, nbytes) in table.items():
+                total += nbytes
+                metrics.add_event(f"transport.{direction}.{op}", nbytes)
+                metrics.add_event(f"transport.{direction}_count.{op}", count)
+            metrics.add_event(MetricsName.TRANSPORT_TX_BYTES if
+                              direction == "tx" else
+                              MetricsName.TRANSPORT_RX_BYTES, total)
+
+    node._transport_stats_timer = RepeatingTimer(
+        timer, config.METRICS_FLUSH_INTERVAL, sample_transport_stats)
+    # the SIGTERM tail-flush must carry the FINAL totals too
+    node._sample_transport_stats = sample_transport_stats
 
     if record:
         # the reference's STACK_COMPANION=1 mode: record every ingress +
@@ -232,6 +261,7 @@ def main(argv=None):
         try:
             # capture the tail of the run: gauges + accumulators since the
             # last periodic flush would otherwise die with the process
+            node._sample_transport_stats()
             node._flush_metrics()
         except Exception:
             pass

@@ -198,7 +198,7 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                     socket_path=env["PLENUM_CRYPTO_SOCKET"]).stats()
             except Exception:
                 pass
-        return {
+        result = {
             **({"crypto_service": service_stats} if service_stats else {}),
             "transport": "tcp", "nodes": n_nodes, "backend": backend,
             "txns_ordered": len(done), "txns_requested": n_txns,
@@ -209,9 +209,41 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
             "p99_latency_ms": round(
                 lat[int(len(lat) * 0.99)] * 1000, 1) if lat else None,
         }
+        # bytes-on-wire + loss accounting from a node's flushed metrics
+        # history (SIGTERM first so the tail flush carries final totals)
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        try:
+            from plenum_tpu.tools.metrics_report import (derive_summary,
+                                                         fold_rows,
+                                                         read_store)
+            folds = fold_rows(read_store(os.path.join(tmp, names[0],
+                                                      "metrics")))
+            # one derivation (cum-as-max, propagate op set) lives in
+            # metrics_report; this just renames the keys the bench wants
+            summary = derive_summary(folds, 0.0)
+            for src, dst in (
+                    ("transport_tx_bytes_per_txn", "tx_bytes_per_txn"),
+                    ("propagate_tx_bytes_per_txn",
+                     "propagate_tx_bytes_per_txn"),
+                    ("transport_dropped_frames", "dropped_frames"),
+                    ("propagate_inbox_depth_max",
+                     "propagate_inbox_depth_max")):
+                if summary.get(src) is not None:
+                    result[dst] = summary[src]
+        except Exception:
+            pass                     # byte accounting is best-effort extra
+        return result
     finally:
         for p in procs:
-            p.send_signal(signal.SIGTERM)
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
         for p in procs:
             try:
                 p.wait(timeout=5)

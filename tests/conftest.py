@@ -19,7 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no such option; the XLA_FLAGS
+    # host-platform device count set above provides the 8 devices
+    pass
 # NOTE: x64 deliberately NOT enabled — the kernels are int32 (radix-13
 # limbs) and production runs with default dtypes; tests must match.
 

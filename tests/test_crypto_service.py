@@ -112,6 +112,9 @@ def test_tcp_pool_over_crypto_service():
     """The topology this exists for: a 4-process pool whose nodes all
     verify through ONE crypto-plane process (backend service:cpu), with
     the verdict cache collapsing per-node re-verification."""
+    pytest.importorskip(
+        "cryptography",
+        reason="the TCP node stack's handshake needs the cryptography package")
     from plenum_tpu.tools.tcp_pool import run_tcp_pool
     r = run_tcp_pool(n_nodes=4, n_txns=60, backend="service:cpu",
                      timeout=90.0)

@@ -3,6 +3,9 @@ against a naive reference tree, proofs, recovery, uncommitted staging."""
 import hashlib
 
 import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package")
 from hypothesis import given, settings, strategies as st
 
 from plenum_tpu.ledger.tree_hasher import TreeHasher, make_tree_hasher

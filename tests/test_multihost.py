@@ -14,6 +14,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 _WORKER = r"""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -37,10 +39,7 @@ assert mesh.devices.size == 8, mesh.devices.shape
 local = np.arange(4 * 16, dtype=np.float32).reshape(4, 16) + rank * 64
 garr = shard_host_batch(mesh, local, P(("inst", "sig"), None))
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:
-    from jax.experimental.shard_map import shard_map
+from plenum_tpu.parallel.crypto_plane import _shard_map as shard_map
 
 def step(x):
     # the plane's collective pattern: per-shard reduction, all_gather of
@@ -80,6 +79,11 @@ def test_two_process_distributed_mesh(tmp_path):
     for r, p in enumerate(procs):
         out, _ = p.communicate(timeout=180)
         outs.append(out.decode())
+        if "Multiprocess computations aren't implemented" in outs[-1]:
+            for q in procs:
+                q.kill()
+            pytest.skip("this jax build has no cross-process CPU "
+                        "collectives (gloo backend missing)")
         assert p.returncode == 0, f"rank{r} failed:\n{outs[-1]}"
     assert "RANK_OK 0" in outs[0]
     assert "RANK_OK 1" in outs[1]

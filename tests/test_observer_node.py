@@ -23,6 +23,9 @@ from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID, POOL_LEDGER_ID
 
 @pytest.fixture
 def tcp_pool_4():
+    pytest.importorskip(
+        "cryptography",
+        reason="the TCP node stack's handshake needs the cryptography package")
     from plenum_tpu.tools.tcp_pool import REPO, setup_pool_dir, _wait_all_started
     import os
 

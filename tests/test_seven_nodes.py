@@ -61,6 +61,9 @@ def test_seven_node_pool_over_real_tcp():
     """The asyncio TCP stack at 7 nodes / f=2: 42 directed encrypted
     connections, 7 OS processes, real NYM load ordered pool-wide
     (VERDICT r2: no scale datum existed for the TCP stack beyond 4)."""
+    pytest.importorskip(
+        "cryptography",
+        reason="the TCP node stack's handshake needs the cryptography package")
     from plenum_tpu.tools.tcp_pool import run_tcp_pool
 
     stats = run_tcp_pool(n_nodes=7, n_txns=60, timeout=120.0)

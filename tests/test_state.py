@@ -3,6 +3,9 @@ Ethereum root vectors, proofs, commit/revert."""
 import hashlib
 
 import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package")
 from hypothesis import given, settings, strategies as st
 
 from plenum_tpu.state import rlp

@@ -13,6 +13,10 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="the TCP node stack's handshake needs the cryptography package")
+
 from plenum_tpu.common.node_messages import InstanceChange
 from plenum_tpu.common.event_bus import ExternalBus
 from plenum_tpu.common.serialization import pack, unpack

@@ -323,6 +323,9 @@ def test_durable_spylog_survives_torn_tail(tmp_path):
 def test_start_node_chunked_backend_is_durable(tmp_path):
     """--kv chunked must build a node on KvChunked ledgers (review
     finding: it silently fell back to in-memory storage)."""
+    pytest.importorskip(
+        "cryptography",
+        reason="build_node stands up the TCP stack, which needs cryptography")
     from plenum_tpu.storage.kv_chunked import KvChunked
     from plenum_tpu.tools.start_node import build_node
     from plenum_tpu.tools.tcp_pool import setup_pool_dir

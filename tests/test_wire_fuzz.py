@@ -95,6 +95,91 @@ def test_node_survives_garbage_node_traffic():
     assert sizes == {2}, sizes
 
 
+def test_propagate_batch_roundtrips_and_rejects_garbage():
+    """PropagateBatch (the per-tick propagate coalescing envelope) must
+    survive pack/unpack/message_from_dict unchanged, and every mutation
+    must fail ONLY with MessageValidationError."""
+    from plenum_tpu.common.node_messages import Propagate, PropagateBatch
+
+    body = Propagate(request={"identifier": "A", "reqId": 1,
+                              "operation": {"type": "1"}},
+                     sender_client="cli-7").to_dict()
+    base = PropagateBatch(
+        votes=(("d" * 64, "cli-1"), ("e" * 64, None)),
+        bodies=(body,)).to_dict()
+    # clean round trip through the real wire path
+    decoded = message_from_dict(unpack(pack(base)))
+    assert isinstance(decoded, PropagateBatch)
+    assert decoded.to_dict() == base
+    assert decoded.votes[1][1] is None
+
+    rng = random.Random(4242)
+    ok = 0
+    for _ in range(N_CASES):
+        d = _mutate(rng, base)
+        try:
+            wire = pack(d)
+        except (TypeError, ValueError, OverflowError):
+            continue
+        try:
+            message_from_dict(unpack(wire))
+            ok += 1
+        except MessageValidationError:
+            pass                     # the ONLY acceptable failure mode
+    assert ok < N_CASES
+
+
+def test_broadcast_call_sites_pack_once():
+    """Guard against per-peer pack() regressions on broadcast paths: the
+    node-stack outbox and the client-stack send_many must serialize a
+    message ONCE no matter how many recipients it fans out to."""
+    import inspect
+
+    from plenum_tpu.network import tcp_stack as ts
+
+    # source-level: no pack( inside the per-peer fan-out loops
+    src = inspect.getsource(ts.TcpStack._enqueue_send)
+    loop_body = src.split("for peer in targets", 1)[1]
+    assert "pack(" not in loop_body, \
+        "TcpStack._enqueue_send re-packs per peer"
+    prop_src = inspect.getsource(ts.ClientStack._send_packed)
+    assert "pack(" not in prop_src, \
+        "ClientStack._send_packed must take pre-packed bytes"
+
+    # functional: ClientStack.send_many packs once for N live clients
+    class _W:                                   # fake asyncio writer
+        class _T:
+            @staticmethod
+            def get_write_buffer_size():
+                return 0
+        transport = _T()
+
+        def __init__(self):
+            self.wrote = []
+
+        def write(self, data):
+            self.wrote.append(data)
+
+    stack = ts.ClientStack("N1", "127.0.0.1", 0, on_request=lambda m, f: None)
+    for i in range(5):
+        stack._conns[f"client-{i}"] = _W()
+    calls = {"n": 0}
+    real_pack = ts.pack
+
+    def counting_pack(obj):
+        calls["n"] += 1
+        return real_pack(obj)
+
+    ts.pack = counting_pack
+    try:
+        stack.send_many({"op": "REPLY", "result": {"x": 1}},
+                        [f"client-{i}" for i in range(5)])
+    finally:
+        ts.pack = real_pack
+    assert calls["n"] == 1, f"send_many packed {calls['n']}x for 5 clients"
+    assert sum(len(w.wrote) for w in stack._conns.values()) == 5
+
+
 def test_node_nacks_garbage_client_traffic():
     rng = random.Random(7)
     pool = Pool()
