@@ -202,6 +202,17 @@ def main():
                   "propagate_inbox_depth_max", "dropped_frames"):
             if tcp7.get(k) is not None:
                 result[f"tcp7_{k}"] = tcp7[k]
+    # batched-BLS + group-commit acceptance: per-stage commit-path p50/p95
+    # (bls_verify_ms / apply_ms / durable_ms / reply_ms) and the
+    # pairings-per-ordered-batch counter, per config — a TPS regression
+    # must localize to a stage
+    for t, prefix in ((cpu, "cpu"), (tcp, "tcp"),
+                      (tcpsvc, "tcpsvc"), (tcp7, "tcp7")):
+        if t and t.get("commit_stage"):
+            result[f"{prefix}_commit_stage"] = t["commit_stage"]
+            ppb = t["commit_stage"].get("pairings_per_batch")
+            if ppb is not None and "pairings_per_batch" not in result:
+                result["pairings_per_batch"] = ppb
     if jax_ok:
         result.update({
             "jax_tps": jax_stats["tps"],    # real-device in-process pool
@@ -243,6 +254,8 @@ def main():
         if c5.get("propagate_bytes_per_txn") is not None:
             result["config5_propagate_bytes_per_txn"] = \
                 c5["propagate_bytes_per_txn"]
+        if c5.get("commit_stage"):
+            result["config5_commit_stage"] = c5["commit_stage"]
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))

@@ -34,6 +34,25 @@ class MetricsName:
     SIG_BATCH_SIZE = "crypto.sig_batch_size"
     SIG_BATCH_TIME = "crypto.sig_batch_time"
     BLS_VERIFY_TIME = "crypto.bls_verify_time"
+    # pairing accounting (cumulative bn254.PAIRING_STATS gauges sampled at
+    # flush, read back via max like gc_pause_time) + the per-ordered-batch
+    # Miller-loop count the batched-BLS acceptance rides on
+    BLS_PAIRING_CHECKS = "crypto.pairing_checks"
+    BLS_PAIRINGS = "crypto.pairings"
+    BLS_PAIRINGS_NATIVE = "crypto.pairings_native"
+    BLS_PAIRINGS_PER_BATCH = "crypto.pairings_per_batch"
+    # device-plane dispatch counter (ShardedJaxEd25519Verifier.dispatches,
+    # cumulative gauge)
+    SIG_PLANE_DISPATCHES = "crypto.plane_dispatches"
+    # post-ordering critical path, one stage timer each: aggregate COMMIT
+    # signature validation, uncommitted apply, the durable group flush,
+    # and client REPLY fan-out — regressions must localize to a stage
+    COMMIT_BLS_VERIFY_TIME = "commit_path.bls_verify_time"
+    COMMIT_APPLY_TIME = "commit_path.apply_time"
+    COMMIT_DURABLE_TIME = "commit_path.durable_time"
+    COMMIT_REPLY_TIME = "commit_path.reply_time"
+    # ordered batches riding ONE durable flush (group commit coalescing)
+    GROUP_COMMIT_BATCHES = "node.group_commit_batches"
     # consensus
     VIEW_CHANGES = "consensus.view_changes"
     SUSPICIONS = "consensus.suspicions"
@@ -167,27 +186,55 @@ def sample_process_gauges(collector: "MetricsCollector") -> None:
     collector.add_event(MetricsName.GC_PAUSE_TIME, _gc_pause_timer.total)
 
 
+# Folds lose the distribution; these commit-path names additionally keep a
+# bounded run of raw samples that rides the flush row (key "samples"), so
+# metrics_report can print honest p50/p95 per stage instead of a mean that
+# hides the tail. Bounded: a flush interval orders at most a few thousand
+# batches, and SAMPLE_CAP per flush keeps rows small.
+SAMPLED_NAMES = frozenset({
+    MetricsName.COMMIT_BLS_VERIFY_TIME, MetricsName.COMMIT_APPLY_TIME,
+    MetricsName.COMMIT_DURABLE_TIME, MetricsName.COMMIT_REPLY_TIME,
+    MetricsName.BLS_PAIRINGS_PER_BATCH,
+})
+SAMPLE_CAP = 256
+
+
+def percentile(values, q: float) -> Optional[float]:
+    """Nearest-rank percentile of an unsorted sequence (q in [0, 1])."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return ordered[idx]
+
+
 class Accumulator:
     """Fold of all events for one name since the last flush."""
 
-    __slots__ = ("count", "total", "min", "max")
+    __slots__ = ("count", "total", "min", "max", "samples")
 
-    def __init__(self):
+    def __init__(self, keep_samples: bool = False):
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self.samples: Optional[list[float]] = [] if keep_samples else None
 
     def add(self, value: float) -> None:
         self.count += 1
         self.total += value
         self.min = value if self.min is None else min(self.min, value)
         self.max = value if self.max is None else max(self.max, value)
+        if self.samples is not None and len(self.samples) < SAMPLE_CAP:
+            self.samples.append(value)
 
     def to_dict(self) -> dict:
         avg = self.total / self.count if self.count else 0.0
-        return {"count": self.count, "sum": self.total, "avg": avg,
-                "min": self.min, "max": self.max}
+        out = {"count": self.count, "sum": self.total, "avg": avg,
+               "min": self.min, "max": self.max}
+        if self.samples:
+            out["samples"] = list(self.samples)
+        return out
 
 
 class MetricsCollector:
@@ -200,7 +247,8 @@ class MetricsCollector:
     def add_event(self, name: str, value: float = 1.0) -> None:
         acc = self.accumulators.get(name)
         if acc is None:
-            acc = self.accumulators[name] = Accumulator()
+            acc = self.accumulators[name] = Accumulator(
+                keep_samples=name in SAMPLED_NAMES)
         acc.add(value)
 
     @contextmanager

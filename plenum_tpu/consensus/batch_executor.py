@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 from abc import ABC, abstractmethod
+from contextlib import nullcontext
 from typing import NamedTuple, Optional, Sequence
 
 from plenum_tpu.common.request import Request
@@ -43,6 +44,12 @@ class BatchExecutor(ABC):
     @abstractmethod
     def ledger_id_for(self, request: Request) -> int:
         """Which ledger a request's txn type writes to."""
+
+    def group_commit(self):
+        """Context manager grouping every durable write issued inside into
+        one atomic flush per store. Executors without durable storage
+        (this default) make it a no-op scope."""
+        return nullcontext(self)
 
 
 class SimBatchExecutor(BatchExecutor):

@@ -15,6 +15,7 @@ the same traffic for the RBFT monitor comparison without touching state
 """
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Callable, Optional
 
@@ -313,12 +314,27 @@ class OrderingService:
         if self._data.is_master and self._executor is not None:
             # primaries resolution is the executor's: the audit ledger is
             # the exact historical record (write_manager._resolve_primaries)
-            return self._executor.apply_batch(
+            return self._timed_apply(
                 ledger_id, reqs, pp_time, view_no, pp_seq_no,
                 primaries=(list(self._data.primaries)
                            if view_no == self._data.view_no else None))
         digests = tuple(r.digest for r in reqs)
         return AppliedBatch("", "", "", "", digests, ())
+
+    def _timed_apply(self, ledger_id, reqs, pp_time, view_no, pp_seq_no,
+                     primaries=None) -> AppliedBatch:
+        """executor.apply_batch under the commit-path apply-stage timer —
+        every uncommitted apply (fresh batch, peer pre-prepare, view-change
+        re-apply) lands in the same stage bucket."""
+        t0 = time.perf_counter()
+        try:
+            return self._executor.apply_batch(
+                ledger_id, reqs, pp_time, view_no, pp_seq_no,
+                primaries=primaries)
+        finally:
+            if self._metrics is not None:
+                self._metrics.add_event(MetricsName.COMMIT_APPLY_TIME,
+                                        time.perf_counter() - t0)
 
     def _last_state_root(self, ledger_id: int) -> str:
         """State root of the previous batch on this ledger (what the previous
@@ -469,7 +485,7 @@ class OrderingService:
             # (viewNo, primaries), and a re-ordered batch must reproduce the
             # audit root minted in its original view
             orig = _orig_view(msg)
-            applied = self._executor.apply_batch(
+            applied = self._timed_apply(
                 msg.ledger_id, reqs, msg.pp_time, orig, msg.pp_seq_no,
                 primaries=(list(self._data.primaries)
                            if orig == self._data.view_no else None))
@@ -588,6 +604,26 @@ class OrderingService:
     def process_commit(self, msg: Commit, sender: str):
         verdict = self._validate(msg)
         if verdict is not PROCESS:
+            # A COMMIT landing after its batch ordered is stale for 3PC but
+            # may carry the BLS signature the pending multi-sig aggregation
+            # is WAITING on: a batch orders at quorum n-f commits, and if a
+            # bad signer is among those first arrivals the honest aggregate
+            # falls short until a late sig lands — which used to be
+            # discarded here, starving the retry forever (one Byzantine
+            # signer suppressed multi-sigs on every node that counted its
+            # commit toward the ordering quorum). Strictly this instance's
+            # own sig-carrying commits (backup instances broadcast sig-less
+            # commits that must not shadow the master's), and only the BLS
+            # side sees them — the 3PC vote table stays untouched.
+            if (verdict is DISCARD and self._bls is not None
+                    and msg.inst_id == self._data.inst_id
+                    and msg.bls_sig is not None):
+                key = (msg.view_no, msg.pp_seq_no)
+                pp = self.prePrepares.get(key)
+                if (key in self.ordered and pp is not None
+                        and self._bls.validate_commit(msg, sender, pp)
+                        is None):
+                    self._bls.process_commit(msg, sender)
             return verdict
         key = (msg.view_no, msg.pp_seq_no)
         votes = self.commits.setdefault(key, {})
@@ -688,7 +724,7 @@ class OrderingService:
         if any(r is None for r in reqs):
             return False
         orig = _orig_view(pp)
-        applied = self._executor.apply_batch(
+        applied = self._timed_apply(
             pp.ledger_id, reqs, pp.pp_time, orig, pp.pp_seq_no,
             primaries=(list(self._data.primaries)
                        if orig == self._data.view_no else None))
@@ -1068,7 +1104,7 @@ class OrderingService:
                 if self._data.is_master and self._executor is not None \
                         and not rerun:
                     reqs = [self._get_request(d) for d in new_pp.req_idr]
-                    self._executor.apply_batch(
+                    self._timed_apply(
                         new_pp.ledger_id, reqs, new_pp.pp_time,
                         orig_view, pp_seq_no,
                         primaries=(list(self._data.primaries)

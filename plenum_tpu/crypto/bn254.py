@@ -451,6 +451,15 @@ def multi_pairing(pairs) -> Fq12:
     return final_exponentiation(f)
 
 
+# Process-wide pairing accounting. A pairing is the unit the commit path's
+# cost is measured in (~2.6 ms native, ~100x that pure-Python), so the
+# counters are cheap ints bumped once per check: `checks` = pairing_check
+# calls, `pairings` = Miller loops inside them, split by which engine ran.
+# Readers (bls_bft_replica's per-batch delta, the node's flush gauges) take
+# snapshots; nothing resets these during a process lifetime.
+PAIRING_STATS = {"checks": 0, "pairings": 0, "native": 0, "python": 0}
+
+
 def pairing_check(pairs) -> bool:
     """True iff ∏ e(Qᵢ, Pᵢ) == 1 — the shape every BLS verification reduces to.
 
@@ -459,12 +468,16 @@ def pairing_check(pairs) -> bool:
     native multi-pairing is ~20× the pure-Python one. Falls back to the
     Python twin (the differential-testing reference) otherwise."""
     pairs = list(pairs)
+    PAIRING_STATS["checks"] += 1
+    PAIRING_STATS["pairings"] += len(pairs)
     if _NATIVE is not None:
         g2_bytes = b"".join(_enc_g2(q) for q, _ in pairs)
         g1_bytes = b"".join(_enc_g1(p) for _, p in pairs)
         res = _NATIVE.pc_pairing_check(g2_bytes, g1_bytes, len(pairs))
         if res >= 0:          # -1 = malformed input: let Python decide
+            PAIRING_STATS["native"] += len(pairs)
             return bool(res)
+    PAIRING_STATS["python"] += len(pairs)
     return multi_pairing(pairs) == F12_ONE
 
 

@@ -8,7 +8,8 @@ node bootstrap wire real ones.
 """
 from __future__ import annotations
 
-from typing import Iterable, Optional
+from contextlib import ExitStack, contextmanager
+from typing import Iterable, Iterator, Optional
 
 from plenum_tpu.ledger.ledger import Ledger
 from plenum_tpu.state.pruning_state import PruningState
@@ -65,6 +66,49 @@ class DatabaseManager:
     @property
     def idr_cache(self):
         return self._stores.get(IDR_CACHE_LABEL)
+
+    # --- group commit -----------------------------------------------------
+
+    def iter_kv_stores(self) -> Iterator:
+        """Every underlying KeyValueStorage a 3PC commit can touch: txn
+        logs, Merkle hash stores, state tries, and the named specialty
+        stores (ts/seq-no/bls/...). Deduplicated by identity."""
+        seen: set[int] = set()
+
+        def fresh(kv) -> bool:
+            if kv is None or id(kv) in seen:
+                return False
+            seen.add(id(kv))
+            return True
+
+        for ledger in self._ledgers.values():
+            if fresh(ledger.txn_log):
+                yield ledger.txn_log
+            hs_kv = ledger.tree.hash_store.kv
+            if fresh(hs_kv):
+                yield hs_kv
+        for state in self._states.values():
+            if state is not None and fresh(state.kv):
+                yield state.kv
+        for store in self._stores.values():
+            kv = store if hasattr(store, "write_batch") \
+                else getattr(store, "kv", None)
+            if kv is not None and hasattr(kv, "write_batch") and fresh(kv):
+                yield kv
+
+    @contextmanager
+    def group_commit(self):
+        """One write_batch scope across EVERY store: all durable rows a
+        3PC batch produces (ledger txns, hash-store rows, trie nodes,
+        audit, ts-store, seq-no entries) land as one atomic KV batch per
+        store, flushed once at scope exit. Nesting joins the outer scope
+        (each backend's write_batch does), so the node can stretch one
+        scope over several consecutive ordered batches — catchup-style
+        multi-batch group commit."""
+        with ExitStack() as stack:
+            for kv in self.iter_kv_stores():
+                stack.enter_context(kv.write_batch())
+            yield self
 
     def close(self) -> None:
         for ledger in self._ledgers.values():

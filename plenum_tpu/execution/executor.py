@@ -40,3 +40,9 @@ class LedgerBatchExecutor(BatchExecutor):
 
     def commit_batch(self, batch: ThreePcBatch) -> list[dict]:
         return self.write_manager.commit_batch(batch)
+
+    def group_commit(self):
+        """Context manager: stretch ONE durable flush per store across all
+        commit_batch calls made inside the scope (multi-batch group
+        commit — the node drains every ready Ordered under one scope)."""
+        return self.write_manager.db.group_commit()

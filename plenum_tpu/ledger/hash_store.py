@@ -21,6 +21,12 @@ class HashStore:
     def __init__(self, kv: Optional[KeyValueStorage] = None):
         self._kv = kv if kv is not None else KvMemory()
 
+    @property
+    def kv(self) -> KeyValueStorage:
+        """Backing store — exposed so the commit path can group this
+        store's rows into the per-3PC-batch atomic write."""
+        return self._kv
+
     @staticmethod
     def _leaf_key(idx: int) -> bytes:
         return b"l" + idx.to_bytes(8, "big")

@@ -79,11 +79,22 @@ class Ledger:
     def append_batch(self, txns: Sequence[dict]) -> list[dict]:
         leaves = [txn_to_leaf(t) for t in txns]
         start = self.seq_no
-        for i, (txn, leaf) in enumerate(zip(txns, leaves)):
-            self._log.put(start + 1 + i, leaf)
-        self.tree.extend_batch(leaves)
+        # one atomic KV batch for the txn-log rows and one for the Merkle
+        # hash-store rows (leaves + interior nodes), instead of a flushed
+        # append per row — with a durable backend this is the difference
+        # between 2 fsync-ish flushes and ~3n per committed batch
+        self._log.do_ops_in_batch(
+            [("put", start + 1 + i, leaf) for i, leaf in enumerate(leaves)])
+        with self.tree.hash_store.kv.write_batch():
+            self.tree.extend_batch(leaves)
         self.seq_no += len(txns)
         return [self.merkle_info(start + 1 + i) for i in range(len(txns))]
+
+    @property
+    def txn_log(self) -> KeyValueStorage:
+        """Backing txn-log store — exposed for the commit path's group
+        flush (DatabaseManager.group_commit)."""
+        return self._log
 
     # --- uncommitted staging (ref appendTxns/commitTxns/discardTxns) ------
 

@@ -64,6 +64,8 @@ struct Store {
     std::map<std::string, Entry> index;
     uint64_t live_bytes = 0;    // payload bytes reachable from the index
     uint64_t total_bytes = 0;   // file size (garbage ratio = 1 - live/total)
+    bool batching = false;      // kvn_begin_batch: defer fflush to batch end
+    bool dirty = false;         // unflushed appends pending
 };
 
 constexpr size_t HDR = 4 + 1 + 4 + 4;
@@ -144,7 +146,11 @@ int append_record(Store* s, uint8_t op, const uint8_t* key, uint32_t klen,
     if (fwrite(hdr, 1, HDR, s->fh) != HDR) return -1;
     if (fwrite(key, 1, klen, s->fh) != klen) return -1;
     if (vlen && fwrite(val, 1, vlen, s->fh) != vlen) return -1;
-    if (fflush(s->fh) != 0) return -1;
+    if (s->batching) {
+        s->dirty = true;        // ONE fflush at kvn_end_batch
+    } else if (fflush(s->fh) != 0) {
+        return -1;
+    }
     s->total_bytes += HDR + klen + vlen;
     return 0;
 }
@@ -185,7 +191,13 @@ long kvn_get(void* h, const uint8_t* key, uint32_t klen,
     if (!s->rf) s->rf = fopen(s->path.c_str(), "rb");
     if (!s->rf) return -2;
     // reads go through the persistent handle; appends fflush, so the
-    // separate read FD always sees committed records
+    // separate read FD always sees committed records. Inside a batch the
+    // flush is deferred — a read of a just-batched key forces it, keeping
+    // read-your-writes exact (commit batches are write-mostly, so this
+    // rarely fires).
+    if (s->dirty && fflush(s->fh) == 0) s->dirty = false;
+    // (a failed lazy flush keeps dirty set so kvn_end_batch retries and
+    // surfaces the error; the fread below then short-reads and returns -2)
     fseek(s->rf, (long)it->second.offset, SEEK_SET);
     size_t got = fread(buf, 1, it->second.vlen, s->rf);
     return got == it->second.vlen ? (long)it->second.vlen : -2;
@@ -210,6 +222,26 @@ int kvn_del(void* h, const uint8_t* key, uint32_t klen) {
 
 long kvn_count(void* h) {
     return (long)((Store*)h)->index.size();
+}
+
+// Group-commit mode: appends between begin/end skip the per-record fflush;
+// end issues ONE flush for the whole batch. Records keep their individual
+// CRC framing, so a crash mid-batch replays a valid prefix (torn-tail
+// tolerance unchanged) — the grouping is a durability-latency win, not an
+// atomicity guarantee (the pure-python log's _BATCH record provides that).
+int kvn_begin_batch(void* h) {
+    ((Store*)h)->batching = true;
+    return 0;
+}
+
+int kvn_end_batch(void* h) {
+    Store* s = (Store*)h;
+    s->batching = false;
+    if (s->dirty) {
+        s->dirty = false;
+        if (fflush(s->fh) != 0) return -1;
+    }
+    return 0;
 }
 
 // Sorted keys in [start, end) serialized as repeated (u32 klen | key).

@@ -16,6 +16,7 @@ path would (ack/nack/reject/suspicion).
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional
 
 from plenum_tpu.catchup import NodeLeecherService, SeederService
@@ -428,6 +429,29 @@ class Node:
             sum(len(q) for q in
                 self.master_replica.ordering.request_queues.values()))
 
+    def _sample_crypto_gauges(self) -> None:
+        """Pairing accounting + device-plane dispatch counters as cumulative
+        gauges (read back via max, like gc_pause_time). PAIRING_STATS is
+        process-wide — per-node exactness holds in the one-process-per-node
+        topology the flushed history exists for."""
+        from plenum_tpu.crypto.bn254 import PAIRING_STATS
+        self.metrics.add_event(MetricsName.BLS_PAIRING_CHECKS,
+                               PAIRING_STATS["checks"])
+        self.metrics.add_event(MetricsName.BLS_PAIRINGS,
+                               PAIRING_STATS["pairings"])
+        self.metrics.add_event(MetricsName.BLS_PAIRINGS_NATIVE,
+                               PAIRING_STATS["native"])
+        # ShardedJaxEd25519Verifier.dispatches, possibly wrapped by the
+        # CoalescingVerifier (walk one level of ._inner)
+        verifier = getattr(self.c.authenticator.core_authenticator,
+                           "verifier", None)
+        for obj in (verifier, getattr(verifier, "_inner", None)):
+            dispatches = getattr(obj, "dispatches", None)
+            if dispatches is not None:
+                self.metrics.add_event(MetricsName.SIG_PLANE_DISPATCHES,
+                                       dispatches)
+                break
+
     def _flush_metrics(self) -> None:
         """Sample process RSS/GC gauges + one last queue sample, then flush
         accumulators to the KV store. The in-flush flag lets signal
@@ -438,6 +462,7 @@ class Node:
             from plenum_tpu.common.metrics import sample_process_gauges
             sample_process_gauges(self.metrics)
             self._sample_queue_gauges()
+            self._sample_crypto_gauges()
             self.metrics.flush()
         finally:
             self._in_metrics_flush = False
@@ -597,6 +622,8 @@ class Node:
                 key_register=self.c.bls_register,
                 bls_store=self.c.bls_store,
                 node_reg_at=node_reg_at, key_at=key_at)
+            # commit-path stage timer + pairings-per-batch counter
+            bls.metrics = self.metrics
         # InstanceChange votes survive restart via the node-status DB
         # (ref instance_change_provider.py:34-69); master-only — backups
         # have no view-change machinery (see Replica)
@@ -621,7 +648,7 @@ class Node:
             bls.report_bad_signature = lambda sender, r=replica: \
                 r.internal_bus.send(RaisedSuspicion(
                     inst_id=inst_id, code=Suspicions.CM_BLS_WRONG.code,
-                    reason="bad COMMIT BLS signature (order-time bisection)",
+                    reason="bad COMMIT BLS signature (batch-check fallback)",
                     sender=sender))
         if inst_id != 0 and self._last_sent_pp is not None:
             replica.ordering.on_backup_pp_sent = self._last_sent_pp.store
@@ -1370,31 +1397,61 @@ class Node:
     def _service_ordered(self) -> int:
         done = 0
         while self._ordered_queue:
-            msg = self._ordered_queue.pop(0)
-            done += 1
-            self.monitor.request_ordered(msg.inst_id, msg.req_idr)
-            if msg.inst_id == 0:
-                for digest in msg.discarded:
-                    self.monitor.req_tracker.drop(digest)
-            if msg.inst_id != 0:
-                self.metrics.add_event(MetricsName.BACKUP_ORDERED)
-                self.spylog.append(("backup_ordered", msg))
+            drained, self._ordered_queue = self._ordered_queue, []
+            to_exec: list[Ordered] = []
+            # tracks the filter floor WITHIN this drain too: two copies of
+            # the same re-certified batch can land in one drain window, and
+            # comparing both against the pre-drain _last_executed_pp_seq
+            # would double-commit (commit-out-of-order crash)
+            exec_floor = self._last_executed_pp_seq
+            for msg in drained:
+                done += 1
+                self.monitor.request_ordered(msg.inst_id, msg.req_idr)
+                if msg.inst_id == 0:
+                    for digest in msg.discarded:
+                        self.monitor.req_tracker.drop(digest)
+                if msg.inst_id != 0:
+                    self.metrics.add_event(MetricsName.BACKUP_ORDERED)
+                    self.spylog.append(("backup_ordered", msg))
+                    continue
+                if msg.pp_seq_no <= exec_floor:
+                    # a batch ordered pre-view-change and re-certified after
+                    # it can surface twice; the ledger effects are already
+                    # durable
+                    self.spylog.append(("duplicate_ordered_skipped",
+                                        (msg.view_no, msg.pp_seq_no)))
+                    continue
+                to_exec.append(msg)
+                exec_floor = msg.pp_seq_no
+            if not to_exec:
                 continue
-            if msg.pp_seq_no <= self._last_executed_pp_seq:
-                # a batch ordered pre-view-change and re-certified after it
-                # can surface twice; the ledger effects are already durable
-                self.spylog.append(("duplicate_ordered_skipped",
-                                    (msg.view_no, msg.pp_seq_no)))
-                continue
-            self.metrics.add_event(MetricsName.ORDERED_BATCH_SIZE,
-                                   len(msg.req_idr))
-            with self.metrics.measure_time(MetricsName.EXECUTE_BATCH_TIME):
-                self._execute_batch(msg)
-            self._last_executed_pp_seq = msg.pp_seq_no
+            # GROUP COMMIT: every ready batch commits under ONE write_batch
+            # scope per store — the flush coalesces across batches
+            # (catchup-style multi-batch commit). REPLIES go out only after
+            # the scope closes: a client ack must never precede the durable
+            # flush backing it.
+            committed_per_msg: list[list[dict]] = []
+            t0 = time.perf_counter()
+            with self.c.executor.group_commit():
+                for msg in to_exec:
+                    self.metrics.add_event(MetricsName.ORDERED_BATCH_SIZE,
+                                           len(msg.req_idr))
+                    with self.metrics.measure_time(
+                            MetricsName.EXECUTE_BATCH_TIME):
+                        committed_per_msg.append(self._commit_ordered(msg))
+                    self._last_executed_pp_seq = msg.pp_seq_no
+            self.metrics.add_event(MetricsName.COMMIT_DURABLE_TIME,
+                                   time.perf_counter() - t0)
+            self.metrics.add_event(MetricsName.GROUP_COMMIT_BATCHES,
+                                   len(to_exec))
+            with self.metrics.measure_time(MetricsName.COMMIT_REPLY_TIME):
+                for msg, committed in zip(to_exec, committed_per_msg):
+                    self._reply_batch(msg, committed)
         return done
 
-    def _execute_batch(self, msg: Ordered) -> None:
-        """Commit the ordered batch and REPLY (ref executeBatch:2661)."""
+    def _commit_ordered(self, msg: Ordered) -> list[dict]:
+        """Durable half of executeBatch:2661 — commit the ordered batch's
+        writes (inside the caller's group-commit scope)."""
         batch = ThreePcBatch(
             ledger_id=msg.ledger_id, view_no=msg.view_no,
             pp_seq_no=msg.pp_seq_no, pp_time=msg.pp_time,
@@ -1407,6 +1464,11 @@ class Node:
             node_reg=tuple(self.validators))
         committed = self.c.executor.commit_batch(batch)
         self.spylog.append(("executed", (msg.view_no, msg.pp_seq_no)))
+        return committed
+
+    def _reply_batch(self, msg: Ordered, committed: list[dict]) -> None:
+        """Client-visible half of executeBatch: observer push, REPLY/Reject
+        fan-out, request-state retirement — after the durable flush."""
         if committed and self.observable.observer_ids:
             reqs = []
             complete = True

@@ -597,6 +597,37 @@ class ServiceBlsVerifier:
             return self._local.verify_multi_sig(signature, message, verkeys)
         return b._bls_cache_put(key, verdict)
 
+    def batch_verify(self, items) -> list:
+        """COMMIT-set batch verification over the shared plane. When every
+        triple signs the SAME message (the commit path always does), the
+        deterministic aggregate check is tried first because the service
+        dedups it host-wide — co-hosted nodes run the IDENTICAL check, so
+        one IPC round-trip settles it for the whole host, where the
+        random-coefficient combined check (fresh randomness per node by
+        design) never dedups. Any failure, mixed messages, or malformed
+        input falls back to the local RLC batch check, whose per-signature
+        fallback names the culprit signer(s) individually.
+
+        DELIBERATE trade-off: the aggregate fast path certifies the SET,
+        not each signature — an error-cancelling pair (σ₁+δ, σ₂−δ) is
+        accepted here (the summed artifact equals the honest aggregate and
+        remains a valid multi-sig, so consensus artifacts stay sound) where
+        the local RLC path would reject and evict both. Blame precision is
+        traded for host-wide dedup ONLY in this opt-in co-hosted plane
+        topology; isolated nodes always take the individually-certifying
+        path."""
+        items = list(items)
+        msgs = {m for _, m, _ in items}
+        if len(items) > 1 and len(msgs) == 1:
+            try:
+                agg = self._local.create_multi_sig([s for s, _, _ in items])
+            except (ValueError, KeyError):
+                return self._local.batch_verify(items)
+            if self.verify_multi_sig(agg, next(iter(msgs)),
+                                     [v for _, _, v in items]):
+                return [True] * len(items)
+        return self._local.batch_verify(items)
+
     def close(self) -> None:
         self._client.close()
 

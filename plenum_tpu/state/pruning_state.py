@@ -30,6 +30,12 @@ class PruningState:
         self._trie = Trie(self._db, root, cache=self._node_cache)
         self._committed_root = root
 
+    @property
+    def kv(self) -> KeyValueStorage:
+        """Backing trie-node store — exposed so the commit path can group
+        trie-node writes into the per-3PC-batch atomic write."""
+        return self._db
+
     # --- writes (uncommitted head) ----------------------------------------
 
     def set(self, key: bytes, value: bytes) -> None:

@@ -16,9 +16,11 @@ chunking), unlike KvFile whose single file earns its close-time rewrite.
 from __future__ import annotations
 
 import os
-from typing import Iterator
+from contextlib import contextmanager
+from typing import Iterator, Optional
 
-from .kv_file import apply_records, scan_records, _HDR, _PUT, _DEL
+from .kv_file import (apply_records, pack_record, scan_records, _BATCH,
+                      _HDR, _PUT, _DEL)
 from .kv_memory import KvMemory
 from .kv_store import KeyValueStorage, encode_key
 
@@ -34,6 +36,7 @@ class KvChunked(KeyValueStorage):
         self._chunk_records = chunk_records
         self._mem = KvMemory()
         self._fh = None
+        self._batch: Optional[list[bytes]] = None   # staged records in scope
         self._tail_no = 0          # number of the live chunk
         self._tail_records = 0     # records in the live chunk
         self._replay()
@@ -85,10 +88,39 @@ class KvChunked(KeyValueStorage):
         self._fh = open(self._chunk_path(self._tail_no), "ab")
 
     def _append(self, op: int, key: bytes, value: bytes = b"") -> None:
+        if self._batch is not None:
+            self._batch.append(pack_record(op, key, value))
+            return
         self._rotate_if_full()
-        self._fh.write(_HDR.pack(op, len(key), len(value)) + key + value)
+        self._fh.write(pack_record(op, key, value))
         self._fh.flush()
         self._tail_records += 1
+
+    @contextmanager
+    def write_batch(self):
+        """One atomic _BATCH record in the tail chunk per scope (torn tail
+        drops the whole batch, same as KvFile). The batch counts as its
+        inner record count toward chunk rotation — replay expands it to the
+        inner entries, so the accounting must match on reopen. A batch
+        larger than chunk_records overflows its chunk rather than split:
+        atomicity beats the soft chunk-size target."""
+        if self._batch is not None:         # nested: join the outer batch
+            yield self
+            return
+        self._batch = []
+        try:
+            yield self
+        finally:
+            records, self._batch = self._batch, None
+            if records:
+                self._rotate_if_full()
+                if len(records) == 1:
+                    self._fh.write(records[0])
+                else:
+                    self._fh.write(pack_record(_BATCH, b"",
+                                               b"".join(records)))
+                self._fh.flush()
+                self._tail_records += len(records)
 
     # --- KeyValueStorage --------------------------------------------------
 

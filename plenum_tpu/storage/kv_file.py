@@ -9,31 +9,50 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Iterator
+from contextlib import contextmanager
+from typing import Iterator, Optional
 
 from .kv_store import KeyValueStorage, encode_key
 from .kv_memory import KvMemory
 
-_PUT, _DEL = 0, 1
+# _BATCH is the group-commit record: key empty, value = the concatenated
+# inner put/del records, written (and flushed) as ONE append. Crash
+# atomicity falls out of the framing: the outer header's value_len covers
+# every inner record, so a torn write drops the WHOLE batch on replay —
+# there is no prefix of a batch.
+_PUT, _DEL, _BATCH = 0, 1, 2
 _HDR = struct.Struct(">BII")  # op, key_len, value_len
+
+
+def pack_record(op: int, key: bytes, value: bytes = b"") -> bytes:
+    return _HDR.pack(op, len(key), len(value)) + key + value
 
 
 def scan_records(data: bytes) -> tuple[list[tuple[int, bytes, bytes]], int]:
     """THE record-scan for this on-disk format, shared by every reader
     (KvFile replay, read-only replay, KvChunked replay — a format or
     validation change happens HERE once). Parses until the first corrupt
-    header or truncated (torn-tail) record. -> ([(op, key, value)],
-    good_prefix_length)."""
+    header or truncated (torn-tail) record; batch records expand to their
+    inner put/del entries (whose framing the outer length already
+    validated — a batch whose payload doesn't parse exactly is corrupt and
+    ends the scan). -> ([(op, key, value)], good_prefix_length)."""
     entries = []
     off, n = 0, len(data)
     while off + _HDR.size <= n:
         op, klen, vlen = _HDR.unpack_from(data, off)
-        if op not in (_PUT, _DEL) or off + _HDR.size + klen + vlen > n:
+        if op not in (_PUT, _DEL, _BATCH) or off + _HDR.size + klen + vlen > n:
             break
-        off += _HDR.size
-        key = data[off:off + klen]; off += klen
-        val = data[off:off + vlen]; off += vlen
-        entries.append((op, key, val))
+        rec_end = off + _HDR.size + klen + vlen
+        key = data[off + _HDR.size:off + _HDR.size + klen]
+        val = data[off + _HDR.size + klen:rec_end]
+        if op == _BATCH:
+            inner, inner_off = scan_records(val)
+            if inner_off != len(val) or any(o == _BATCH for o, _, _ in inner):
+                break                      # corrupt batch payload
+            entries.extend(inner)
+        else:
+            entries.append((op, key, val))
+        off = rec_end
     return entries, off
 
 
@@ -65,6 +84,7 @@ class KvFile(KeyValueStorage):
         self._file_path = os.path.join(path, name + ".kvlog")
         self._mem = KvMemory()
         self._fh = None
+        self._batch: Optional[list[bytes]] = None   # staged records in scope
         self._replay()
         self._fh = open(self._file_path, "ab")
 
@@ -83,8 +103,35 @@ class KvFile(KeyValueStorage):
                 fh.truncate(off)
 
     def _append(self, op: int, key: bytes, value: bytes = b"") -> None:
-        self._fh.write(_HDR.pack(op, len(key), len(value)) + key + value)
+        if self._batch is not None:
+            self._batch.append(pack_record(op, key, value))
+            return
+        self._fh.write(pack_record(op, key, value))
         self._fh.flush()
+
+    def _flush_batch(self, records: list[bytes]) -> None:
+        """One append, one flush, all-or-nothing on replay."""
+        if not records:
+            return
+        if len(records) == 1:
+            self._fh.write(records[0])      # a 1-op batch IS atomic already
+        else:
+            self._fh.write(pack_record(_BATCH, b"", b"".join(records)))
+        self._fh.flush()
+
+    @contextmanager
+    def write_batch(self):
+        if self._batch is not None:         # nested: join the outer batch
+            yield self
+            return
+        self._batch = []
+        try:
+            yield self
+        finally:
+            # flushed even if the scope raised: the in-memory view already
+            # holds these writes, and memory/disk must not diverge
+            records, self._batch = self._batch, None
+            self._flush_batch(records)
 
     def put(self, key, value: bytes) -> None:
         k = encode_key(key)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+from contextlib import contextmanager
 from typing import Iterator, Optional
 
 from .kv_store import KeyValueStorage, encode_key
@@ -49,6 +50,11 @@ def _load():
     lib.kvn_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     lib.kvn_compact.argtypes = [ctypes.c_void_p]
     lib.kvn_compact.restype = ctypes.c_int
+    for name in ("kvn_begin_batch", "kvn_end_batch"):
+        fn = getattr(lib, name, None)
+        if fn is not None:       # older cached .so without batch support
+            fn.argtypes = [ctypes.c_void_p]
+            fn.restype = ctypes.c_int
     lib.kvn_garbage_ratio.argtypes = [ctypes.c_void_p]
     lib.kvn_garbage_ratio.restype = ctypes.c_double
     lib.kvn_close.argtypes = [ctypes.c_void_p]
@@ -81,6 +87,25 @@ class KvNative(KeyValueStorage):
         k = encode_key(key)
         if _LIB.kvn_put(self._h, k, len(k), bytes(value), len(value)) != 0:
             raise IOError("kvn_put failed")
+
+    @contextmanager
+    def write_batch(self):
+        """Engine-level group commit: puts/removes in the scope skip the
+        per-record flush, one flush lands at scope exit (kvn_end_batch).
+        Reads inside the scope stay exact (the engine flushes lazily on
+        read). Nesting joins the outer scope."""
+        begin = getattr(_LIB, "kvn_begin_batch", None)
+        if begin is None or getattr(self, "_in_batch", False):
+            yield self
+            return
+        self._in_batch = True
+        begin(self._h)
+        try:
+            yield self
+        finally:
+            self._in_batch = False
+            if _LIB.kvn_end_batch(self._h) != 0:
+                raise IOError("kvn_end_batch failed")
 
     def get(self, key) -> bytes:
         k = encode_key(key)

@@ -8,6 +8,7 @@ lexicographic iteration equals numeric order (ref kv_store_leveldb_int_keys.py).
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
+from contextlib import contextmanager
 from typing import Iterable, Iterator, Optional, Tuple
 
 
@@ -50,15 +51,28 @@ class KeyValueStorage(ABC):
     def has_key(self, key) -> bool:
         return self.try_get(key) is not None
 
+    @contextmanager
+    def write_batch(self):
+        """Group every put/remove issued inside the scope into one backend
+        write. Durable backends override this to emit a SINGLE atomic batch
+        record (one syscall, one flush, all-or-nothing on crash replay) —
+        the group-commit primitive the 3PC durable path rides. Default:
+        no-op grouping (each op applies immediately), which is exact for
+        memory-only stores. Reads inside the scope observe the writes.
+        Nested scopes join the outermost batch."""
+        yield self
+
     def do_ops_in_batch(self, batch: Iterable[Tuple[str, object, bytes]]) -> None:
-        """batch of ('put'|'remove', key, value) applied atomically-enough."""
-        for op, key, value in batch:
-            if op == "put":
-                self.put(key, value)
-            elif op == "remove":
-                self.remove(key)
-            else:
-                raise ValueError(f"unknown op {op}")
+        """batch of ('put'|'remove', key, value) applied as ONE atomic
+        backend write where the backend supports it (write_batch)."""
+        with self.write_batch():
+            for op, key, value in batch:
+                if op == "put":
+                    self.put(key, value)
+                elif op == "remove":
+                    self.remove(key)
+                else:
+                    raise ValueError(f"unknown op {op}")
 
     @property
     @abstractmethod

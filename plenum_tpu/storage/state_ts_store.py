@@ -27,6 +27,10 @@ class StateTsStore:
     def __init__(self, kv: KeyValueStorage):
         self._kv = kv
 
+    @property
+    def kv(self) -> KeyValueStorage:
+        return self._kv
+
     def set(self, ledger_id: int, ts: float, root: bytes) -> None:
         self._kv.put(_key(ledger_id, int(ts)), root)
 

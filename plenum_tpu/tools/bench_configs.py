@@ -336,12 +336,14 @@ def config5_sim25(n_txns: int = 60, timeout: float = 180.0) -> dict:
         wire = net.bytes_summary()
         prop = sum(c["bytes"] for op, c in wire["by_type"].items()
                    if op in ("PROPAGATE", "PROPAGATE_BATCH"))
+        stage = lp.commit_stage_stats(nodes[names[0]].metrics)
         return {"nodes": 25, "txns_ordered": done, "txns_requested": n_txns,
                 "tps": round(done / dt, 1) if dt else 0.0,
                 "wire_bytes_per_txn": round(wire["total_bytes"] / done)
                 if done else None,
                 "propagate_bytes_per_txn": round(prop / done)
-                if done else None}
+                if done else None,
+                **({"commit_stage": stage} if stage else {})}
     except Exception as e:                       # pragma: no cover
         return {"error": f"{type(e).__name__}: {e}"}
 

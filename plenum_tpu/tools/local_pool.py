@@ -101,6 +101,33 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1):
             plane, net)
 
 
+def commit_stage_stats(metrics) -> dict:
+    """Post-ordering stage percentiles + pairing counters from an
+    IN-PROCESS node's MetricsCollector (no flush required: the plain
+    collector retains accumulators and their bounded raw samples).
+    Keys match the bench line: bls_verify_ms/apply_ms/durable_ms/reply_ms
+    p50+p95, pairings_per_batch, group_commit_batches."""
+    from plenum_tpu.common.metrics import MetricsName, percentile
+    acc = metrics.accumulators
+    out = {}
+    for key, label in ((MetricsName.COMMIT_BLS_VERIFY_TIME, "bls_verify_ms"),
+                       (MetricsName.COMMIT_APPLY_TIME, "apply_ms"),
+                       (MetricsName.COMMIT_DURABLE_TIME, "durable_ms"),
+                       (MetricsName.COMMIT_REPLY_TIME, "reply_ms")):
+        a = acc.get(key)
+        if a is not None and a.samples:
+            out[f"{label}_p50"] = round(percentile(a.samples, 0.5) * 1000, 3)
+            out[f"{label}_p95"] = round(percentile(a.samples, 0.95) * 1000, 3)
+    for key, label in ((MetricsName.BLS_PAIRINGS_PER_BATCH,
+                        "pairings_per_batch"),
+                       (MetricsName.GROUP_COMMIT_BATCHES,
+                        "group_commit_batches")):
+        a = acc.get(key)
+        if a is not None and a.count:
+            out[label] = round(a.total / a.count, 2)
+    return out
+
+
 def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
              timeout: float = 120.0) -> dict:
     from plenum_tpu.common.request import Request
@@ -170,7 +197,9 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     latencies = sorted(first_reply[d] - submit_times[d]
                        for d in first_reply if d in submit_times)
     sizes = {nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size for n in names}
+    stage = commit_stage_stats(nodes[names[0]].metrics)
     return {
+        **({"commit_stage": stage} if stage else {}),
         "backend": backend,
         "nodes": n_nodes,
         "txns_ordered": done,

@@ -52,6 +52,10 @@ def fold_rows(rows: list[tuple[float, str, dict]]) -> dict[str, dict]:
         agg["flushes"] += 1
         if fold.get("count"):
             agg["last"] = fold["sum"] / fold["count"]
+        # commit-path stage rows carry bounded raw samples (metrics.py
+        # SAMPLED_NAMES) so the report can print honest p50/p95
+        if fold.get("samples"):
+            agg.setdefault("samples", []).extend(fold["samples"][:4096])
     for agg in out.values():
         agg["mean"] = agg["sum"] / agg["count"] if agg["count"] else None
     return out
@@ -124,6 +128,45 @@ def derive_summary(folds: dict[str, dict], span_s: float,
     if (propagate_tx is not None or batch_tx is not None) and txns:
         out["propagate_tx_bytes_per_txn"] = round(
             ((propagate_tx or 0) + (batch_tx or 0)) / txns)
+
+    # post-ordering critical path: per-stage p50/p95 from the raw samples
+    # the commit-path timers flush (bls-verify / apply / durable / reply) —
+    # a latency regression must localize to a stage, not hide in a mean
+    from plenum_tpu.common.metrics import percentile
+    for stage in ("bls_verify", "apply", "durable", "reply"):
+        f = folds.get(f"commit_path.{stage}_time", {})
+        samples = f.get("samples")
+        if samples:
+            out[f"{stage}_ms_p50"] = _ms(percentile(samples, 0.5))
+            out[f"{stage}_ms_p95"] = _ms(percentile(samples, 0.95))
+        elif f.get("mean") is not None:
+            out[f"{stage}_ms_mean"] = _ms(f["mean"])
+    # batched-BLS acceptance counter: Miller loops per ordered batch
+    # (amortized O(1) target: ~2 for a same-message commit set)
+    ppb = folds.get("crypto.pairings_per_batch", {})
+    if ppb.get("mean") is not None:
+        out["pairings_per_batch"] = round(ppb["mean"], 2)
+    if "crypto.pairing_checks" in folds:
+        out["pairing_checks_total"] = int(cum("crypto.pairing_checks") or 0)
+        out["pairings_total"] = int(cum("crypto.pairings") or 0)
+    # group-commit coalescing: ordered batches riding one durable flush
+    gcb = folds.get("node.group_commit_batches", {})
+    if gcb.get("mean") is not None:
+        out["group_commit_batches_mean"] = round(gcb["mean"], 2)
+    # device-plane observability: dispatch counter (sharded plane) +
+    # coalescing-verifier batch stats, which existed as attributes/events
+    # but never reached this report
+    if "crypto.plane_dispatches" in folds:
+        out["plane_dispatches"] = int(cum("crypto.plane_dispatches") or 0)
+    sbs = folds.get("crypto.sig_batch_size", {})
+    if sbs.get("mean") is not None:
+        out["sig_batch_size_mean"] = round(sbs["mean"], 1)
+        out["sig_batches_dispatched"] = int(sbs.get("count") or 0)
+    if mean("crypto.sig_dispatch_time") is not None:
+        out["sig_dispatch_ms_mean"] = _ms(mean("crypto.sig_dispatch_time"))
+    if mean("crypto.sig_batch_fill_time") is not None:
+        out["sig_batch_fill_ms_mean"] = _ms(
+            mean("crypto.sig_batch_fill_time"))
     return {k: v for k, v in out.items() if v is not None}
 
 

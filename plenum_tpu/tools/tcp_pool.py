@@ -237,6 +237,16 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                      "propagate_inbox_depth_max")):
                 if summary.get(src) is not None:
                     result[dst] = summary[src]
+            # commit-path stage percentiles + pairing/group-commit counters
+            # (derive_summary computes them from the flushed raw samples)
+            stage = {k: summary[k] for k in summary
+                     if k.startswith(("bls_verify_ms", "apply_ms",
+                                      "durable_ms", "reply_ms"))
+                     or k in ("pairings_per_batch",
+                              "group_commit_batches_mean",
+                              "plane_dispatches", "sig_batch_size_mean")}
+            if stage:
+                result["commit_stage"] = stage
         except Exception:
             pass                     # byte accounting is best-effort extra
         return result

@@ -235,6 +235,35 @@ def test_out_of_order_commit_quorums_order_sequentially():
         assert seqs == [1, 2], f"{name}: {seqs}"
 
 
+def test_bls_multi_sig_survives_one_bad_signer():
+    """Regression: a batch orders at quorum n-f COMMITs, so with one
+    Byzantine signer among the first arrivals the honest aggregate falls
+    short at order time; the late honest COMMIT (stale for 3PC — its key is
+    already ordered) must still reach the BLS retry, or one bad signer
+    suppresses multi-sigs on most of the pool forever."""
+    pool = PoolSim(with_bls=True)
+
+    class EvilSigner:
+        def __init__(self, inner):
+            self._inner = inner
+        def sign(self, message):
+            return self._inner.sign(b"EVIL " + message)
+
+    evil = pool.names[-1]
+    pool.replicas[evil].bls._signer = EvilSigner(
+        pool.replicas[evil].bls._signer)
+    req = make_request(0)
+    pool.finalize_request(req)
+    pool.run(5.0)
+    assert all(len(pool.ordered[n]) == 1 for n in NODES)
+    o = pool.ordered["Alpha"][0]
+    for name in NODES:
+        ms = pool.replicas[name].bls._recent_multi_sigs.get(o.state_root)
+        assert ms is not None, f"{name} never formed a multi-sig"
+        assert evil not in ms.participants
+        assert len(ms.participants) == 3
+
+
 def test_bls_multi_sig_collected_on_order():
     pool = PoolSim(with_bls=True)
     req = make_request(0)

@@ -251,6 +251,38 @@ def test_metrics_report_reads_flushed_history(tmp_path):
     assert out["Node1"]["summary"]["txns_ordered"] == 150
 
 
+def test_metrics_report_commit_stage_percentiles(tmp_path):
+    """Commit-path stage timers flush bounded RAW samples so the report
+    can print honest p50/p95 per stage (not just fold means), plus the
+    pairings-per-batch and plane-dispatch counters that previously never
+    reached the report."""
+    from plenum_tpu.common.metrics import KvMetricsCollector, MetricsName
+    from plenum_tpu.storage.kv_file import KvFile
+    from plenum_tpu.tools.metrics_report import report_node
+
+    mdir = tmp_path / "Node1" / "metrics"
+    m = KvMetricsCollector(KvFile(str(mdir)), now=lambda: 1000.0)
+    for i in range(100):
+        m.add_event(MetricsName.COMMIT_BLS_VERIFY_TIME, 0.001 * (i + 1))
+        m.add_event(MetricsName.COMMIT_DURABLE_TIME, 0.002)
+        m.add_event(MetricsName.BLS_PAIRINGS_PER_BATCH, 2)
+    m.add_event(MetricsName.SIG_BATCH_SIZE, 512)
+    m.add_event(MetricsName.SIG_PLANE_DISPATCHES, 7)   # cumulative gauge
+    m.add_event(MetricsName.BLS_PAIRING_CHECKS, 100)
+    m.add_event(MetricsName.BLS_PAIRINGS, 200)
+    m.flush()
+
+    folds, summary = report_node(str(mdir), last_s=None)
+    assert summary["bls_verify_ms_p50"] == pytest.approx(51.0, abs=2.0)
+    assert summary["bls_verify_ms_p95"] == pytest.approx(96.0, abs=2.0)
+    assert summary["durable_ms_p50"] == pytest.approx(2.0, abs=0.1)
+    assert summary["pairings_per_batch"] == 2.0
+    assert summary["pairing_checks_total"] == 100
+    assert summary["pairings_total"] == 200
+    assert summary["plane_dispatches"] == 7
+    assert summary["sig_batch_size_mean"] == 512.0
+
+
 def test_distinct_signers_config_orders_owner_writes():
     """config1b: n distinct client keys on the authN hot path — every
     ATTRIB owner-signed by its own DID (authorization: owner-or-trustee),
