@@ -44,6 +44,21 @@ class MetricsName:
     # device-plane dispatch counter (ShardedJaxEd25519Verifier.dispatches,
     # cumulative gauge)
     SIG_PLANE_DISPATCHES = "crypto.plane_dispatches"
+    # plane supervisor (parallel/supervisor.py): breaker state is a gauge
+    # (0 closed / 1 half-open / 2 open, read back via `last`); the rest
+    # are cumulative counters (read back via max); dispatch_budget keeps
+    # raw samples so the report prints the deadline distribution p50/p95
+    CRYPTO_BREAKER_STATE = "crypto.breaker_state"
+    CRYPTO_BREAKER_OPENS = "crypto.breaker_opens"
+    CRYPTO_FALLBACK_BATCHES = "crypto.fallback_batches"
+    CRYPTO_FALLBACK_ITEMS = "crypto.fallback_items"
+    CRYPTO_HEDGE_WINS = "crypto.hedge_wins"
+    CRYPTO_DEADLINE_MISSES = "crypto.deadline_misses"
+    CRYPTO_DISPATCH_BUDGET = "crypto.dispatch_budget"
+    # BLS batch-verify plane counters (crypto/bls.py BATCH_STATS +
+    # ServiceBlsVerifier.stats, cumulative gauges)
+    BLS_BATCH_FALLBACKS = "crypto.bls_batch_fallbacks"
+    BLS_LOCAL_FALLBACKS = "crypto.bls_local_fallbacks"
     # post-ordering critical path, one stage timer each: aggregate COMMIT
     # signature validation, uncommitted apply, the durable group flush,
     # and client REPLY fan-out — regressions must localize to a stage
@@ -195,6 +210,7 @@ SAMPLED_NAMES = frozenset({
     MetricsName.COMMIT_BLS_VERIFY_TIME, MetricsName.COMMIT_APPLY_TIME,
     MetricsName.COMMIT_DURABLE_TIME, MetricsName.COMMIT_REPLY_TIME,
     MetricsName.BLS_PAIRINGS_PER_BATCH,
+    MetricsName.CRYPTO_DISPATCH_BUDGET,
 })
 SAMPLE_CAP = 256
 

@@ -458,6 +458,14 @@ class JaxEd25519Verifier(Ed25519Verifier):
             jnp.asarray(aq_unique), jnp.asarray(idx),
             jnp.asarray(ry), jnp.asarray(r_sign))
 
+    def rewarm(self) -> None:
+        """Plane-supervisor re-warm hook: drop the staged key material so
+        the next dispatch re-uploads it. After a device/relay restart the
+        host-side caches describe uploads the device no longer holds;
+        re-staging them is the cheap insurance that a re-admitted device
+        starts from a known-good session."""
+        self._pt_cache.clear()
+
     # verify_batch = submit + blocking collect; submit_batch returns right
     # after the (asynchronous) device dispatch
     def submit_batch(self, items: Sequence[VerifyItem]):
@@ -636,21 +644,36 @@ class CoalescingVerifier(Ed25519Verifier):
         return self.collect_batch(self.submit_batch(items), wait=True)
 
 
-def make_verifier(backend: str, min_batch: int = 1) -> Ed25519Verifier:
+def make_verifier(backend: str, min_batch: int = 1,
+                  supervised: Optional[bool] = None) -> Ed25519Verifier:
     """min_batch (jax only): pad every dispatch to at least this power of
     two. A pool node should pick one bucket covering its receive quotas so
     XLA compiles exactly ONE program shape — recompiles at novel shapes cost
-    minutes on a tunneled TPU and starve the prod loop."""
+    minutes on a tunneled TPU and starve the prod loop.
+
+    Every DEVICE-backed verifier (jax, jax-sharded, service) comes wrapped
+    in the plane supervisor (parallel/supervisor.py): circuit breaker to
+    CPU fallback, adaptive deadlines with hedged dispatch, and bounded
+    in-flight backpressure — a wedged device degrades the node to CPU
+    speed instead of stalling it (the round-5 relay blackout). Pass
+    supervised=False (or set PLENUM_CRYPTO_SUPERVISOR=0) for the bare
+    verifier."""
+    def _wrap(device):
+        if supervised is False:
+            return device
+        from plenum_tpu.parallel.supervisor import supervise
+        return supervise(device)
+
     if backend == "jax":
-        return JaxEd25519Verifier(min_batch=min_batch)
+        return _wrap(JaxEd25519Verifier(min_batch=min_batch))
     if backend == "jax-sharded":
         # deferred: parallel/ pulls in jax.sharding + the SPMD plane
         from plenum_tpu.parallel.crypto_plane import make_sharded_verifier
-        return make_sharded_verifier(min_batch=min_batch)
+        return _wrap(make_sharded_verifier(min_batch=min_batch))
     if backend == "service":
         # cross-process crypto plane: the device has ONE owner process
         # and co-hosted nodes ship batches to it (socket path from
         # PLENUM_CRYPTO_SOCKET); see parallel/crypto_service.py
         from plenum_tpu.parallel.crypto_service import ServiceEd25519Verifier
-        return ServiceEd25519Verifier()
+        return _wrap(ServiceEd25519Verifier())
     return CpuEd25519Verifier()

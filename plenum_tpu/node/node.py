@@ -451,6 +451,39 @@ class Node:
                 self.metrics.add_event(MetricsName.SIG_PLANE_DISPATCHES,
                                        dispatches)
                 break
+        # plane supervisor: breaker state gauge + fallback/hedge/deadline
+        # cumulative counters + the dispatch-budget distribution — the
+        # degraded-mode story must be VISIBLE in the flushed history
+        # (docs/robustness.md "Degraded modes of the crypto plane")
+        from plenum_tpu.parallel.supervisor import find_supervisor
+        sup = find_supervisor(verifier)
+        if sup is not None:
+            st = sup.supervisor_stats()
+            self.metrics.add_event(MetricsName.CRYPTO_BREAKER_STATE,
+                                   st["breaker_state_code"])
+            self.metrics.add_event(MetricsName.CRYPTO_BREAKER_OPENS,
+                                   st["breaker_opens"])
+            self.metrics.add_event(MetricsName.CRYPTO_FALLBACK_BATCHES,
+                                   st["fallback_batches"])
+            self.metrics.add_event(MetricsName.CRYPTO_FALLBACK_ITEMS,
+                                   st["fallback_items"])
+            self.metrics.add_event(MetricsName.CRYPTO_HEDGE_WINS,
+                                   st["hedge_wins"])
+            self.metrics.add_event(MetricsName.CRYPTO_DEADLINE_MISSES,
+                                   st["deadline_misses"])
+            for budget_s in sup.drain_budget_samples():
+                self.metrics.add_event(MetricsName.CRYPTO_DISPATCH_BUDGET,
+                                       budget_s)
+        # BLS plane health: combined-check fallbacks (process-wide) and,
+        # with the service plane, local-IPC fallback counts
+        from plenum_tpu.crypto.bls import BATCH_STATS
+        self.metrics.add_event(MetricsName.BLS_BATCH_FALLBACKS,
+                               BATCH_STATS["fallbacks"])
+        bls = getattr(self.replicas.master, "bls", None)
+        bls_stats = getattr(getattr(bls, "_verifier", None), "stats", None)
+        if isinstance(bls_stats, dict) and "local_fallbacks" in bls_stats:
+            self.metrics.add_event(MetricsName.BLS_LOCAL_FALLBACKS,
+                                   bls_stats["local_fallbacks"])
 
     def _flush_metrics(self) -> None:
         """Sample process RSS/GC gauges + one last queue sample, then flush

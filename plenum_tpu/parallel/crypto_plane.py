@@ -190,6 +190,17 @@ class ShardedJaxEd25519Verifier(JaxEd25519Verifier):
         self._plane = plane
         self._grid = (inst, sig)
         self.dispatches = 0          # observability for tests/metrics
+        self.rewarms = 0
+
+    def rewarm(self) -> None:
+        """Plane-supervisor re-warm hook: drop the staged quarter-point
+        key rows so the next dispatch re-uploads the replicated verkey
+        table to every shard (after a mesh/relay restart the device-side
+        copies are gone; the compiled SPMD program itself persists in the
+        XLA cache, and the supervisor's probe batch re-validates it at a
+        compiled shape before traffic is re-admitted)."""
+        super().rewarm()
+        self.rewarms += 1
 
     def _device_verify_bytes(self, s_u8, h_u8, k_u8, idx, r_u8):
         """The compressed staging reshaped onto the plane's grid; the

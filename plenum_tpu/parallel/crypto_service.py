@@ -40,6 +40,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -91,6 +92,21 @@ class CryptoPlaneServer:
 
     # --- worker thread: the only place the inner verifier runs ----------
 
+    def _plane_fault(self, counter: str) -> None:
+        """EVERY swallowed worker-loop error lands here: a named counter
+        (ops can tell collect stalls from submit failures from cycle
+        bugs), the legacy aggregate, and — when the inner verifier is
+        supervised — a breaker feed, so repeated device faults open the
+        circuit even for error paths the supervisor itself never saw."""
+        self.stats[counter] = self.stats.get(counter, 0) + 1
+        self.stats["errors"] = self.stats.get("errors", 0) + 1
+        breaker = getattr(self._inner, "breaker", None)
+        if breaker is not None:
+            try:
+                breaker.record_failure()
+            except Exception:
+                pass
+
     def _drain(self, first) -> list:
         jobs = [first]
         while True:
@@ -139,7 +155,11 @@ class CryptoPlaneServer:
             try:
                 done(err if err is not None else out)
             except Exception:
-                pass   # loop closing mid-shutdown: nothing to notify
+                # loop closing mid-shutdown: nothing to notify — but NEVER
+                # silently (a growing counter here means live clients are
+                # not receiving verdicts, which is a plane fault)
+                self.stats["notify_failures"] = \
+                    self.stats.get("notify_failures", 0) + 1
 
         def _land(block: bool) -> bool:
             """Try to retire the oldest in-flight wave. -> landed?"""
@@ -157,7 +177,7 @@ class CryptoPlaneServer:
                 return False
             waves.popleft()
             if isinstance(verdicts, str):
-                self.stats["errors"] = self.stats.get("errors", 0) + 1
+                self._plane_fault("collect_errors")
                 recent[wave["seq"]] = verdicts
             else:
                 self.stats["dispatches"] += 1
@@ -231,7 +251,7 @@ class CryptoPlaneServer:
                 token = self._inner.submit_batch(items)
             except Exception as e:
                 recent[seq] = f"{type(e).__name__}: {e}"
-                self.stats["errors"] = self.stats.get("errors", 0) + 1
+                self._plane_fault("submit_errors")
                 for d in todo:
                     if pending.get(d) == seq:
                         del pending[d]
@@ -257,12 +277,12 @@ class CryptoPlaneServer:
             except Exception:
                 # LAST-RESORT guard: a bug anywhere in the cycle must not
                 # kill this thread — a dead worker silently wedges every
-                # co-hosted node. Stats record the event for ops; the
-                # cycle's wave state is self-healing (jobs of a wave that
-                # never lands resolve as errors when it is pruned, and
-                # clients fall back locally on error replies).
-                self.stats["worker_faults"] = \
-                    self.stats.get("worker_faults", 0) + 1
+                # co-hosted node. Named counter + breaker feed (never a
+                # bare swallow); the cycle's wave state is self-healing
+                # (jobs of a wave that never lands resolve as errors when
+                # it is pruned, and clients fall back locally on error
+                # replies).
+                self._plane_fault("worker_faults")
 
     # --- asyncio front end ----------------------------------------------
 
@@ -325,8 +345,13 @@ class CryptoPlaneServer:
         rid = None
         try:
             if req.get("op") == "stats":
-                payload = pack(dict(self.stats,
-                                    cache_size=len(self._cache)))
+                out = dict(self.stats, cache_size=len(self._cache))
+                sup = getattr(self._inner, "supervisor_stats", None)
+                if callable(sup):
+                    # breaker state / fallbacks / hedge wins of the
+                    # supervised device plane, readable over the socket
+                    out["plane"] = sup()
+                payload = pack(out)
             elif "bls" in req:
                 # [[sig_b58, msg_bytes, [verkey_b58...]], ...] -> bools.
                 # Pairings run in the default executor (the BN254 ctypes
@@ -373,7 +398,10 @@ class CryptoPlaneServer:
                 writer.write(_LEN.pack(len(payload)) + payload)
                 await writer.drain()
         except Exception:
-            writer.close()              # dead writer: drop the connection
+            # dead writer: drop the connection — counted, a rising rate
+            # means clients are dying mid-reply (relay/network trouble)
+            self.stats["dead_writers"] = self.stats.get("dead_writers", 0) + 1
+            writer.close()
 
     async def _handle(self, reader, writer) -> None:
         wlock = asyncio.Lock()
@@ -392,8 +420,10 @@ class CryptoPlaneServer:
             pass
         except Exception:
             # malformed frame (bad msgpack, wrong schema): drop THIS
-            # connection; the plane itself must survive garbage clients
-            pass
+            # connection; the plane itself must survive garbage clients —
+            # counted so a flood of garbage is visible in the stats op
+            self.stats["bad_connections"] = \
+                self.stats.get("bad_connections", 0) + 1
         finally:
             for t in tasks:
                 t.cancel()
@@ -435,27 +465,100 @@ class ServiceEd25519Verifier(Ed25519Verifier):
 
     def __init__(self, socket_path: Optional[str] = None,
                  connect_timeout: float = 5.0,
-                 request_timeout: float = 300.0):
+                 request_timeout: float = 300.0,
+                 warm_timeout: float = 30.0):
         self.socket_path = socket_path or os.environ.get(
             "PLENUM_CRYPTO_SOCKET", DEFAULT_SOCKET)
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.settimeout(connect_timeout)
-        self._sock.connect(self.socket_path)   # fail fast: operator error
-        # blocking recv wears a generous deadline (service-side jax kernel
-        # compile can take ~2 min per shape) so a wedged service surfaces
-        # as ConnectionError -> local fallback, never an infinite hang
+        self._connect_timeout = connect_timeout
+        # PER-REQUEST deadline budget (replaces the old flat 300 s recv
+        # timeout, which made a wedged relay cost 5 minutes PER BATCH):
+        # deadline = base + n_items * rolling-p99 per-item cost, clamped.
+        # request_timeout survives as the COLD ceiling — the first
+        # dispatch on a fresh service may sit behind a multi-minute XLA
+        # compile — and warm_timeout caps every budget after the first
+        # success, so a mid-run wedge costs one bounded miss.
+        from plenum_tpu.parallel.supervisor import DeadlineBudget
         self._request_timeout = request_timeout
-        self._sock.settimeout(request_timeout)
+        self._budget = DeadlineBudget(base=2.0, per_item_initial=0.01,
+                                      margin=8.0, min_s=1.0,
+                                      warm_max=warm_timeout,
+                                      cold_max=request_timeout)
         self._lock = threading.Lock()
         self._next_id = 0
         self._replies: dict[int, list] = {}
+        # rid -> (t0, n, deadline): the deadline is FIXED at submit time —
+        # a cold request that was promised the compile ceiling must not be
+        # re-judged by the warmed (shorter) budget at collect time
+        self._meta: dict[int, tuple[float, int, float]] = {}
+        self._discarded: set[int] = set()
         # partial frame bytes survive across non-blocking polls — throwing
         # them away on BlockingIOError would desync the framing forever
         self._rxbuf = b""
+        self._connect()                        # fail fast: operator error
 
-    def _send(self, obj) -> None:
-        payload = pack(obj)
+    def _connect(self) -> None:
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(self._connect_timeout)
+        self._sock.connect(self.socket_path)
+        self._sock.settimeout(self._budget.budget(1))
+        self._rxbuf = b""
+
+    def reconnect(self) -> None:
+        """Fresh socket to the service; in-flight replies are abandoned
+        (their callers see ConnectionError from the closed old socket).
+        The plane supervisor calls this as its re-warm step before
+        re-admitting the service after an open circuit."""
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._replies.clear()
+            self._meta.clear()
+            self._discarded.clear()
+            self._connect()
+
+    # supervisor re-warm hook: a reconnect IS the client-side re-warm
+    # (server-side key caches re-fill on the wire from the next dispatch)
+    rewarm = reconnect
+
+    def discard(self, token) -> None:
+        """Abandon a request: a reply landing later is dropped instead of
+        accumulating forever in the reply map (the supervisor discards
+        hedged-and-reaped tokens through this)."""
+        rid = token[0]
+        with self._lock:
+            self._discarded.add(rid)
+            self._replies.pop(rid, None)
+            self._meta.pop(rid, None)
+            if len(self._discarded) > 4096:
+                self._discarded.clear()   # ancient rids can't collide soon
+
+    def _deadline_for(self, rid: int) -> float:
+        meta = self._meta.get(rid)
+        if meta is None:
+            return time.monotonic() + self._budget.budget(1)
+        return meta[2]
+
+    def _submit_send(self, rid: int, obj, n_items: int) -> None:
+        """Register (t0, n, deadline) and send; the meta entry must not
+        outlive a failed send (an unsupervised client retrying against a
+        down service would otherwise leak one tuple per attempt)."""
+        t0 = time.monotonic()
+        deadline = t0 + self._budget.budget(n_items)
+        self._meta[rid] = (t0, n_items, deadline)
         try:
+            self._send(obj, deadline=deadline)
+        except Exception:
+            self._meta.pop(rid, None)
+            raise
+
+    def _send(self, obj, deadline: Optional[float] = None) -> None:
+        payload = pack(obj)
+        budget = (deadline - time.monotonic()) if deadline is not None \
+            else self._budget.budget(1)
+        try:
+            self._sock.settimeout(max(0.05, budget))
             self._sock.sendall(_LEN.pack(len(payload)) + payload)
         except socket.timeout:
             # a timed-out sendall may have written a PARTIAL frame; the
@@ -463,8 +566,8 @@ class ServiceEd25519Verifier(Ed25519Verifier):
             # use fails loudly instead of desyncing the stream
             self._sock.close()
             raise ConnectionError(
-                f"crypto service send stalled for "
-                f"{self._request_timeout:.0f}s (socket closed)") from None
+                f"crypto service send stalled past its "
+                f"{budget:.1f}s budget (socket closed)") from None
 
     def _parse_frame(self):
         if len(self._rxbuf) < 4:
@@ -476,15 +579,21 @@ class ServiceEd25519Verifier(Ed25519Verifier):
         self._rxbuf = self._rxbuf[4 + length:]
         return unpack(payload)
 
-    def _recv(self, block: bool = True):
+    def _recv(self, block: bool = True, deadline: Optional[float] = None):
         """Next complete frame, buffering partial reads. None when
-        non-blocking and no complete frame is available yet."""
+        non-blocking and no complete frame is available yet. Blocking
+        reads honor the caller's per-request deadline (adaptive budget,
+        not the old flat timeout)."""
         while True:
             frame = self._parse_frame()
             if frame is not None:
                 return frame
             if block:
+                remaining = (deadline - time.monotonic()
+                             if deadline is not None
+                             else self._budget.budget(1))
                 try:
+                    self._sock.settimeout(max(0.05, remaining))
                     chunk = self._sock.recv(65536)
                 except socket.timeout:
                     # caller abandons the request; a reply landing later
@@ -492,9 +601,9 @@ class ServiceEd25519Verifier(Ed25519Verifier):
                     # the wedged-service state is unambiguous
                     self._sock.close()
                     raise ConnectionError(
-                        f"crypto service unresponsive for "
-                        f"{self._request_timeout:.0f}s (socket closed)"
-                    ) from None
+                        f"crypto service unresponsive past its "
+                        f"{max(0.05, remaining):.1f}s deadline budget "
+                        f"(socket closed)") from None
             else:
                 self._sock.setblocking(False)
                 try:
@@ -502,28 +611,42 @@ class ServiceEd25519Verifier(Ed25519Verifier):
                 except BlockingIOError:
                     return None
                 finally:
-                    self._sock.settimeout(self._request_timeout)
+                    self._sock.settimeout(self._budget.budget(1))
             if not chunk:
                 raise ConnectionError("crypto service closed")
             self._rxbuf += chunk
+
+    def _stash_reply(self, reply: dict) -> None:
+        rid = reply.get("id")
+        if rid in self._discarded:
+            self._discarded.discard(rid)       # abandoned: drop on arrival
+            self._meta.pop(rid, None)
+            return
+        self._replies[rid] = reply
 
     def submit_batch(self, items: Sequence[VerifyItem]):
         items = [(bytes(m), bytes(s), bytes(v)) for m, s, v in items]
         with self._lock:
             rid = self._next_id
             self._next_id += 1
-            self._send({"id": rid, "items": items})
+            self._submit_send(rid, {"id": rid, "items": items},
+                              max(1, len(items)))
         return (rid, len(items))
 
     def collect_batch(self, token, wait: bool = True):
         rid, n = token
         with self._lock:
+            deadline = self._deadline_for(rid)
             while rid not in self._replies:
-                reply = self._recv(block=wait)
+                reply = self._recv(block=wait, deadline=deadline)
                 if reply is None:
                     return None
-                self._replies[reply["id"]] = reply
+                self._stash_reply(reply)
             reply = self._replies.pop(rid)
+            meta = self._meta.pop(rid, None)
+            if meta is not None and "error" not in reply:
+                # successful round-trip: tighten the rolling budget
+                self._budget.record(meta[1], time.monotonic() - meta[0])
         if "error" in reply:
             # backend/device failure or a request the server rejected —
             # loud, not a silent all-False verdict (which would read as
@@ -541,8 +664,9 @@ class ServiceEd25519Verifier(Ed25519Verifier):
         with self._lock:
             rid = self._next_id
             self._next_id += 1
-            self._send({"id": rid, "bls": [[signature, bytes(message),
-                                            list(verkeys)]]})
+            self._submit_send(rid, {"id": rid,
+                                    "bls": [[signature, bytes(message),
+                                             list(verkeys)]]}, 1)
         reply = self.collect_batch((rid, 1), wait=True)
         return bool(reply[0])
 
@@ -554,11 +678,12 @@ class ServiceEd25519Verifier(Ed25519Verifier):
 
     def stats(self) -> dict:
         with self._lock:
-            self._send({"op": "stats"})
+            deadline = time.monotonic() + 10.0
+            self._send({"op": "stats"}, deadline=deadline)
             while True:
-                reply = self._recv()
+                reply = self._recv(deadline=deadline)
                 if "id" in reply:        # verify reply racing ahead of ours
-                    self._replies[reply["id"]] = reply
+                    self._stash_reply(reply)
                     continue
                 return reply
 
@@ -571,11 +696,17 @@ class ServiceBlsVerifier:
     pairing). Everything else (PoP, well-formedness, aggregation)
     delegates to the local implementation."""
 
-    def __init__(self, socket_path: Optional[str] = None):
+    def __init__(self, socket_path: Optional[str] = None, breaker=None):
         from plenum_tpu.crypto import bls as _bls
+        from plenum_tpu.parallel.supervisor import CircuitBreaker
         self._local = _bls.BlsCryptoVerifier()
         self._bls_mod = _bls
         self._client = ServiceEd25519Verifier(socket_path=socket_path)
+        # breaker over the IPC path: a dead plane costs ONE bounded miss
+        # per cooldown window, not one socket deadline per aggregate check
+        self.breaker = breaker or CircuitBreaker(fail_threshold=3,
+                                                 cooldown=5.0)
+        self.stats = {"ipc_checks": 0, "local_fallbacks": 0}
 
     def verify_multi_sig(self, signature: str, message: bytes,
                          verkeys) -> bool:
@@ -588,12 +719,36 @@ class ServiceBlsVerifier:
         hit = b._BLS_VERDICTS.get(key)
         if hit is not None:
             return hit
+        from plenum_tpu.parallel import supervisor as _sup
+        probing = False
+        if self.breaker.state != _sup.CLOSED:
+            if not self.breaker.probe_due():
+                # circuit open: verify locally, instantly
+                self.stats["local_fallbacks"] += 1
+                return self._local.verify_multi_sig(signature, message,
+                                                    verkeys)
+            # half-open: this very check doubles as the probe; re-warm
+            # (fresh socket) before re-admitting the plane
+            probing = True
+            self.breaker.to_half_open()
         try:
+            if probing:
+                self._client.reconnect()
             verdict = self._client.verify_bls_multi(signature, message,
                                                     verkeys)
+            self.stats["ipc_checks"] += 1
+            if probing:
+                self.breaker.close()
+            else:
+                self.breaker.record_success()
         except (OSError, RuntimeError, ConnectionError):
             # plane down mid-run: verify locally rather than stalling
             # consensus on an ops failure
+            if probing:
+                self.breaker.reopen()
+            else:
+                self.breaker.record_failure()
+            self.stats["local_fallbacks"] += 1
             return self._local.verify_multi_sig(signature, message, verkeys)
         return b._bls_cache_put(key, verdict)
 
@@ -654,15 +809,24 @@ def main(argv=None):
     ap.add_argument("--backend", default="cpu",
                     choices=["cpu", "jax", "jax-sharded"])
     ap.add_argument("--min-batch", type=int, default=128)
+    ap.add_argument("--no-supervisor", action="store_true",
+                    help="run the device verifier bare (no breaker / "
+                         "hedged CPU fallback) — debugging only")
     args = ap.parse_args(argv)
 
-    inner = make_verifier(args.backend, min_batch=args.min_batch)
+    # device backends come supervised from the factory: a wedged device
+    # behind this service degrades every client to CPU-speed verdicts
+    # instead of erroring (or stalling) each batch
+    inner = make_verifier(args.backend, min_batch=args.min_batch,
+                          supervised=False if args.no_supervisor else None)
     server = CryptoPlaneServer(inner, socket_path=args.socket)
 
     async def run():
         await server.start()
         print(json.dumps({"crypto_service": args.socket,
-                          "backend": args.backend}), flush=True)
+                          "backend": args.backend,
+                          "supervised": hasattr(inner, "supervisor_stats")}),
+              flush=True)
         try:
             while True:
                 await asyncio.sleep(3600)

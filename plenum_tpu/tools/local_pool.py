@@ -85,7 +85,12 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1):
         bucket = 1
         while bucket < n_nodes * per_node:
             bucket *= 2
-        plane = CoalescingVerifier(JaxEd25519Verifier(min_batch=bucket))
+        # supervised: a device/tunnel wedge mid-bench degrades the pool to
+        # CPU-speed verdicts (breaker + hedged fallback) instead of
+        # blanking the run — the bench line then reports backend_state
+        from plenum_tpu.parallel.supervisor import supervise
+        plane = CoalescingVerifier(supervise(
+            JaxEd25519Verifier(min_batch=bucket)))
     for name in names:
         bus = net.create_peer(name)
         components = NodeBootstrap(name, genesis_txns=genesis,
@@ -198,8 +203,22 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                        for d in first_reply if d in submit_times)
     sizes = {nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size for n in names}
     stage = commit_stage_stats(nodes[names[0]].metrics)
+    plane_stats = None
+    if plane is not None:
+        from plenum_tpu.parallel.supervisor import find_supervisor
+        sup = find_supervisor(plane)
+        if sup is not None:
+            st = sup.supervisor_stats()
+            plane_stats = {k: st[k] for k in
+                           ("breaker_state", "breaker_opens",
+                            "fallback_batches", "hedge_wins",
+                            "deadline_misses", "device_batches")}
     return {
         **({"commit_stage": stage} if stage else {}),
+        **({"crypto_plane": plane_stats,
+            "backend_state": {"closed": "ok", "half_open": "fallback",
+                              "open": "open"}[plane_stats["breaker_state"]]}
+           if plane_stats else {}),
         "backend": backend,
         "nodes": n_nodes,
         "txns_ordered": done,

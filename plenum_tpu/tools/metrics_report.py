@@ -167,6 +167,33 @@ def derive_summary(folds: dict[str, dict], span_s: float,
     if mean("crypto.sig_batch_fill_time") is not None:
         out["sig_batch_fill_ms_mean"] = _ms(
             mean("crypto.sig_batch_fill_time"))
+    # plane supervisor: the degraded-mode story an operator actually
+    # checks — breaker state (latest gauge), fallback volume, hedge wins,
+    # deadline misses, and the dispatch-budget distribution p50/p95
+    # (docs/robustness.md "Degraded modes of the crypto plane")
+    bs = folds.get("crypto.breaker_state", {})
+    if bs.get("last") is not None:
+        out["crypto_breaker_state"] = {0: "closed", 1: "half_open",
+                                       2: "open"}.get(int(bs["last"]),
+                                                      "unknown")
+        out["crypto_breaker_opens"] = int(cum("crypto.breaker_opens") or 0)
+        out["crypto_fallback_batches"] = int(
+            cum("crypto.fallback_batches") or 0)
+        out["crypto_fallback_items"] = int(
+            cum("crypto.fallback_items") or 0)
+        out["crypto_hedge_wins"] = int(cum("crypto.hedge_wins") or 0)
+        out["crypto_deadline_misses"] = int(
+            cum("crypto.deadline_misses") or 0)
+    budget = folds.get("crypto.dispatch_budget", {})
+    if budget.get("samples"):
+        out["deadline_ms_p50"] = _ms(percentile(budget["samples"], 0.5))
+        out["deadline_ms_p95"] = _ms(percentile(budget["samples"], 0.95))
+    if "crypto.bls_batch_fallbacks" in folds:
+        out["bls_batch_fallbacks"] = int(
+            cum("crypto.bls_batch_fallbacks") or 0)
+    if "crypto.bls_local_fallbacks" in folds:
+        out["bls_local_fallbacks"] = int(
+            cum("crypto.bls_local_fallbacks") or 0)
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -247,6 +247,21 @@ def run_tcp_pool(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                               "plane_dispatches", "sig_batch_size_mean")}
             if stage:
                 result["commit_stage"] = stage
+            # plane-supervisor health: breaker state, fallback volume,
+            # hedge wins, deadline distribution (degraded-mode acceptance:
+            # these must be on the bench line, not buried in a KV store).
+            # Gated on the breaker gauge: only configs that actually RAN a
+            # device plane report a backend_state — a pure-CPU pool must
+            # not claim a healthy device it never had.
+            if "crypto_breaker_state" in summary:
+                plane = {k: summary[k] for k in summary
+                         if k.startswith(("crypto_", "deadline_ms_",
+                                          "bls_batch", "bls_local"))}
+                result["crypto_plane"] = plane
+                result["backend_state"] = {
+                    "closed": "ok", "half_open": "fallback",
+                    "open": "open"}.get(
+                        plane["crypto_breaker_state"], "ok")
         except Exception:
             pass                     # byte accounting is best-effort extra
         return result

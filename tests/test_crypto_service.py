@@ -181,9 +181,64 @@ def test_backend_failure_is_loud_and_worker_survives(tmp_path):
         ver.verify_batch(items)
     # the worker survived: the next dispatch succeeds
     assert ver.verify_batch(items).all()
+    # the swallow was NAMED, not silent: the audit counters recorded it
+    assert server.stats.get("submit_errors", 0) + \
+        server.stats.get("collect_errors", 0) >= 1
     ver.close()
     server._stop.set()
     t.join(timeout=5.0)
+
+
+def test_supervised_inner_degrades_server_to_cpu_not_errors(tmp_path):
+    """The production server topology: its inner device verifier rides
+    the plane supervisor, so a wedged device yields CPU-hedged VERDICTS
+    to every client — not error replies — and the stats op exposes the
+    breaker state over the socket."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.crypto_service import (CryptoPlaneServer,
+                                                    ServiceEd25519Verifier)
+    from plenum_tpu.parallel.faults import FaultyVerifier
+    from plenum_tpu.parallel.supervisor import (CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    device = FaultyVerifier(CpuEd25519Verifier())
+    inner = SupervisedVerifier(
+        device, fallback=CpuEd25519Verifier(),
+        breaker=CircuitBreaker(fail_threshold=2, cooldown=30.0),
+        budget=DeadlineBudget(base=0.2, min_s=0.15, warm_max=0.3,
+                              cold_max=0.3))
+    sock = str(tmp_path / "crypto.sock")
+    server = CryptoPlaneServer(inner, socket_path=sock)
+    started = threading.Event()
+
+    def runner():
+        async def run():
+            await server.start()
+            started.set()
+            while not server._stop.is_set():
+                await asyncio.sleep(0.02)
+        asyncio.new_event_loop().run_until_complete(run())
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    assert started.wait(5.0)
+    try:
+        ver = ServiceEd25519Verifier(socket_path=sock)
+        good = _make_items(3, tag=b"sup-ok")
+        assert ver.verify_batch(good).all()
+        device.wedge()
+        mixed = _make_items(4, tag=b"sup-wedge")
+        mixed[1] = (mixed[1][0], mixed[1][1][:32] + bytes(32), mixed[1][2])
+        # verdicts, not errors: the server hedged on its CPU fallback
+        out = ver.verify_batch(mixed)
+        assert list(out) == [True, False, True, True]
+        stats = ver.stats()
+        assert stats["plane"]["hedge_wins"] >= 1
+        assert stats["plane"]["verdict_forks"] == 0
+        ver.close()
+    finally:
+        server._stop.set()
+        t.join(timeout=5.0)
 
 
 def test_bls_checks_ride_the_plane_and_dedupe(service):

@@ -227,8 +227,12 @@ def test_pool_jax_backend_end_to_end():
     cycle) and every ledger uses the jax-backed tree hasher. Slow: the
     kernel compiles once for the pool's dispatch bucket."""
     pool = Pool(config=Config(Max3PCBatchWait=0.05, crypto_backend="jax"))
-    assert type(pool.nodes["Alpha"].c.authenticator.core_authenticator
-                .verifier).__name__ == "JaxEd25519Verifier"
+    verifier = pool.nodes["Alpha"].c.authenticator.core_authenticator.verifier
+    # device backends come supervised from the factory (breaker + hedged
+    # CPU fallback); the device underneath is the jax kernel verifier
+    from plenum_tpu.parallel.supervisor import SupervisedVerifier
+    assert isinstance(verifier, SupervisedVerifier)
+    assert type(verifier._device).__name__ == "JaxEd25519Verifier"
     user = Ed25519Signer(seed=b"jax-pool-user".ljust(32, b"\0"))
     pool.submit(signed_nym(pool.trustee, user, 1))
     pool.run(10.0)

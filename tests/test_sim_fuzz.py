@@ -241,6 +241,120 @@ def run_scenario(seed: int) -> None:
     assert_safety(pool)
 
 
+# --- scenario kind `device_flap`: the crypto plane is the fault -------------
+# A seed-driven relay wedge/drop/corrupt hits the pool's SHARED device
+# verifier mid-consensus. The plane supervisor must degrade every node to
+# hedged CPU verdicts (no request stalls past its per-batch deadline
+# budget — measured from the supervisor's stall accounting, not asserted
+# by sleeping), keep ordering throughout, and after the seeded heal the
+# breaker must re-warm + re-admit the device with ordering latency back
+# at the pre-fault level. Runs as its OWN seed sweep rather than widening
+# run_scenario's rng.integer(0, 5) draw, which would silently remap every
+# historical seed of the six existing kinds.
+
+
+def _order_and_time(pool, req, expect_size: float, timeout: float = 25.0):
+    """Submit and run until every node's domain ledger reaches
+    expect_size; -> sim seconds it took, or None on timeout."""
+    t0 = pool.timer.get_current_time()
+    pool.submit(req)
+    elapsed = 0.0
+    while elapsed < timeout:
+        pool.run(0.5)
+        elapsed += 0.5
+        if all(len(_domain_txns(pool.nodes[n])) >= expect_size
+               for n in pool.names):
+            return pool.timer.get_current_time() - t0
+    return None
+
+
+def run_device_flap_scenario(seed: int) -> None:
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.faults import FaultyVerifier
+    from plenum_tpu.parallel.supervisor import (CLOSED, CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    rng = SimRandom(seed * 104729 + 71)
+    faulty = FaultyVerifier(CpuEd25519Verifier())
+    sup = SupervisedVerifier(
+        faulty, fallback=CpuEd25519Verifier(),
+        breaker=CircuitBreaker(fail_threshold=2,
+                               cooldown=rng.float(0.5, 1.5)),
+        budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
+                              warm_max=1.0, cold_max=1.0))
+    pool = Pool(seed=seed, config=Config(**FAST), verifier=sup)
+    # the supervisor's whole state machine runs on SIM time: any failing
+    # seed replays exactly
+    sup.set_clock(pool.timer.get_current_time)
+    faulty.set_clock(pool.timer.get_current_time)
+
+    users = [Ed25519Signer(seed=(b"flap%d-%d" % (seed, i))
+                           .ljust(32, b"\0")[:32]) for i in range(4)]
+    reqs = [signed_nym(pool.trustee, u, i + 1) for i, u in enumerate(users)]
+
+    # pre-fault: device-backed ordering, timed
+    pre = _order_and_time(pool, reqs[0], 2)
+    assert pre is not None, f"seed {seed}: healthy pool failed to order"
+    assert sup.stats["device_batches"] >= 1, "traffic never hit the device"
+
+    # fault the plane MID-consensus: request in flight, then the relay
+    # wedges (replies lost) / drops (refuses) / corrupts (dies mid-read)
+    kind = ("wedge", "drop", "corrupt")[rng.integer(0, 2)]
+    pool.submit(reqs[1])
+    pool.run(rng.float(0.0, 0.3))
+    getattr(faulty, kind)()
+    during = _order_and_time(pool, reqs[2], 4)
+    assert during is not None, \
+        f"seed {seed}: pool stopped ordering under device {kind}"
+    st = sup.supervisor_stats()
+    assert st["fallback_batches"] >= 1, \
+        f"seed {seed}: no CPU fallback recorded under {kind}"
+    # MEASURED stall bound: no dispatch waited past its deadline budget
+    # (+2 prod ticks of poll granularity)
+    assert st["max_stall_s"] <= st["max_budget_s"] + 0.3, \
+        f"seed {seed}: stall {st['max_stall_s']:.2f}s past budget " \
+        f"{st['max_budget_s']:.2f}s"
+
+    # heal: traffic drives the cooldown -> probe -> re-warm -> re-admit
+    faulty.heal()
+    waited = 0.0
+    while sup.breaker.state != CLOSED and waited < 30.0:
+        pool.run(1.0)
+        waited += 1.0
+        # probes only advance on plane calls; idle pools still heal
+        # because periodic node traffic (freshness checks) may be sparse,
+        # so nudge with a tiny verify
+        sup.verify_batch([(b"heal-nudge-%d-%f" % (seed, waited),
+                           b"\0" * 64, b"\0" * 32)])
+    assert sup.breaker.state == CLOSED, \
+        f"seed {seed}: breaker never re-closed after heal ({kind})"
+    assert st["verdict_forks"] == 0 and \
+        sup.stats["verdict_forks"] == 0, "hedge forked backend verdicts"
+    assert faulty.rewarms >= 1, "re-admission skipped the re-warm"
+
+    # recovery: post-heal ordering latency back at the pre-fault level
+    post = _order_and_time(pool, reqs[3], 5)
+    assert post is not None, f"seed {seed}: pool dead after heal"
+    assert post <= pre + 1.5, \
+        f"seed {seed}: post-heal ordering {post:.1f}s vs pre {pre:.1f}s"
+    tok = sup.submit_batch([(b"readmit-%d" % seed, b"\0" * 64, b"\0" * 32)])
+    assert tok.kind == "dev", "device not re-admitted after close"
+    sup.collect_batch(tok)
+    assert_safety(pool)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_device_flap_fuzz(bucket):
+    for seed in range(bucket * 5, (bucket + 1) * 5):
+        run_device_flap_scenario(seed)
+
+
+def test_sim_device_flap_smoke():
+    """One device_flap scenario always runs in the default suite."""
+    run_device_flap_scenario(3)
+
+
 # 100 seeds, bucketed so failures show their seed range and xdist can split
 @pytest.mark.slow
 @pytest.mark.parametrize("bucket", range(10))
