@@ -283,6 +283,17 @@ def main():
                 c5["propagate_bytes_per_txn"]
         if c5.get("commit_stage"):
             result["config5_commit_stage"] = c5["commit_stage"]
+        # verified read plane acceptance: reads/s at 90:10 read:write,
+        # measured per-read fanout (target 2 vs legacy 2n), and the
+        # client-side proof-verify p50/p95 the read budget rides on
+        c6 = bc.config6_read_plane(n_reads=1800)
+        result["config6_verified_reads_per_s"] = c6.get("reads_per_s",
+                                                        c6.get("error"))
+        for k in ("read_fanout", "legacy_read_fanout", "verify_ms_p50",
+                  "verify_ms_p95", "failovers", "fallbacks",
+                  "server_cache_hit_rate"):
+            if c6.get(k) is not None:
+                result[f"config6_{k}"] = c6[k]
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))

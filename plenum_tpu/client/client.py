@@ -8,6 +8,7 @@ The transport is the framework's length-prefixed msgpack client framing
 from __future__ import annotations
 
 import asyncio
+import hashlib
 from typing import Any, Optional
 
 from plenum_tpu.common.request import Request
@@ -67,9 +68,14 @@ class PoolClient:
                 if not isinstance(msg, dict):
                     continue
                 if msg.get("op") == "REPLY":
-                    txn = msg.get("result", {})
-                    meta = txn.get("txn", {}).get("metadata", {})
+                    result = msg.get("result", {})
+                    meta = result.get("txn", {}).get("metadata", {})
                     if (meta.get("from"), meta.get("reqId")) == req_key:
+                        return msg
+                    # read replies carry no txn metadata; the read plane
+                    # echoes the asker at the result's top level instead
+                    if (result.get("identifier"),
+                            result.get("reqId")) == req_key:
                         return msg
                 elif msg.get("op") in ("REQNACK", "REJECT") and \
                         (msg.get("identifier"),
@@ -77,6 +83,33 @@ class PoolClient:
                     return msg
         except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError):
             return None
+
+    @staticmethod
+    def _vote_key(msg: dict) -> tuple:
+        """Quorum bucket for one node's reply. Write replies vote by txn
+        identity (seqNo + request digest from the txn metadata). Read
+        replies have NO txn metadata — keying them by the (absent)
+        metadata would let nodes returning DIFFERENT read data all count
+        toward one f+1 bucket, so they vote by a digest of the result's
+        DATA content — minus everything that legitimately varies between
+        HONEST nodes: the per-request echo fields (vary by asker) and
+        every proof attachment (read_proof, state_proof, merkle_proof).
+        Proofs are advisory, unsigned-by-this-quorum material that
+        honest nodes at different commit points or with different
+        aggregated COMMIT-sig subsets produce differently — voting on
+        them would split identical answers into separate buckets and
+        starve the quorum."""
+        if msg.get("op") != "REPLY":
+            return (msg.get("op"), msg.get("reason"))
+        result = msg.get("result", {})
+        meta = result.get("txn", {}).get("metadata", {})
+        if meta.get("digest"):
+            return ("REPLY", result.get("txnMetadata", {}).get("seqNo"),
+                    meta.get("digest"))
+        core = {k: v for k, v in result.items()
+                if k not in ("identifier", "reqId", "read_proof",
+                             "state_proof", "merkle_proof")}
+        return ("REPLY", hashlib.sha256(pack(core)).hexdigest())
 
     async def submit(self, request: Request, timeout: float = 30.0) -> dict:
         """Send to all nodes; resolve when f+1 nodes agree on the outcome.
@@ -95,12 +128,7 @@ class PoolClient:
         for msg in results:
             if msg is None:
                 continue
-            if msg.get("op") == "REPLY":
-                meta = msg["result"].get("txn", {}).get("metadata", {})
-                key = ("REPLY", msg["result"].get("txnMetadata", {})
-                       .get("seqNo"), meta.get("digest"))
-            else:
-                key = (msg.get("op"), msg.get("reason"))
+            key = self._vote_key(msg)
             count, _ = votes.get(key, (0, msg))
             votes[key] = (count + 1, msg)
         for count, msg in votes.values():

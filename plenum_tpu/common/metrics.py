@@ -68,6 +68,17 @@ class MetricsName:
     COMMIT_REPLY_TIME = "commit_path.reply_time"
     # ordered batches riding ONE durable flush (group commit coalescing)
     GROUP_COMMIT_BATCHES = "node.group_commit_batches"
+    # verified read plane (reads/plane.py): one event per tick's query
+    # batch (fold sum = total queries, fold mean = mean batch size), the
+    # proof-generation stage timer (sampled -> p50/p95 in the report),
+    # and cumulative cache/proof gauges sampled at flush
+    READ_QUERIES = "read_plane.queries"
+    READ_PROOF_GEN_TIME = "read_plane.proof_gen_time"
+    READ_CACHE_HITS = "read_plane.cache_hits"
+    READ_PROOFS_STATE = "read_plane.proofs_state"
+    READ_PROOFS_MERKLE = "read_plane.proofs_merkle"
+    READ_PROOFLESS = "read_plane.proofless"
+    READ_ANCHOR_UPDATES = "read_plane.anchor_updates"
     # consensus
     VIEW_CHANGES = "consensus.view_changes"
     SUSPICIONS = "consensus.suspicions"
@@ -211,6 +222,7 @@ SAMPLED_NAMES = frozenset({
     MetricsName.COMMIT_DURABLE_TIME, MetricsName.COMMIT_REPLY_TIME,
     MetricsName.BLS_PAIRINGS_PER_BATCH,
     MetricsName.CRYPTO_DISPATCH_BUDGET,
+    MetricsName.READ_PROOF_GEN_TIME,
 })
 SAMPLE_CAP = 256
 

@@ -100,6 +100,10 @@ class BlsBftReplica:
         # set by the node: called with the sender of a bad COMMIT signature
         # caught by the order-time per-signature fallback
         self.report_bad_signature: Optional[Callable[[str], None]] = None
+        # set by the node: every freshly aggregated multi-sig (including
+        # late pending-order retries) is announced so the read plane can
+        # advance its signed-root anchor
+        self.on_multi_sig: Optional[Callable[[MultiSignature], None]] = None
         # optional MetricsCollector (master instance only): commit-path
         # stage timer + the pairings-per-batch counter the batched-BLS
         # acceptance is judged by
@@ -260,6 +264,8 @@ class BlsBftReplica:
             del self._recent_multi_sigs[oldest]
         if self._store is not None:
             self._store.put(ms)
+        if self.on_multi_sig is not None:
+            self.on_multi_sig(ms)
         return ms
 
     def _batch_verify_commits(self, sigs: dict[str, str],

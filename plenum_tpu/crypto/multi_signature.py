@@ -8,7 +8,7 @@ PRE-PREPARE (bls_multi_sig field) and the BlsStore keyed by state root.
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Sequence
+from typing import Callable, Mapping, NamedTuple, Optional, Sequence, Union
 
 from plenum_tpu.common.serialization import signing_serialize
 
@@ -52,3 +52,50 @@ class MultiSignature(NamedTuple):
     def from_list(cls, items: Sequence) -> "MultiSignature":
         return cls(str(items[0]), tuple(items[1]),
                    MultiSignatureValue.from_list(items[2]))
+
+    def verify(self,
+               bls_keys: Union[Mapping[str, str],
+                               Callable[[str], Optional[str]]],
+               n: Optional[int] = None) -> bool:
+        """THE shared verification path for a multi-signature value —
+        server (PRE-PREPARE validation fast path aside) and verifying
+        read clients both judge a sig by exactly this rule set:
+
+        - participants are DISTINCT (plain point addition means one
+          colluding signer repeated n-f times would otherwise verify as
+          a quorum — rogue self-aggregation);
+        - every participant resolves to a known BLS verkey;
+        - the participant count reaches the n-f signature quorum of an
+          n-node pool (n defaults to the key-register size);
+        - the aggregated signature verifies over the CANONICAL value
+          serialization (as_single_value) under the aggregated keys.
+
+        Never raises: unknown names, malformed keys/sigs -> False.
+        """
+        participants = self.participants
+        if not participants or \
+                len(set(participants)) != len(participants):
+            return False
+        lookup = bls_keys.get if isinstance(bls_keys, Mapping) \
+            else bls_keys
+        try:
+            verkeys = [lookup(name) for name in participants]
+        except Exception:
+            return False
+        if any(vk is None for vk in verkeys):
+            return False
+        if n is not None:
+            pool_n = n
+        elif isinstance(bls_keys, Mapping):
+            pool_n = len(bls_keys)
+        else:
+            return False     # callable lookup can't imply the pool size
+        from plenum_tpu.common.quorums import Quorums
+        if not Quorums(pool_n).bls_signatures.is_reached(len(participants)):
+            return False
+        from plenum_tpu.crypto import bls as bls_lib
+        try:
+            return bls_lib.verify_multi_sig(
+                self.signature, self.value.as_single_value(), verkeys)
+        except Exception:
+            return False

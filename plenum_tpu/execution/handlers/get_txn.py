@@ -19,17 +19,24 @@ class GetTxnHandler(ReadRequestHandler):
         super().__init__(db, GET_TXN, DOMAIN_LEDGER_ID)
 
     def static_validation(self, request: Request) -> None:
+        from plenum_tpu.execution.exceptions import InvalidClientRequest
         op = request.operation
         if not isinstance(op.get("data"), int) or op["data"] < 1:
-            from plenum_tpu.execution.exceptions import InvalidClientRequest
             raise InvalidClientRequest(request.identifier, request.req_id,
                                        "GET_TXN needs a positive seqNo in data")
+        # an invalid ledgerId is a malformed query -> NACK; silently
+        # coercing it to DOMAIN would answer a DIFFERENT question than
+        # the client asked (and let a proof for the wrong ledger verify)
+        ledger_id = op.get("ledgerId", DOMAIN_LEDGER_ID)
+        if ledger_id not in VALID_LEDGER_IDS:
+            raise InvalidClientRequest(
+                request.identifier, request.req_id,
+                f"GET_TXN ledgerId must be one of {list(VALID_LEDGER_IDS)}, "
+                f"got {ledger_id!r}")
 
     def get_result(self, request: Request) -> dict:
         op = request.operation
         ledger_id = op.get("ledgerId", DOMAIN_LEDGER_ID)
-        if ledger_id not in VALID_LEDGER_IDS:
-            ledger_id = DOMAIN_LEDGER_ID
         seq_no = op["data"]
         ledger = self.db.get_ledger(ledger_id)
         result = {"type": GET_TXN, "ledgerId": ledger_id, "seqNo": seq_no,

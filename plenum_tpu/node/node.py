@@ -34,6 +34,7 @@ from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
                                              BatchCommitted,
                                              CatchupRep, CatchupReq,
                                              Commit, ConsistencyProof,
+                                             DOMAIN_LEDGER_ID,
                                              LedgerStatus, NewView,
                                              Ordered, POOL_LEDGER_ID,
                                              Prepare, PrePrepare,
@@ -188,6 +189,18 @@ class Node:
         # digest -> targeted body-fetch tries so far (digest-gossip: a
         # quorum can complete before any body-carrying propagate arrives)
         self._body_fetches: dict[str, int] = {}
+
+        # verified read plane (reads/plane.py): proof envelopes + a
+        # per-signed-root result cache in front of the read manager; its
+        # anchors advance from the commit path and from (possibly late)
+        # multi-sig aggregation (_make_replica wires bls.on_multi_sig).
+        # The domain ledger's tree hasher is reused so envelope digests
+        # batch through the configured (possibly device-backed) SHA-256.
+        from plenum_tpu.reads import ReadPlane
+        domain_ledger = self.c.db.get_ledger(DOMAIN_LEDGER_ID)
+        self.read_plane = ReadPlane(
+            self.c.db, self.c.read_manager, metrics=self.metrics,
+            hasher=domain_ledger.hasher if domain_ledger else None)
 
         # RBFT: f+1 protocol instances by default (ref replicas.py:19),
         # recomputed as pool membership changes f; an explicit
@@ -484,6 +497,20 @@ class Node:
         if isinstance(bls_stats, dict) and "local_fallbacks" in bls_stats:
             self.metrics.add_event(MetricsName.BLS_LOCAL_FALLBACKS,
                                    bls_stats["local_fallbacks"])
+        # read-plane health as cumulative gauges (read back via max):
+        # cache effectiveness + the proofless rate an operator watches —
+        # proofless replies are the ones that cost clients an f+1 fanout
+        rp = self.read_plane.stats
+        self.metrics.add_event(MetricsName.READ_CACHE_HITS,
+                               rp["cache_hits"])
+        self.metrics.add_event(MetricsName.READ_PROOFS_STATE,
+                               rp["proofs_state"])
+        self.metrics.add_event(MetricsName.READ_PROOFS_MERKLE,
+                               rp["proofs_merkle"])
+        self.metrics.add_event(MetricsName.READ_PROOFLESS,
+                               rp["proofless"])
+        self.metrics.add_event(MetricsName.READ_ANCHOR_UPDATES,
+                               rp["anchor_updates"])
 
     def _flush_metrics(self) -> None:
         """Sample process RSS/GC gauges + one last queue sample, then flush
@@ -657,6 +684,9 @@ class Node:
                 node_reg_at=node_reg_at, key_at=key_at)
             # commit-path stage timer + pairings-per-batch counter
             bls.metrics = self.metrics
+            # freshly aggregated multi-sigs advance the read plane's
+            # signed-root anchor (late pending-order retries included)
+            bls.on_multi_sig = self.read_plane.on_multi_sig
         # InstanceChange votes survive restart via the node-status DB
         # (ref instance_change_provider.py:34-69); master-only — backups
         # have no view-change machinery (see Replica)
@@ -1152,6 +1182,7 @@ class Node:
         batch, self._client_inbox = (self._client_inbox[:quota],
                                      self._client_inbox[quota:])
         to_auth: list[tuple[Request, str]] = []
+        queries: list[tuple[Request, str]] = []
         for msg, frm in batch:
             if msg.get("op") == "OBSERVER_REGISTER":
                 # a follower on this client connection wants BatchCommitted
@@ -1170,7 +1201,9 @@ class Node:
                     reason="malformed request"), frm)
                 continue
             if self.c.read_manager.is_query_type(request.txn_type):
-                self._answer_query(request, frm)
+                # answered together after the drain loop: the read plane
+                # batches proof generation across the tick's query set
+                queries.append((request, frm))
             elif self.action_manager is not None and \
                     self.action_manager.is_action_type(request.txn_type):
                 # actions authenticate like writes but execute locally
@@ -1188,6 +1221,8 @@ class Node:
                 self._client_send(RequestNack(
                     identifier=request.identifier, req_id=request.req_id,
                     reason=f"unknown txn type {request.txn_type!r}"), frm)
+        if queries:
+            self._answer_queries(queries)
         deduped: list[tuple[Request, str]] = []
         for req, frm in to_auth:
             if req.digest in self._authing:
@@ -1209,22 +1244,27 @@ class Node:
                 return count + len(batch) - len(to_auth)
         return count + len(batch)
 
+    def _answer_queries(self, queries: list[tuple[Request, str]]) -> None:
+        """One read-plane batch for the tick's whole query set: cache
+        hits, proof envelopes, and the batched digest hash happen once
+        per tick, not once per query (reads/plane.py)."""
+        outcomes = self.read_plane.answer_batch([q for q, _ in queries])
+        for (request, frm), out in zip(queries, outcomes):
+            if isinstance(out, InvalidClientRequest):
+                self._client_send(RequestNack(identifier=request.identifier,
+                                              req_id=request.req_id,
+                                              reason=out.reason), frm)
+            elif isinstance(out, Exception):
+                # a malformed query must never take the prod loop down
+                self._client_send(RequestNack(identifier=request.identifier,
+                                              req_id=request.req_id,
+                                              reason="malformed query"), frm)
+            else:
+                self._client_send(Reply(result=out), frm)
+
     def _answer_query(self, request: Request, frm: str) -> None:
-        try:
-            self.c.read_manager.static_validation(request)
-            result = self.c.read_manager.get_result(request)
-        except InvalidClientRequest as e:
-            self._client_send(RequestNack(identifier=request.identifier,
-                                          req_id=request.req_id,
-                                          reason=e.reason), frm)
-            return
-        except Exception:
-            # a malformed query must never take the prod loop down
-            self._client_send(RequestNack(identifier=request.identifier,
-                                          req_id=request.req_id,
-                                          reason="malformed query"), frm)
-            return
-        self._client_send(Reply(result=result), frm)
+        """Single-query seam kept for callers outside the prod loop."""
+        self._answer_queries([(request, frm)])
 
     def _finish_client_auth(self, items: list[tuple[Request, str]],
                             verdicts) -> None:
@@ -1496,6 +1536,12 @@ class Node:
             primaries=tuple(self.replicas.master.data.primaries),
             node_reg=tuple(self.validators))
         committed = self.c.executor.commit_batch(batch)
+        # advance the read plane: the txn root's tree size is knowable
+        # only now (post-commit), and the batch's multi-sig — if the
+        # aggregation already produced it — becomes the serving anchor;
+        # either way the ledger's cached read results are invalidated
+        self.read_plane.on_batch_committed(msg.ledger_id, msg.state_root,
+                                           msg.txn_root)
         self.spylog.append(("executed", (msg.view_no, msg.pp_seq_no)))
         return committed
 

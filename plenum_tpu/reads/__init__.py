@@ -1,0 +1,20 @@
+"""Verified read plane: single-reply state-proof reads.
+
+A read answered by ONE node is trustworthy when the reply carries a proof
+anchored to a BLS multi-signed root: an MPT state proof against the signed
+state root for trie-backed queries, an RFC-6962 inclusion proof against the
+signed txn root for GET_TXN. The server half (ReadPlane) wraps every
+ReadRequestManager result in that envelope and caches results per signed
+root; the client half (VerifyingReadClient / SimReadDriver) sends each read
+to one node, verifies proof + multi-sig + freshness, and fails over — only
+proofless replies escalate to the legacy f+1 broadcast. See docs/reads.md.
+"""
+from .proofs import (READ_PROOF, result_core, result_digest,
+                     verify_read_proof)
+from .plane import ReadPlane
+from .client import ReadCheck, ReadClientStats, SimReadDriver, \
+    VerifyingReadClient
+
+__all__ = ["READ_PROOF", "ReadPlane", "ReadCheck", "ReadClientStats",
+           "SimReadDriver", "VerifyingReadClient", "result_core",
+           "result_digest", "verify_read_proof"]

@@ -1,0 +1,222 @@
+"""Client half of the verified read plane.
+
+`ReadCheck` is the verification core both drivers share: given a request
+and one node's reply, it verifies the proof envelope (proofs.py) against
+the pool's BLS keys and a freshness bound, timing every check.
+
+`VerifyingReadClient` is the TCP client: each read goes to ONE node; a
+verified reply ends the read (fanout 1 request + 1 reply). The failover
+ladder walks the remaining nodes on forged/stale/missing-data replies and
+per-node timeouts; only when replies carry NO proof at all (a pool that
+cannot anchor one yet) does it escalate to the legacy f+1 broadcast of
+PoolClient.submit.
+
+`SimReadDriver` runs the same ladder over an in-process sim pool
+(tests/test_reads.py, test_sim_fuzz.py lying_reader, the read-heavy bench
+config) where transport is `node.handle_client_message` + a reply sink.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Mapping, Optional, Sequence
+
+from plenum_tpu.common.metrics import percentile
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.serialization import pack
+from plenum_tpu.client.client import PoolClient
+
+from . import proofs
+
+
+class ReadClientStats:
+    """Counters + verify-latency samples for one client instance."""
+
+    def __init__(self):
+        self.reads = 0
+        self.single_reply_ok = 0
+        self.failovers = 0
+        self.fallbacks = 0
+        self.verify_failures = 0
+        self.timeouts = 0
+        self.msgs_sent = 0
+        self.replies_seen = 0
+        self.verify_s: list[float] = []
+
+    def note_verify(self, dt: float) -> None:
+        if len(self.verify_s) < 65536:
+            self.verify_s.append(dt)
+
+    def summary(self) -> dict:
+        out = {"reads": self.reads,
+               "single_reply_ok": self.single_reply_ok,
+               "failovers": self.failovers,
+               "fallbacks": self.fallbacks,
+               "verify_failures": self.verify_failures,
+               "timeouts": self.timeouts,
+               "msgs_sent": self.msgs_sent,
+               "replies_seen": self.replies_seen}
+        if self.reads:
+            out["fanout"] = round(
+                (self.msgs_sent + self.replies_seen) / self.reads, 2)
+        if self.verify_s:
+            out["verify_ms_p50"] = round(
+                percentile(self.verify_s, 0.5) * 1000, 3)
+            out["verify_ms_p95"] = round(
+                percentile(self.verify_s, 0.95) * 1000, 3)
+        return out
+
+
+class ReadCheck:
+    """Shared verification core: pool BLS keys + freshness policy."""
+
+    def __init__(self, bls_keys: Mapping[str, str],
+                 freshness_s: float = proofs.DEFAULT_FRESHNESS_S,
+                 now: Optional[Callable[[], float]] = None,
+                 n_nodes: Optional[int] = None,
+                 stats: Optional[ReadClientStats] = None):
+        self.bls_keys = dict(bls_keys)
+        self.freshness_s = freshness_s
+        self.now = now
+        self.n_nodes = n_nodes
+        self.stats = stats or ReadClientStats()
+        # verified-multi-sig memo: one 2-pairing check per anchor, not
+        # per read (verify_read_proof ms_cache contract)
+        self._ms_cache: dict = {}
+
+    def check(self, request: Request, result: Mapping) -> tuple[bool, str]:
+        t0 = time.perf_counter()
+        ok, reason = proofs.verify_read_proof(
+            request.txn_type, request.operation, result,
+            self.bls_keys, freshness_s=self.freshness_s, now=self.now,
+            n_nodes=self.n_nodes, ms_cache=self._ms_cache)
+        self.stats.note_verify(time.perf_counter() - t0)
+        if not ok and reason != proofs.NO_PROOF:
+            self.stats.verify_failures += 1
+        return ok, reason
+
+
+def ladder_order(names: Sequence[str], request: Request) -> list[str]:
+    """Per-read node rotation: spread load across the pool while keeping
+    the order deterministic per request (replayable sims)."""
+    names = list(names)
+    if not names:
+        return names
+    start = sum(request.digest.encode()) % len(names)
+    return names[start:] + names[:start]
+
+
+class VerifyingReadClient(PoolClient):
+    """One proof-verified reply per read, over the node client ports."""
+
+    def __init__(self, node_addrs: dict, f: int,
+                 bls_keys: Mapping[str, str],
+                 freshness_s: float = proofs.DEFAULT_FRESHNESS_S,
+                 now: Optional[Callable[[], float]] = None):
+        super().__init__(node_addrs, f)
+        self.checker = ReadCheck(bls_keys, freshness_s=freshness_s,
+                                 now=now, n_nodes=len(node_addrs))
+        self.stats = self.checker.stats
+
+    async def submit_read(self, request: Request, timeout: float = 30.0,
+                          per_node_timeout: float = 5.0) -> dict:
+        """-> the verified REPLY dict (or the legacy f+1-agreed reply
+        after escalation). Raises TimeoutError when every rung fails."""
+        self.stats.reads += 1
+        data = pack(request.to_dict())
+        req_key = (request.identifier, request.req_id)
+        for rung, name in enumerate(ladder_order(list(self.node_addrs),
+                                                 request)):
+            if rung:
+                self.stats.failovers += 1
+            await self._send_one(name, data)
+            self.stats.msgs_sent += 1
+            msg = await self._read_until_reply(name, req_key,
+                                               per_node_timeout)
+            if msg is None:
+                self.stats.timeouts += 1
+                continue
+            self.stats.replies_seen += 1
+            if msg.get("op") != "REPLY":
+                continue                 # a lone NACK is unverifiable
+            ok, reason = self.checker.check(request, msg.get("result", {}))
+            if ok:
+                self.stats.single_reply_ok += 1
+                return msg
+            if reason == proofs.NO_PROOF:
+                break                    # pool can't prove: broadcast
+        # escalation: the legacy f+1 matching-reply broadcast — reached
+        # when the pool cannot anchor proofs yet or every proof-bearing
+        # rung lied/timed out; either way the quorum path stays sound
+        # (f+1 CONTENT-matching replies)
+        self.stats.fallbacks += 1
+        msg = await self.submit(request, timeout)
+        self.stats.msgs_sent += len(self.node_addrs)
+        self.stats.replies_seen += len(self.node_addrs)
+        return msg
+
+
+class SimReadDriver:
+    """The same ladder over an in-process pool.
+
+    submit(node_name, request): deliver the query to that node only.
+    collect(node_name): -> list of reply DICTS that node sent this driver
+        since the last collect (drained).
+    pump(seconds): run the pool loop.
+    """
+
+    def __init__(self, submit: Callable[[str, Request], None],
+                 collect: Callable[[str], list],
+                 pump: Callable[[float], None],
+                 node_names: Sequence[str],
+                 bls_keys: Mapping[str, str],
+                 freshness_s: float = proofs.DEFAULT_FRESHNESS_S,
+                 now: Optional[Callable[[], float]] = None):
+        self._submit = submit
+        self._collect = collect
+        self._pump = pump
+        self.node_names = list(node_names)
+        self.checker = ReadCheck(bls_keys, freshness_s=freshness_s,
+                                 now=now, n_nodes=len(node_names))
+        self.stats = self.checker.stats
+
+    def read(self, request: Request, per_node_s: float = 1.0,
+             step_s: float = 0.05, order: Optional[Sequence[str]] = None
+             ) -> Optional[dict]:
+        """-> the verified result dict, or None when every rung failed
+        (caller escalates to its own broadcast path)."""
+        self.stats.reads += 1
+        for rung, name in enumerate(order if order is not None
+                                    else ladder_order(self.node_names,
+                                                      request)):
+            if rung:
+                self.stats.failovers += 1
+            self._submit(name, request)
+            self.stats.msgs_sent += 1
+            result = self._await_reply(name, request, per_node_s, step_s)
+            if result is None:
+                self.stats.timeouts += 1
+                continue
+            self.stats.replies_seen += 1
+            ok, reason = self.checker.check(request, result)
+            if ok:
+                self.stats.single_reply_ok += 1
+                return result
+            if reason == proofs.NO_PROOF:
+                break
+        self.stats.fallbacks += 1
+        return None
+
+    def _await_reply(self, name: str, request: Request, per_node_s: float,
+                     step_s: float) -> Optional[dict]:
+        waited = 0.0
+        while True:
+            for result in self._collect(name):
+                if not isinstance(result, dict):
+                    continue
+                if (result.get("identifier"), result.get("reqId")) == \
+                        (request.identifier, request.req_id):
+                    return result
+            if waited >= per_node_s:
+                return None
+            self._pump(step_s)
+            waited += step_s

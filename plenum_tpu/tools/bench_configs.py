@@ -351,6 +351,102 @@ def config5_sim25(n_txns: int = 60, timeout: float = 180.0) -> dict:
 
 
 
+def config6_read_plane(n_reads: int = 1800, write_every: int = 9,
+                       timeout: float = 120.0) -> dict:
+    """Read-heavy mix (90:10 read:write) through the VERIFIED read plane:
+    every read goes to ONE node and the client checks the state proof +
+    BLS multi-sig + freshness (reads/client.py). Reports reads/s, the
+    measured per-read fanout (messages per read, target 2 = 1 request +
+    1 reply vs the legacy 2n broadcast), client verify p50/p95, and the
+    serving node's cache hit rate."""
+    import plenum_tpu.tools.local_pool as lp
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import GET_NYM, NYM
+    from plenum_tpu.reads import SimReadDriver
+
+    try:
+        (names, nodes, timer, trustee,
+         replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(4, "cpu")
+        users = []
+        setup = []
+        for i in range(20):
+            user = Ed25519Signer(seed=(b"rp%08d" % i).ljust(32, b"\0")[:32])
+            users.append(user)
+            req = Request(trustee.identifier, i + 1,
+                          {"type": NYM, "dest": user.identifier,
+                           "verkey": user.verkey_b58})
+            req.signature = trustee.sign_b58(req.signing_bytes())
+            setup.append(req)
+        done, _ = _drive_inprocess(names, nodes, timer, replies, ReplyCls,
+                                   plane, setup, 60.0)
+        if done < len(setup):
+            return {"error": f"setup ordered only {done}/{len(setup)}"}
+
+        bls_keys = lp.pool_bls_keys(names)
+
+        def submit(name, req):
+            nodes[name].handle_client_message(req.to_dict(), "rdr")
+
+        def collect(name):
+            out = [m.result for _, m, c in replies[name]
+                   if isinstance(m, ReplyCls) and c == "rdr"]
+            replies[name].clear()
+            return out
+
+        def pump(seconds):
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                timer.service()
+                for node in nodes.values():
+                    node.prod()
+
+        driver = SimReadDriver(submit, collect, pump, names, bls_keys,
+                               freshness_s=1e9,
+                               now=timer.get_current_time)
+        served = 0
+        writes = 0
+        write_id = 1000
+        t0 = time.perf_counter()
+        for i in range(n_reads):
+            if time.perf_counter() > t0 + timeout:
+                break
+            if i % write_every == write_every - 1:
+                # the write share of the 90:10 mix, fire-and-forget
+                user = Ed25519Signer(
+                    seed=(b"rpw%07d" % i).ljust(32, b"\0")[:32])
+                w = Request(trustee.identifier, write_id,
+                            {"type": NYM, "dest": user.identifier,
+                             "verkey": user.verkey_b58})
+                w.signature = trustee.sign_b58(w.signing_bytes())
+                write_id += 1
+                for n in names:
+                    nodes[n].handle_client_message(w.to_dict(), "bench-w")
+                writes += 1
+            q = Request("reader", i + 1,
+                        {"type": GET_NYM,
+                         "dest": users[i % len(users)].identifier})
+            if driver.read(q, per_node_s=2.0, step_s=0.001) is not None:
+                served += 1
+        dt = time.perf_counter() - t0
+        s = driver.stats.summary()
+        rp = nodes[names[0]].read_plane.stats
+        out = {"reads_served": served, "writes_submitted": writes,
+               "reads_per_s": round(served / dt, 1) if dt else 0.0,
+               "read_fanout": s.get("fanout"),
+               "legacy_read_fanout": 2 * len(names),
+               "single_reply_ok": s["single_reply_ok"],
+               "failovers": s["failovers"], "fallbacks": s["fallbacks"],
+               "verify_ms_p50": s.get("verify_ms_p50"),
+               "verify_ms_p95": s.get("verify_ms_p95")}
+        if rp["queries"]:
+            out["server_cache_hit_rate"] = round(
+                rp["cache_hits"] / rp["queries"], 3)
+        return out
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def config1b_distinct_signers(n_txns: int = 200,
                               timeout: float = 120.0) -> dict:
     """Diverse-client honesty datum: every write signed by a DIFFERENT
@@ -405,7 +501,8 @@ def main():
                      ("config2", config2_three_instances_mixed),
                      ("config3", config3_bls_proof_reads),
                      ("config4", config4_viewchange_under_load),
-                     ("config5", config5_sim25)):
+                     ("config5", config5_sim25),
+                     ("config6", config6_read_plane)):
         print(name, json.dumps(fn()), flush=True)
 
 

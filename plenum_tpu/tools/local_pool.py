@@ -19,6 +19,16 @@ import statistics
 import time
 
 
+def pool_bls_keys(names) -> dict:
+    """node name -> BLS verkey under the name-seeded derivation every
+    in-process genesis uses (build_genesis below, tests/test_pool.py).
+    THE one copy: a verifying read client fed keys derived any other way
+    would silently reject every proof and fall back to broadcast."""
+    from plenum_tpu.crypto.bls import BlsCryptoSigner
+    return {n: BlsCryptoSigner(seed=n.encode().ljust(32, b"\0")[:32]).pk
+            for n in names}
+
+
 def build_genesis(names, node_data_extra=None):
     """Pool + domain genesis txns for a named node set -> (genesis, trustee).
 
@@ -27,16 +37,16 @@ def build_genesis(names, node_data_extra=None):
     same fields the reference pool ledger carries)."""
     from plenum_tpu.common.node_messages import (DOMAIN_LEDGER_ID,
                                                  POOL_LEDGER_ID)
-    from plenum_tpu.crypto.bls import BlsCryptoSigner
     from plenum_tpu.crypto.ed25519 import Ed25519Signer
     from plenum_tpu.execution import txn as txn_lib
     from plenum_tpu.execution.txn import NODE, NYM, TRUSTEE
 
     trustee = Ed25519Signer(seed=b"local-pool-trustee".ljust(32, b"\0"))
+    bls_keys = pool_bls_keys(names)
     pool_txns = []
     for i, name in enumerate(names):
-        bls_pk = BlsCryptoSigner(seed=name.encode().ljust(32, b"\0")[:32]).pk
-        data = {"alias": name, "services": ["VALIDATOR"], "blskey": bls_pk}
+        data = {"alias": name, "services": ["VALIDATOR"],
+                "blskey": bls_keys[name]}
         if node_data_extra and name in node_data_extra:
             data.update(node_data_extra[name])
         txn = txn_lib.new_txn(NODE, {"dest": f"{name}Dest", "data": data})

@@ -194,6 +194,41 @@ def derive_summary(folds: dict[str, dict], span_s: float,
     if "crypto.bls_local_fallbacks" in folds:
         out["bls_local_fallbacks"] = int(
             cum("crypto.bls_local_fallbacks") or 0)
+    # verified read plane (docs/reads.md): volume, cache effectiveness,
+    # proof mix, and the proof-generation stage p50/p95 — a read-latency
+    # regression must localize to proof gen vs everything else, and a
+    # rising proofless share is the operator's signal that clients are
+    # paying the f+1 broadcast fallback
+    rq = folds.get("read_plane.queries", {})
+    if rq.get("count"):
+        queries = rq.get("sum") or 0.0
+        hits = cum("read_plane.cache_hits") or 0
+        section = {
+            "queries": int(queries),
+            "reads_per_s": round(queries / span_s, 1) if span_s > 0
+            else None,
+            "cache_hits": int(hits),
+            "cache_hit_rate": round(hits / queries, 3) if queries
+            else None,
+            "proofs_state": int(cum("read_plane.proofs_state") or 0),
+            "proofs_merkle": int(cum("read_plane.proofs_merkle") or 0),
+            "proofless": int(cum("read_plane.proofless") or 0),
+            "anchor_updates": int(
+                cum("read_plane.anchor_updates") or 0),
+            # one event per tick batch carries len(batch): the mean IS
+            # the mean queries-per-tick batch size
+            "batch_size_mean": rq.get("mean"),
+        }
+        gen = folds.get("read_plane.proof_gen_time", {})
+        if gen.get("samples"):
+            section["proof_gen_ms_p50"] = _ms(
+                percentile(gen["samples"], 0.5))
+            section["proof_gen_ms_p95"] = _ms(
+                percentile(gen["samples"], 0.95))
+        elif gen.get("mean") is not None:
+            section["proof_gen_ms_mean"] = _ms(gen["mean"])
+        out["read_plane"] = {k: v for k, v in section.items()
+                             if v is not None}
     return {k: v for k, v in out.items() if v is not None}
 
 

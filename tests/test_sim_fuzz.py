@@ -15,6 +15,7 @@ from __future__ import annotations
 import pytest
 
 from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+from plenum_tpu.common.request import Request
 from plenum_tpu.config import Config
 from plenum_tpu.crypto.ed25519 import Ed25519Signer
 from plenum_tpu.execution import txn as txn_lib
@@ -341,6 +342,164 @@ def run_device_flap_scenario(seed: int) -> None:
     assert tok.kind == "dev", "device not re-admitted after close"
     sup.collect_batch(tok)
     assert_safety(pool)
+
+
+def run_lying_reader_scenario(seed: int) -> None:
+    """A Byzantine node forges read replies; the verifying read client
+    must reject every forgery kind and fail over to an honest node
+    within its per-rung deadline — or, when the liar strips the proof
+    entirely, escalate to the f+1 broadcast (which the diverging-reader
+    vote-key fix keeps sound)."""
+    import copy
+
+    from plenum_tpu.common.node_messages import Reply
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.reads import READ_PROOF, result_digest
+    from test_reads import FOREVER, LyingPlane, make_driver
+
+    rng = SimRandom(seed * 6151 + 13)
+    pool = Pool(seed=seed, config=Config(**FAST))
+    user = Ed25519Signer(seed=(b"liar%d" % seed).ljust(32, b"\0")[:32])
+    assert _order_and_time(pool, signed_nym(pool.trustee, user, 1), 2) \
+        is not None
+
+    def forge_value(result):
+        env = result.get(READ_PROOF)
+        if env and env.get("entries"):
+            e = env["entries"][0]
+            if e.get("value"):
+                e["value"] = bytes(
+                    reversed(bytes.fromhex(e["value"]))).hex()
+        return result
+
+    def forge_root(result):
+        env = result.get(READ_PROOF)
+        if env and env.get("root_hash"):
+            env["root_hash"] = "ab" * 32
+            env["result_digest"] = result_digest(result).hex()
+        return result
+
+    def mismatch_ms(result):
+        env = result.get(READ_PROOF)
+        if env:
+            ms = env["multi_signature"]
+            ms[1] = list(ms[1])[:-1]     # claim a smaller participant set
+            env["result_digest"] = result_digest(result).hex()
+        return result
+
+    def tamper_data(result):
+        if isinstance(result.get("data"), dict):
+            result["data"] = dict(result["data"], verkey="EvilVerkey1111")
+            env = result.get(READ_PROOF)
+            if env:                      # smart liar: re-binds the digest
+                env["result_digest"] = result_digest(result).hex()
+        return result
+
+    def strip(result):
+        result.pop(READ_PROOF, None)
+        return result
+
+    kind, mutate = [("forge_value", forge_value),
+                    ("forge_root", forge_root),
+                    ("mismatch_ms", mismatch_ms),
+                    ("tamper_data", tamper_data),
+                    ("strip", strip)][rng.integer(0, 4)]
+    liar = pool.names[rng.integer(0, len(pool.names) - 1)]
+    node = pool.nodes[liar]
+    node.read_plane = LyingPlane(node.read_plane, mutate)
+
+    driver = make_driver(pool, client="fuzz", freshness_s=FOREVER)
+    q = Request("fuzz", 50, {"type": GET_NYM, "dest": user.identifier})
+    order = [liar] + [n for n in pool.names if n != liar]
+    t0 = pool.timer.get_current_time()
+    res = driver.read(q, per_node_s=2.0, order=order)
+    took = pool.timer.get_current_time() - t0
+    deadline = 2.0 * len(pool.names) + 1.0
+    assert took <= deadline, \
+        f"seed {seed}: {kind} read took {took:.1f}s > {deadline:.1f}s"
+    s = driver.stats
+    if kind == "strip":
+        # no proof at all -> escalate to the legacy f+1 broadcast; the
+        # content vote key keeps the liar's divergent data sub-quorum
+        assert res is None and s.fallbacks == 1, f"seed {seed}"
+        from plenum_tpu.client.client import PoolClient
+        pool.submit(q, client="fuzz-bc")
+        pool.run(2.0)
+        votes: dict = {}
+        for name in pool.names:
+            for m, c in pool.client_msgs[name]:
+                if c == "fuzz-bc" and isinstance(m, Reply):
+                    key = PoolClient._vote_key(
+                        {"op": "REPLY", "result": copy.deepcopy(m.result)})
+                    votes[key] = votes.get(key, 0) + 1
+        agreed = [k for k, v in votes.items()
+                  if v >= pool.nodes[liar].f + 1]
+        assert len(agreed) == 1, f"seed {seed}: votes {votes}"
+    else:
+        assert res is not None, f"seed {seed}: {kind} never failed over"
+        assert res["data"]["verkey"] == user.verkey_b58, f"seed {seed}"
+        assert s.verify_failures >= 1 and s.failovers >= 1, \
+            f"seed {seed}: {kind} accepted a forged reply " \
+            f"({s.summary()})"
+        assert s.single_reply_ok == 1 and s.fallbacks == 0, f"seed {seed}"
+    assert_safety(pool)
+
+
+LYING_READER_SEEDS = 20
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_lying_reader_fuzz(bucket):
+    for seed in range(bucket * 5, (bucket + 1) * 5):
+        run_lying_reader_scenario(seed)
+
+
+def test_sim_lying_reader_smoke():
+    """One lying_reader scenario always runs in the default suite."""
+    run_lying_reader_scenario(2)
+
+
+def test_sim_lying_reader_stale_replay():
+    """A liar replaying a captured pre-rotation reply (honest sig, old
+    root) must be rejected by the freshness bound and failed over."""
+    from plenum_tpu.execution.txn import GET_NYM, NYM
+    from test_reads import LyingPlane, make_driver
+
+    pool = Pool(seed=5, config=Config(**FAST))
+    user = Ed25519Signer(seed=b"stale-user".ljust(32, b"\0")[:32])
+    assert _order_and_time(pool, signed_nym(pool.trustee, user, 1), 2) \
+        is not None
+
+    # capture an honest reply at t0 through the liar-to-be
+    liar = pool.names[0]
+    node = pool.nodes[liar]
+    captured = node.read_plane.answer(
+        Request("cap", 1, {"type": GET_NYM, "dest": user.identifier}))
+
+    pool.run(12.0)                      # age the captured anchor
+    rotated = Ed25519Signer(seed=b"stale-user-2".ljust(32, b"\0")[:32])
+    upd = Request(pool.trustee.identifier, 2,
+                  {"type": NYM, "dest": user.identifier,
+                   "verkey": rotated.verkey_b58})
+    upd.signature = pool.trustee.sign_b58(upd.signing_bytes())
+    assert _order_and_time(pool, upd, 3) is not None
+
+    # replay keeps the asker echo so the client matches the reply to its
+    # request; the result digest excludes those fields, so the binding
+    # still verifies and rejection comes from the freshness bound alone
+    node.read_plane = LyingPlane(
+        node.read_plane,
+        lambda result: dict(captured, identifier=result.get("identifier"),
+                            reqId=result.get("reqId")))
+    driver = make_driver(pool, client="stale", freshness_s=8.0)
+    q = Request("stale", 9, {"type": GET_NYM, "dest": user.identifier})
+    res = driver.read(q, per_node_s=2.0,
+                      order=[liar] + [n for n in pool.names if n != liar])
+    assert res is not None
+    assert res["data"]["verkey"] == rotated.verkey_b58
+    assert driver.stats.failovers >= 1
+    assert driver.stats.verify_failures >= 1
 
 
 @pytest.mark.slow

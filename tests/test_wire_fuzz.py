@@ -200,3 +200,113 @@ def test_node_nacks_garbage_client_traffic():
     pool.submit(signed_nym(pool.trustee, user, 1))
     pool.run(5.0)
     assert pool.nodes["Alpha"].c.db.get_ledger(DOMAIN_LEDGER_ID).size == 2
+
+
+# --- proof-bearing REPLY envelope (verified read plane) -------------------
+
+def _proof_bearing_result():
+    """One committed NYM + a proof-enveloped GET_NYM result, plus the
+    verification context (pool keys, sim clock)."""
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.tools.local_pool import pool_bls_keys
+
+    pool = Pool(seed=31)
+    user = Ed25519Signer(seed=b"wirefuzz-user".ljust(32, b"\0")[:32])
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(6.0)
+    q = Request("wf", 1, {"type": GET_NYM, "dest": user.identifier})
+    result = pool.nodes["Alpha"].read_plane.answer(q)
+    return pool, q, result, pool_bls_keys(pool.names)
+
+
+def _corrupt_tree(rng: random.Random, obj):
+    """One random structural corruption somewhere in a nested dict/list:
+    drop a key, retype a value, truncate/flip a hex string, or splice in
+    garbage. Returns a deep-copied corrupted twin."""
+    import copy
+    obj = copy.deepcopy(obj)
+
+    def nodes(o, path=()):
+        yield o, path
+        if isinstance(o, dict):
+            for k, v in o.items():
+                yield from nodes(v, path + (k,))
+        elif isinstance(o, list):
+            for i, v in enumerate(o):
+                yield from nodes(v, path + (i,))
+
+    def set_at(o, path, value):
+        for p in path[:-1]:
+            o = o[p]
+        o[path[-1]] = value
+
+    def del_at(o, path):
+        for p in path[:-1]:
+            o = o[p]
+        del o[path[-1]]
+
+    candidates = [(n, p) for n, p in nodes(obj) if p]
+    node, path = candidates[rng.randrange(len(candidates))]
+    op = rng.randrange(4)
+    if op == 0:
+        del_at(obj, path)
+    elif op == 1:
+        set_at(obj, path, rng.choice(
+            [None, -1, 2 ** 70, "zz", [], {}, True, b"\xff" * 4]))
+    elif op == 2 and isinstance(node, str) and len(node) > 2:
+        cut = rng.randrange(1, len(node))
+        set_at(obj, path, node[:cut])            # truncation
+    elif isinstance(node, str) and node:
+        i = rng.randrange(len(node))
+        repl = "0" if node[i] != "0" else "f"
+        set_at(obj, path, node[:i] + repl + node[i + 1:])  # flip
+    else:
+        set_at(obj, path, "garbage")
+    return obj
+
+
+def test_read_proof_envelope_roundtrips_and_fails_closed():
+    """The proof-bearing REPLY survives the wire roundtrip verbatim and
+    STILL verifies; any corruption of the envelope (or of the result it
+    binds) must verify False — never raise, and never verify unless the
+    corruption was a no-op."""
+    from plenum_tpu.common.node_messages import Reply
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.reads import READ_PROOF, verify_read_proof
+
+    pool, q, result, keys = _proof_bearing_result()
+    now = pool.timer.get_current_time
+
+    # wire roundtrip: pack -> unpack -> still verifies
+    wire = unpack(pack(Reply(result=result).to_dict()))
+    rt_result = wire["result"]
+    ok, reason = verify_read_proof(GET_NYM, q.operation, rt_result, keys,
+                                   freshness_s=1e12, now=now)
+    assert ok, f"roundtrip broke verification: {reason}"
+
+    rng = random.Random(4242)
+    verified = rejected = 0
+    for _ in range(N_CASES):
+        bad = _corrupt_tree(rng, rt_result)
+        try:
+            ok, reason = verify_read_proof(GET_NYM, q.operation, bad,
+                                           keys, freshness_s=1e12,
+                                           now=now)
+        except Exception as e:           # pragma: no cover
+            raise AssertionError(
+                f"verify_read_proof raised {type(e).__name__} on "
+                f"corrupted envelope") from e
+        if ok:
+            # only acceptable when the corruption didn't change anything
+            # the verifier reads (e.g. a legacy state_proof field)
+            assert bad.get(READ_PROOF) == rt_result.get(READ_PROOF) \
+                and {k: v for k, v in bad.items()
+                     if k not in ("identifier", "reqId")} \
+                == {k: v for k, v in rt_result.items()
+                    if k not in ("identifier", "reqId")}, \
+                f"corrupted envelope VERIFIED: {bad}"
+            verified += 1
+        else:
+            rejected += 1
+    assert rejected > N_CASES // 2       # most corruptions must reject
