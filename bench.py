@@ -132,6 +132,17 @@ def main():
         if got and got.get("txns_ordered") == got.get("txns_requested"):
             tcpsvcjax = got
     tcp7 = _run_tcp_pool(n_nodes=7, n_txns=100)   # f=2 scale datum
+    # tracing-plane acceptance: ONE traced 4-node sim pass produces the
+    # sampled per-request waterfall + pool critical-path attribution for
+    # the bench line, and its TPS against the untraced median is the
+    # measured tracing overhead — SAME n_txns as the cpu runs, so the
+    # A/B isolates tracing cost from workload-shape effects (warmup and
+    # pipeline fill amortize differently at different run lengths). The
+    # headline figures above stay untraced (NullTracer fast path).
+    try:
+        traced = run_load(n_nodes=4, n_txns=300, backend="cpu", trace=True)
+    except Exception:
+        traced = None
     jax_stats = _run_jax_pool_subprocess()
 
     REF_TPS = 74.0      # measured reference peak on this host (BASELINE.md)
@@ -213,6 +224,21 @@ def main():
             ppb = t["commit_stage"].get("pairings_per_batch")
             if ppb is not None and "pairings_per_batch" not in result:
                 result["pairings_per_batch"] = ppb
+    # tracing plane: per-stage critical-path p50/p95, sampled waterfalls,
+    # and how much of the measured e2e latency the stage sum attributes
+    if traced and traced.get("trace"):
+        tr = traced["trace"]
+        result["waterfall"] = {
+            "attribution": tr.get("attribution"),
+            "sampled": tr.get("sampled_waterfalls"),
+            "stage_sum_vs_e2e_p50": tr.get("stage_sum_vs_e2e_p50"),
+        }
+        if cpu is not None and cpu.get("tps") and traced.get("tps"):
+            # single traced pass vs the untraced median at the same
+            # workload shape; rides the host's single-run noise band, so
+            # read the trend across rounds, not one round's decimals
+            result["trace_overhead_pct"] = round(
+                100 * (1 - traced["tps"] / cpu["tps"]), 1)
     # plane-supervisor acceptance: breaker state / fallback counts /
     # hedge wins / deadline p50-p95 ride the bench line per config
     # (the overall backend_state is set from the DEVICE pool below)

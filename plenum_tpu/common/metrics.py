@@ -14,6 +14,7 @@ information content as the reference's struct-packed rows.
 from __future__ import annotations
 
 import time
+import zlib
 from contextlib import contextmanager
 from typing import Callable, Optional
 
@@ -228,33 +229,60 @@ SAMPLE_CAP = 256
 
 
 def percentile(values, q: float) -> Optional[float]:
-    """Nearest-rank percentile of an unsorted sequence (q in [0, 1])."""
+    """Nearest-rank percentile of an unsorted sequence (q in [0, 1]).
+
+    Nearest-rank rank is ceil(q*n); as a 0-based index that is
+    ceil(q*n)-1. The previous int(q*n) picked one rank LOW for every q
+    where q*n is integral (p50 of [1,2,3,4] returned 3, the 75th-centile
+    value's neighbor) — tests/test_tracing.py pins p50/p95/p100 on small
+    known sequences."""
     if not values:
         return None
+    import math
     ordered = sorted(values)
-    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    n = len(ordered)
+    idx = min(n - 1, max(0, math.ceil(q * n) - 1))
     return ordered[idx]
 
 
 class Accumulator:
-    """Fold of all events for one name since the last flush."""
+    """Fold of all events for one name since the last flush.
 
-    __slots__ = ("count", "total", "min", "max", "samples")
+    Sampled names keep a DETERMINISTIC RESERVOIR (Algorithm R driven by a
+    seeded LCG) rather than the first SAMPLE_CAP events: first-N sampling
+    over-weighted cold-start/compile costs in every reported p95 once a
+    flush interval saw more than SAMPLE_CAP events. to_dict() consumers
+    (metrics_report, local_pool.commit_stage_stats): `samples` is now an
+    unbiased sample of the WHOLE interval, in no particular order — order
+    never mattered to the percentile readers, but anything assuming
+    "the earliest events" would be wrong. Seeded + replay-stable: the
+    same add() sequence always keeps the same sample set."""
 
-    def __init__(self, keep_samples: bool = False):
+    __slots__ = ("count", "total", "min", "max", "samples", "_rng")
+
+    def __init__(self, keep_samples: bool = False, seed: int = 0):
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.samples: Optional[list[float]] = [] if keep_samples else None
+        self._rng = (seed ^ 0x9E3779B9) & 0xFFFFFFFF
 
     def add(self, value: float) -> None:
         self.count += 1
         self.total += value
         self.min = value if self.min is None else min(self.min, value)
         self.max = value if self.max is None else max(self.max, value)
-        if self.samples is not None and len(self.samples) < SAMPLE_CAP:
-            self.samples.append(value)
+        if self.samples is not None:
+            if len(self.samples) < SAMPLE_CAP:
+                self.samples.append(value)
+            else:
+                # Algorithm R: event i (1-based) replaces a reservoir slot
+                # with probability CAP/i — a uniform sample over all events
+                self._rng = (self._rng * 1664525 + 1013904223) & 0xFFFFFFFF
+                j = self._rng % self.count
+                if j < SAMPLE_CAP:
+                    self.samples[j] = value
 
     def to_dict(self) -> dict:
         avg = self.total / self.count if self.count else 0.0
@@ -275,8 +303,12 @@ class MetricsCollector:
     def add_event(self, name: str, value: float = 1.0) -> None:
         acc = self.accumulators.get(name)
         if acc is None:
+            keep = name in SAMPLED_NAMES
+            # reservoir seed derived from the name: deterministic across
+            # processes and replays, decorrelated across metrics
             acc = self.accumulators[name] = Accumulator(
-                keep_samples=name in SAMPLED_NAMES)
+                keep_samples=keep,
+                seed=zlib.crc32(name.encode()) if keep else 0)
         acc.add(value)
 
     @contextmanager

@@ -97,6 +97,14 @@ class Config:
     METRICS_FLUSH_INTERVAL: float = 10.0
     QUEUE_GAUGE_SAMPLE_INTERVAL: float = 1.0
 
+    # --- tracing / flight recorder (common/tracing.py) ---
+    # False drops the node to the NullTracer fast path (one attribute
+    # check per span site, zero allocations — the <=2% TPS budget)
+    FLIGHT_RECORDER: bool = True
+    TRACE_RING_SIZE: int = 4096
+    # anomaly auto-dumps are debounced to at most one per this interval
+    FLIGHT_DUMP_MIN_INTERVAL: float = 5.0
+
     # --- blacklisting (TTL: self-isolation must heal; see blacklister.py) ---
     BLACKLIST_TTL: float = 120.0
     CatchupTransactionsTimeout: float = 6.0

@@ -36,6 +36,7 @@ from plenum_tpu.common.stashing import (DISCARD, PROCESS, STASH, StashReason,
                                         StashingRouter)
 from plenum_tpu.common.suspicion_codes import Suspicions
 from plenum_tpu.common.timer import TimerService
+from plenum_tpu.common import tracing
 from plenum_tpu.config import Config
 
 from .batch_executor import AppliedBatch, BatchExecutor
@@ -60,12 +61,15 @@ class OrderingService:
                  bls: Optional[BlsBftReplica] = None,
                  config: Optional[Config] = None,
                  get_request: Optional[Callable[[str], Optional[Request]]] = None,
-                 metrics=None):
+                 metrics=None, tracer=None):
         self._data = data
         self._timer = timer
         # per-phase 3PC timing (ref metrics_collector.py's 3PC names):
         # key -> (t_preprepare, t_prepared); emitted at quorum transitions
         self._metrics = metrics
+        # tracing plane: batch-keyed span events (pp send/recv, prepare
+        # quorum, commit send, ordered, apply) — master instance only
+        self._tracer = tracer if tracer is not None else tracing.NULL_TRACER
         self._phase_ts: dict[tuple[int, int], list] = {}
         self._bus = bus
         self._network = network
@@ -297,6 +301,12 @@ class OrderingService:
         self.prePrepares[key] = pre_prepare
         if self._metrics is not None:
             self._phase_ts[key] = [self._timer.get_current_time(), None]
+        if self._tracer.enabled:
+            # reqs list links request digests -> this batch for waterfall
+            # assembly; seq links the batch -> the durable flush event
+            self._tracer.emit(tracing.PP_SENT, pre_prepare.digest,
+                              {"seq": pp_seq_no, "ledger": ledger_id,
+                               "reqs": list(all_digests)})
         batch_id = BatchID(view_no, _orig_view(pre_prepare),
                            pp_seq_no, pre_prepare.digest)
         self._data.preprepare_batch(batch_id)
@@ -335,6 +345,14 @@ class OrderingService:
             if self._metrics is not None:
                 self._metrics.add_event(MetricsName.COMMIT_APPLY_TIME,
                                         time.perf_counter() - t0)
+            if self._tracer.enabled:
+                # keyed by seq (the batch digest does not exist yet for a
+                # fresh batch being minted); wall duration only when the
+                # tracer allows it (replay determinism)
+                data = {"seq": pp_seq_no, "n": len(reqs)}
+                if self._tracer.wall_durations:
+                    data["dur"] = time.perf_counter() - t0
+                self._tracer.emit(tracing.APPLY, "", data)
 
     def _last_state_root(self, ledger_id: int) -> str:
         """State root of the previous batch on this ledger (what the previous
@@ -512,6 +530,10 @@ class OrderingService:
         self.prePrepares[key] = msg
         if self._metrics is not None:
             self._phase_ts[key] = [self._timer.get_current_time(), None]
+        if self._tracer.enabled:
+            self._tracer.emit(tracing.PP_RECV, msg.digest,
+                              {"seq": msg.pp_seq_no, "frm": sender,
+                               "reqs": list(msg.req_idr)})
         self._data.preprepare_batch(batch_id)
         # Commits that raced ahead of this pre-prepare: validate their BLS
         # sigs now that we know the signed roots; evict liars.
@@ -582,6 +604,9 @@ class OrderingService:
             ts[1] = self._timer.get_current_time()
             self._metrics.add_event(MetricsName.PREPARE_PHASE_TIME,
                                     ts[1] - ts[0])
+        if self._tracer.enabled:
+            self._tracer.emit(tracing.PREPARE_QUORUM, pp.digest,
+                              {"seq": key[1], "votes": matching})
         self._send_commit(pp, key)
 
     def _send_commit(self, pp: PrePrepare, key: tuple[int, int]) -> None:
@@ -590,6 +615,9 @@ class OrderingService:
             params = self._bls.update_commit(params, pp)
         commit = Commit(**params)
         self._commits_sent.add(key)
+        if self._tracer.enabled:
+            self._tracer.emit(tracing.COMMIT_SENT, pp.digest,
+                              {"seq": key[1]})
         self._network.send(commit)
         # Count our own commit vote.
         self.commits.setdefault(key, {})[self._data.node_name] = commit
@@ -786,6 +814,10 @@ class OrderingService:
                 self._metrics.add_event(MetricsName.COMMIT_PHASE_TIME,
                                         now - ts[1])
             self._metrics.add_event(MetricsName.ORDERING_TIME, now - ts[0])
+        if self._tracer.enabled:
+            self._tracer.emit(tracing.ORDERED, pp.digest,
+                              {"seq": key[1],
+                               "votes": len(self.commits.get(key, {}))})
         orig_key = (_orig_view(pp), pp.pp_seq_no)
         rerun = self._ordered_originals.get(orig_key) == pp.digest
         self.ordered.add(key)

@@ -46,7 +46,8 @@ class Replica:
                  instance_count: int = 1,
                  external_internal_bus: Optional[InternalBus] = None,
                  metrics=None,
-                 ic_vote_store=None):
+                 ic_vote_store=None,
+                 tracer=None):
         self.name = replica_name(node_name, inst_id)
         self.inst_id = inst_id
         self.config = config or Config()
@@ -66,7 +67,7 @@ class Replica:
         self.ordering = OrderingService(
             data=self._data, timer=timer, bus=self.internal_bus,
             network=network, executor=executor, bls=bls, config=self.config,
-            get_request=get_request, metrics=metrics)
+            get_request=get_request, metrics=metrics, tracer=tracer)
         self.checkpointer = CheckpointService(
             data=self._data, bus=self.internal_bus, network=network,
             config=self.config,

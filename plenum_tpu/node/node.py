@@ -58,6 +58,7 @@ from plenum_tpu.execution.exceptions import (InvalidClientRequest,
 from plenum_tpu.execution.write_manager import ThreePcBatch
 from plenum_tpu.common.metrics import (KvMetricsCollector, MetricsCollector,
                                        MetricsName)
+from plenum_tpu.common import tracing
 from plenum_tpu.node.blacklister import Blacklister
 from plenum_tpu.node.bootstrap import NodeComponents
 from plenum_tpu.node.message_req_processor import MessageReqProcessor
@@ -128,7 +129,8 @@ class Node:
                  client_send: Optional[Callable[[Any, str], None]] = None,
                  config: Optional[Config] = None,
                  instance_count: Optional[int] = None,
-                 metrics: Optional[MetricsCollector] = None):
+                 metrics: Optional[MetricsCollector] = None,
+                 tracer=None):
         self.name = name
         self.timer = timer
         self.node_bus = node_bus
@@ -136,6 +138,11 @@ class Node:
         self.c = components
         self._client_send = client_send or (lambda msg, client: None)
         self.started_at = timer.get_current_time()
+        # tracing plane (common/tracing.py): span events at every pipeline
+        # hop + protocol anomalies, in a bounded flight-recorder ring.
+        # Every emission below is guarded by `tracer.enabled` so the
+        # default NullTracer costs one attribute check per site.
+        self.tracer = tracer if tracer is not None else tracing.NULL_TRACER
         if self.config.GC_SERVER_TUNING:
             from plenum_tpu.common.metrics import tune_gc_for_server
             tune_gc_for_server()
@@ -160,6 +167,18 @@ class Node:
                            "verifier", None)
         if hasattr(verifier, "metrics"):
             verifier.metrics = self.metrics
+        # breaker state transitions are protocol anomalies: the flight
+        # recorder must hold the device-plane story of the seconds before
+        # a fuzz failure or view change (co-hosted nodes share one plane;
+        # the last-attached tracer records for the host, same convention
+        # as the shared plane's metrics hook above)
+        if self.tracer.enabled:
+            from plenum_tpu.parallel.supervisor import find_supervisor
+            sup = find_supervisor(verifier)
+            if sup is not None:
+                sup.breaker.on_transition = (
+                    lambda old, new: self.tracer.anomaly(
+                        "breaker", {"from": old, "to": new}))
 
         self.pool_manager = components.pool_manager
         self.pool_manager._on_changed = self._on_pool_changed
@@ -185,7 +204,8 @@ class Node:
             now=timer.get_current_time,
             validators=lambda: self.validators,
             request_body=self._request_body,
-            digest_gossip=self.config.DIGEST_GOSSIP)
+            digest_gossip=self.config.DIGEST_GOSSIP,
+            tracer=self.tracer)
         # digest -> targeted body-fetch tries so far (digest-gossip: a
         # quorum can complete before any body-carrying propagate arrives)
         self._body_fetches: dict[str, int] = {}
@@ -200,7 +220,8 @@ class Node:
         domain_ledger = self.c.db.get_ledger(DOMAIN_LEDGER_ID)
         self.read_plane = ReadPlane(
             self.c.db, self.c.read_manager, metrics=self.metrics,
-            hasher=domain_ledger.hasher if domain_ledger else None)
+            hasher=domain_ledger.hasher if domain_ledger else None,
+            tracer=self.tracer)
 
         # RBFT: f+1 protocol instances by default (ref replicas.py:19),
         # recomputed as pool membership changes f; an explicit
@@ -706,7 +727,8 @@ class Node:
                 lambda seq: audit.uncommitted_root_hash.hex()),
             instance_count=self._n_instances(),
             metrics=self.metrics if inst_id == 0 else None,
-            ic_vote_store=ic_store)
+            ic_vote_store=ic_store,
+            tracer=self.tracer if inst_id == 0 else None)
         if bls is not None:
             bls.report_bad_signature = lambda sender, r=replica: \
                 r.internal_bus.send(RaisedSuspicion(
@@ -762,6 +784,8 @@ class Node:
         if any(p in ts for p in self._VC_ORDER[rank + 1:]):
             return                      # episode already past this phase
         ts[phase] = self.timer.get_current_time()
+        if phase == "start" and self.tracer.enabled:
+            self.tracer.anomaly("view_change_start", None)
         if phase == "order":
             # metrics emit ONCE, at completion (refreshed stamps would
             # otherwise emit duplicate, drifting durations)
@@ -865,6 +889,9 @@ class Node:
             "primaries": primaries,
             "time": self.timer.get_current_time()})
         self.spylog.append(("view_change_complete", msg.view_no))
+        if self.tracer.enabled:
+            self.tracer.anomaly("view_change_complete",
+                                {"view": msg.view_no})
 
     def _on_suspicion(self, msg: RaisedSuspicion) -> None:
         """Route a protocol suspicion: primary-authored faults become
@@ -872,6 +899,9 @@ class Node:
         sender (ref node.py:2854-2944)."""
         self.metrics.add_event(MetricsName.SUSPICIONS)
         self.spylog.append(("suspicion", (msg.code, msg.sender)))
+        if self.tracer.enabled:
+            self.tracer.anomaly("suspicion", {"code": msg.code,
+                                              "sender": msg.sender})
         if msg.inst_id not in self.replicas:
             return
         replica = self.replicas[msg.inst_id]
@@ -984,6 +1014,8 @@ class Node:
         self._service_ordered()
         self.metrics.add_event(MetricsName.CATCHUPS)
         self.spylog.append(("catchup_started", None))
+        if self.tracer.enabled:
+            self.tracer.anomaly("catchup", None)
         for replica in self.replicas:
             replica.ordering.catchup_started()
         self.leecher.start()
@@ -1216,6 +1248,9 @@ class Node:
                         identifier=request.identifier,
                         req_id=request.req_id, reason=e.reason), frm)
                     continue
+                if self.tracer.enabled:
+                    self.tracer.emit(tracing.INGRESS, request.digest,
+                                     {"frm": frm})
                 to_auth.append((request, frm))
             else:
                 self._client_send(RequestNack(
@@ -1298,6 +1333,8 @@ class Node:
                 self._settle_client(preq, pfrm, ok)
 
     def _settle_client(self, req: Request, frm: str, ok: bool) -> None:
+        if self.tracer.enabled:
+            self.tracer.emit(tracing.AUTH, req.digest, {"ok": bool(ok)})
         if not ok:
             self._client_send(RequestNack(identifier=req.identifier,
                                           req_id=req.req_id,
@@ -1459,6 +1496,13 @@ class Node:
         """Dispatch a signature batch; -> in-flight state or None if the
         verdicts were ready immediately (CPU backend)."""
         token = self.c.authenticator.submit_batch(requests)
+        if self.tracer.enabled:
+            # dispatch provenance: a supervised plane's token names its
+            # route (dev / cpu / hedge); a plain CPU backend resolves
+            # synchronously ("sync")
+            self.tracer.emit(tracing.CRYPTO_DISPATCH, "",
+                             {"n": len(requests),
+                              "kind": getattr(token, "kind", "sync")})
         verdicts = self.c.authenticator.collect_batch(token, wait=False)
         if verdicts is None:
             return (token, items, 0)
@@ -1517,6 +1561,14 @@ class Node:
                                    time.perf_counter() - t0)
             self.metrics.add_event(MetricsName.GROUP_COMMIT_BATCHES,
                                    len(to_exec))
+            if self.tracer.enabled:
+                # batch linkage rides pp_seq_no (Ordered carries no batch
+                # digest); wall duration only when the tracer allows it —
+                # perf_counter deltas are not replay-deterministic
+                data = {"seqs": [m.pp_seq_no for m in to_exec]}
+                if self.tracer.wall_durations:
+                    data["dur"] = time.perf_counter() - t0
+                self.tracer.emit(tracing.DURABLE, "", data)
             with self.metrics.measure_time(MetricsName.COMMIT_REPLY_TIME):
                 for msg, committed in zip(to_exec, committed_per_msg):
                     self._reply_batch(msg, committed)
@@ -1575,6 +1627,9 @@ class Node:
             state = self.propagator.requests.get(digest) if digest else None
             if state is not None and state.client_name is not None:
                 self._client_send(Reply(result=txn), state.client_name)
+                if self.tracer.enabled and digest:
+                    self.tracer.emit(tracing.REPLY, digest,
+                                     {"seq": msg.pp_seq_no})
             # Executed state is RETAINED (freed later by the TTL sweep):
             # peers may still MessageReq this PROPAGATE. Durable client-resend
             # dedup lives in the seq-no DB regardless.

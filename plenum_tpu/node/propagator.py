@@ -33,6 +33,7 @@ from typing import Callable, Optional
 from plenum_tpu.common.node_messages import Propagate, PropagateBatch
 from plenum_tpu.common.quorums import Quorums
 from plenum_tpu.common.request import Request
+from plenum_tpu.common.tracing import NULL_TRACER, PROPAGATE_QUORUM
 
 
 class RequestState:
@@ -108,7 +109,9 @@ class Propagator:
                  now: Callable[[], float],
                  validators: Optional[Callable[[], list]] = None,
                  request_body: Optional[Callable[[str, bool], None]] = None,
-                 digest_gossip: bool = True):
+                 digest_gossip: bool = True,
+                 tracer=None):
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         self.name = name
         self.quorums = quorums
         self.requests = Requests(now)
@@ -233,6 +236,9 @@ class Propagator:
             self._request_body(digest, True)
             return
         state.finalised = True
+        if self._tracer.enabled:
+            self._tracer.emit(PROPAGATE_QUORUM, digest,
+                              {"votes": len(state.propagates)})
         if not state.forwarded:
             state.forwarded = True
             self._forward(digest)

@@ -101,6 +101,11 @@ class CircuitBreaker:
         self.opens = 0
         self.closes = 0
         self.probes = 0
+        # optional observer called as on_transition(old_state, new_state)
+        # on every breaker state change — the tracing plane records these
+        # as flight-recorder anomalies (common/tracing.py); must never
+        # raise into the dispatch path
+        self.on_transition = None
 
     def set_clock(self, now) -> None:
         self._now = now
@@ -139,7 +144,7 @@ class CircuitBreaker:
             # get exponentially rarer, capped
             self.cooldown = min(self.cooldown * 2, self.cooldown_max)
         self._flap_guard = True
-        self.state = OPEN
+        self._transition(OPEN)
         self.opens += 1
         self._opened_at = self._now()
         self._successes_since_close = 0
@@ -149,7 +154,7 @@ class CircuitBreaker:
                 and self._now() - self._opened_at >= self.cooldown)
 
     def to_half_open(self) -> None:
-        self.state = HALF_OPEN
+        self._transition(HALF_OPEN)
         self.probes += 1
 
     def reopen(self) -> None:
@@ -159,11 +164,19 @@ class CircuitBreaker:
 
     def close(self) -> None:
         """Probe + re-warm succeeded: re-admit the device."""
-        self.state = CLOSED
+        self._transition(CLOSED)
         self.closes += 1
         self._consecutive_failures = 0
         self._successes_since_close = 0
         self._opened_at = None
+
+    def _transition(self, new_state: str) -> None:
+        old, self.state = self.state, new_state
+        if self.on_transition is not None and old != new_state:
+            try:
+                self.on_transition(old, new_state)
+            except Exception:
+                pass        # an observer bug must not wedge dispatch
 
 
 class DeadlineBudget:

@@ -56,10 +56,15 @@ class ReadPlane:
 
     def __init__(self, db, read_manager,
                  metrics: Optional[MetricsCollector] = None,
-                 hasher: Optional[TreeHasher] = None):
+                 hasher: Optional[TreeHasher] = None,
+                 tracer=None):
+        from plenum_tpu.common.tracing import NULL_TRACER
         self._db = db
         self._reads = read_manager
         self.metrics = metrics or MetricsCollector()
+        # tracing plane: one read_batch span per tick's query set so read
+        # latency shows up in waterfalls/attribution next to the write path
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self._hasher = hasher or TreeHasher()
         self._anchors: dict[int, _Anchor] = {}
         # txn_root_hex -> committed tree size, recorded at batch commit so
@@ -232,6 +237,13 @@ class ReadPlane:
             # timer — all-cache-hit ticks would flood the p50 with zeros
             self.metrics.add_event(MetricsName.READ_PROOF_GEN_TIME,
                                    proof_s)
+        if self.tracer.enabled:
+            from plenum_tpu.common.tracing import READ_BATCH
+            data = {"n": len(requests), "fresh": len(fresh),
+                    "hits": len(requests) - len(fresh) - len(dups)}
+            if fresh and self.tracer.wall_durations:
+                data["proof_dur"] = proof_s
+            self.tracer.emit(READ_BATCH, "", data)
         return outcomes
 
     def answer(self, request: Request) -> dict:

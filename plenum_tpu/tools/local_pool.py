@@ -59,9 +59,11 @@ def build_genesis(names, node_data_extra=None):
     return {POOL_LEDGER_ID: pool_txns, DOMAIN_LEDGER_ID: [nym]}, trustee
 
 
-def build_pool(n_nodes: int, backend: str, seed: int = 1):
+def build_pool(n_nodes: int, backend: str, seed: int = 1,
+               trace: bool = False):
     from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID, Reply
     from plenum_tpu.common.timer import QueueTimer
+    from plenum_tpu.common.tracing import Tracer
     from plenum_tpu.config import Config
     from plenum_tpu.network import SimNetwork, SimRandom
     from plenum_tpu.node import Node, NodeBootstrap
@@ -106,11 +108,16 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1):
         components = NodeBootstrap(name, genesis_txns=genesis,
                                    crypto_backend=backend,
                                    verifier=plane).build()
+        # traced runs carry real Tracers (shared in-process clock, so
+        # assembly alignment is the identity); untraced runs keep the
+        # NullTracer fast path and stay the honest TPS figures
+        tracer = Tracer(name, timer.get_current_time,
+                        clock_domain="shared") if trace else None
         nodes[name] = Node(
             name, timer, bus, components,
             client_send=lambda msg, client, n=name: replies[n].append(
                 (time.perf_counter(), msg, client)),
-            config=config)
+            config=config, tracer=tracer)
     net.connect_all()
     return (names, nodes, timer, trustee, replies, Reply, DOMAIN_LEDGER_ID,
             plane, net)
@@ -144,13 +151,14 @@ def commit_stage_stats(metrics) -> dict:
 
 
 def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
-             timeout: float = 120.0) -> dict:
+             timeout: float = 120.0, trace: bool = False) -> dict:
     from plenum_tpu.common.request import Request
     from plenum_tpu.crypto.ed25519 import Ed25519Signer
     from plenum_tpu.execution.txn import NYM
 
     (names, nodes, timer, trustee,
-     replies, Reply, DOMAIN_LEDGER_ID, plane, net) = build_pool(n_nodes, backend)
+     replies, Reply, DOMAIN_LEDGER_ID, plane, net) = build_pool(
+         n_nodes, backend, trace=trace)
 
     # pre-sign the whole workload so client-side signing isn't measured
     requests = []
@@ -213,6 +221,25 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                        for d in first_reply if d in submit_times)
     sizes = {nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size for n in names}
     stage = commit_stage_stats(nodes[names[0]].metrics)
+    trace_summary = None
+    if trace:
+        # assemble the per-node rings into the bench line's waterfall
+        # summary, and check stage sums against the MEASURED client e2e
+        # latency (submit -> first REPLY) — both ride one process clock
+        from plenum_tpu.common.metrics import percentile
+        from plenum_tpu.tools.trace_report import assemble, summarize
+        report = assemble([nodes[n].tracer.snapshot() for n in names])
+        trace_summary = summarize(report)
+        ratios = []
+        for digest, per_node in report["requests"].items():
+            e2e = (first_reply.get(digest, 0.0)
+                   - submit_times.get(digest, 0.0))
+            wf = per_node.get(names[0])
+            if wf is not None and e2e > 0:
+                ratios.append(wf["total"] / e2e)
+        if ratios:
+            trace_summary["stage_sum_vs_e2e_p50"] = round(
+                percentile(ratios, 0.5), 4)
     plane_stats = None
     if plane is not None:
         from plenum_tpu.parallel.supervisor import find_supervisor
@@ -224,6 +251,7 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                             "fallback_batches", "hedge_wins",
                             "deadline_misses", "device_batches")}
     return {
+        **({"trace": trace_summary} if trace_summary else {}),
         **({"commit_stage": stage} if stage else {}),
         **({"crypto_plane": plane_stats,
             "backend_state": {"closed": "ok", "half_open": "fallback",

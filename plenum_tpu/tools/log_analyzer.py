@@ -95,6 +95,55 @@ def read_events(path: str, last_s: Optional[float] = None) -> list[dict]:
     return rows
 
 
+def read_flight_anomalies(node_dir: str,
+                          last_s: Optional[float] = None) -> list[dict]:
+    """Flight-recorder dumps (<node_dir>/*flight*.json, common/tracing)
+    -> anomaly rows in events.jsonl shape, named `flight.<kind>` so the
+    timeline distinguishes recorder-sourced rows from spylog ones.
+
+    Times are mapped onto the wall clock when the dump carries a wall
+    anchor (TCP pools); shared-clock sim dumps keep their timer times.
+    Dumps overlap across a numbered series — rows are deduplicated by
+    (t, kind) so a dump-per-anomaly cascade doesn't multiply counts."""
+    rows: list[dict] = []
+    seen: set = set()
+    for path in sorted(glob.glob(os.path.join(node_dir, "*flight*.json"))):
+        try:
+            with open(path, errors="replace") as fh:
+                dump = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        off = 0.0
+        if dump.get("clock_domain") == "wall" \
+                and dump.get("wall_anchor") is not None:
+            off = dump["wall_anchor"] - dump["mono_anchor"]
+        for ev in dump.get("events", ()):
+            try:
+                t, stage, _key, data = ev
+            except (TypeError, ValueError):
+                continue
+            if not isinstance(stage, str) \
+                    or not stage.startswith("anomaly."):
+                continue
+            kind = stage[len("anomaly."):]
+            # payload is part of the identity: the frozen per-cycle clock
+            # stamps two same-kind anomalies from one prod cycle with one
+            # timestamp, and only the payload tells them apart — dedup
+            # exists solely for the overlap across a numbered dump series
+            dedup_key = (t, kind,
+                         json.dumps(data, sort_keys=True, default=repr))
+            if dedup_key in seen:
+                continue
+            seen.add(dedup_key)
+            rows.append({"t": t + off, "event": f"flight.{kind}",
+                         "data": data})
+    rows.sort(key=lambda r: r["t"])
+    if last_s is not None and rows:
+        cutoff = rows[-1]["t"] - last_s
+        rows = [r for r in rows if r["t"] >= cutoff]
+    return rows
+
+
 def view_timeline(events: list[dict]) -> list[dict]:
     """Partition events into per-view segments. A view segment opens at
     the preceding view's `view_change_complete` (view 0 opens at the
@@ -131,12 +180,21 @@ def view_timeline(events: list[dict]) -> list[dict]:
 
 def analyze_node(node_dir: str, last_s: Optional[float] = None) -> dict:
     events = read_events(os.path.join(node_dir, "events.jsonl"), last_s)
+    # flight-recorder anomalies (breaker transitions, tracer-side VC /
+    # catchup / suspicion stamps) merge into the SAME per-view timeline:
+    # a view segment then shows the device-plane story next to the
+    # protocol one, which is exactly what a breaker-open-during-VC
+    # postmortem needs in one place
+    flight = read_flight_anomalies(node_dir, last_s)
+    if flight:
+        events = sorted(events + flight, key=lambda r: r.get("t", 0))
     counts: dict[str, int] = {}
     for r in events:
         counts[r.get("event", "?")] = counts.get(r.get("event", "?"), 0) + 1
     return {
         "node": os.path.basename(node_dir.rstrip("/")),
         "event_counts": counts,
+        "flight_anomalies": len(flight),
         "views": view_timeline(events),
         "error_clusters": cluster_log_text(
             os.path.join(node_dir, "node.log")),
@@ -177,7 +235,8 @@ def main(argv=None):
         dirs = sorted(d for d in glob.glob(os.path.join(args.base_dir, "*"))
                       if os.path.isdir(d)
                       and (os.path.exists(os.path.join(d, "events.jsonl"))
-                           or os.path.exists(os.path.join(d, "node.log"))))
+                           or os.path.exists(os.path.join(d, "node.log"))
+                           or glob.glob(os.path.join(d, "*flight*.json"))))
     reports = [analyze_node(d, args.last_s) for d in dirs]
     if args.json:
         print(json.dumps(reports, indent=2))

@@ -130,9 +130,18 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
                                on_request=None,
                                max_connections=config.MAX_CONNECTED_CLIENTS,
                                idle_timeout=config.CLIENT_CONN_IDLE_TIMEOUT)
+    # flight recorder: per-digest span ring + anomaly auto-dumps next to
+    # the keys (<node>/<node>-flight-N.json). clock_domain="wall": each
+    # OS process runs its own perf_counter epoch, so the tracer anchors
+    # its monotonic timeline to time.time() once at construction and
+    # tools.trace_report aligns the pool's dumps from those anchors.
+    from plenum_tpu.common.tracing import make_tracer
+    tracer = make_tracer(name, timer.get_current_time, config=config,
+                         dump_dir=os.path.join(base_dir, name),
+                         clock_domain="wall", wall=time.time)
     node = Node(name, timer, node_stack.bus, components,
                 client_send=client_stack.send, config=config,
-                metrics=metrics)
+                metrics=metrics, tracer=tracer)
     # durable structured event log: every spylog entry (view changes,
     # catchups, suspicions, VC stall phases) appends a JSONL row that
     # tools.log_analyzer turns into per-view timelines. Seeded with the
@@ -263,6 +272,12 @@ def main(argv=None):
             # last periodic flush would otherwise die with the process
             node._sample_transport_stats()
             node._flush_metrics()
+        except Exception:
+            pass
+        try:
+            # the flight-recorder ring's last seconds go to disk too, so
+            # a pool torn down mid-incident still yields waterfalls
+            node.tracer.dump()
         except Exception:
             pass
         # 128+SIGTERM: supervisors must see termination, not a clean exit

@@ -31,6 +31,9 @@ except AttributeError:
 # NOTE: the persistent compile cache is configured by plenum_tpu.ops
 # (~/.cache/plenum_tpu/jax) — kernels cache across runs automatically.
 
+import json  # noqa: E402
+import weakref  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -42,3 +45,48 @@ def tdir(tmp_path):
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running e2e tests (process pools, fuzzing)")
+
+
+# --- flight-recorder dump on failure ----------------------------------------
+# Sim pools (test_pool.Pool) register here at construction; when a test
+# fails, every still-alive registered pool's per-node flight-recorder ring
+# (common/tracing.py) is appended to the test report, so a red test
+# arrives with its last-seconds span/anomaly story instead of just an
+# assertion message. Weak references: pools die with their tests, and a
+# stale pool from an earlier (passed) test drops out as soon as it is
+# collected.
+FLIGHT_POOLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_pool_for_flight_dump(pool) -> None:
+    FLIGHT_POOLS.add(pool)
+
+
+def flight_ring_lines(max_events: int = 40) -> list[str]:
+    """Render every registered pool's rings (newest events last)."""
+    lines: list[str] = []
+    for pool in list(FLIGHT_POOLS):
+        for name, node in sorted(getattr(pool, "nodes", {}).items()):
+            tracer = getattr(node, "tracer", None)
+            if tracer is None or not getattr(tracer, "enabled", False):
+                continue
+            snap = tracer.snapshot()
+            events = snap["events"][-max_events:]
+            lines.append(f"--- {name}: {len(snap['events'])} ring events "
+                         f"({snap['anomalies']} anomalies), last "
+                         f"{len(events)} ---")
+            lines.extend(json.dumps(ev, default=repr) for ev in events)
+    return lines
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed:
+        try:
+            lines = flight_ring_lines()
+        except Exception:
+            lines = []
+        if lines:
+            rep.sections.append(("flight recorder", "\n".join(lines)))

@@ -54,19 +54,27 @@ def make_genesis(names, validator_names=None):
 
 class Pool:
     def __init__(self, names=NODES, seed=42, config=None, data_dir=None,
-                 validator_names=None, verifier=None):
+                 validator_names=None, verifier=None, tracing=True):
         self.names = list(names)
         self.timer = MockTimer()
         self.net = SimNetwork(self.timer, SimRandom(seed))
         self.config = config or Config(Max3PCBatchWait=0.05)
         self.verifier = verifier          # shared crypto plane (co-hosted)
         self.data_dir = data_dir          # per-node durable storage root
+        self.tracing = tracing            # flight recorders on every node
         self.genesis, self.trustee = make_genesis(self.names, validator_names)
         self.client_msgs: dict[str, list] = {n: [] for n in self.names}
         self.nodes: dict[str, Node] = {}
         for name in self.names:
             self.start_node(name)
         self.net.connect_all()
+        # conftest dumps every registered pool's flight-recorder rings
+        # into the test report when the test fails
+        try:
+            from conftest import register_pool_for_flight_dump
+            register_pool_for_flight_dump(self)
+        except ImportError:
+            pass
 
     def _node_data_dir(self, name):
         import os
@@ -82,11 +90,14 @@ class Pool:
             crypto_backend=self.config.crypto_backend,
             storage_backend=self.config.kv_backend,
             verifier=self.verifier).build()
+        from plenum_tpu.common.tracing import Tracer
+        tracer = Tracer(name, self.timer.get_current_time,
+                        clock_domain="shared") if self.tracing else None
         self.nodes[name] = Node(
             name, self.timer, bus, components,
             client_send=lambda msg, client, n=name:
                 self.client_msgs[n].append((msg, client)),
-            config=self.config)
+            config=self.config, tracer=tracer)
         return self.nodes[name]
 
     def crash_node(self, name: str) -> None:

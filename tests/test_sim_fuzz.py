@@ -33,6 +33,60 @@ FAST = dict(Max3PCBatchWait=0.05,
 
 N_SEEDS = 100
 
+# --- flight-recorder failure artifacts --------------------------------------
+# Every scenario tracks its pool here; a failing rung dumps ALL nodes'
+# flight-recorder rings (span events + anomalies: the pool's last-seconds
+# story) to a temp dir and names it in the assertion, so a fuzz failure
+# arrives debuggable instead of as a bare seed number.
+_SCENARIO_POOLS: list = []
+
+
+def _track(pool):
+    _SCENARIO_POOLS.clear()
+    _SCENARIO_POOLS.append(pool)
+    return pool
+
+
+def _dump_flight_artifacts(label: str):
+    import os
+    import tempfile
+    if not _SCENARIO_POOLS:
+        return None
+    pool = _SCENARIO_POOLS[0]
+    out = tempfile.mkdtemp(prefix=f"plenum_flight_{label}_")
+    dumped = 0
+    for name, node in sorted(pool.nodes.items()):
+        tracer = getattr(node, "tracer", None)
+        if tracer is not None and getattr(tracer, "enabled", False):
+            tracer.dump(os.path.join(out, f"{name}-flight.json"))
+            dumped += 1
+    return out if dumped else None
+
+
+def _run_with_artifacts(scenario, seed: int) -> None:
+    try:
+        scenario(seed)
+    except AssertionError as e:
+        artifacts = _dump_flight_artifacts(f"seed{seed}")
+        if artifacts is not None:
+            raise AssertionError(
+                f"{e} [flight-recorder rings of all nodes: "
+                f"{artifacts}]") from e
+        raise
+    except BaseException:
+        # crash bugs (and Ctrl-C) still get their artifacts, but the
+        # original exception TYPE re-raises untouched — wrapping a
+        # KeyboardInterrupt as AssertionError would turn an abort into a
+        # recorded failure and keep the sweep running
+        import sys
+        artifacts = _dump_flight_artifacts(f"seed{seed}")
+        if artifacts is not None:
+            print(f"[flight-recorder rings of all nodes: {artifacts}]",
+                  file=sys.stderr)
+        raise
+    finally:
+        _SCENARIO_POOLS.clear()
+
 
 def _domain_txns(node) -> list[str]:
     ledger = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
@@ -61,10 +115,11 @@ def run_scenario(seed: int) -> None:
     if scenario == 3:
         import tempfile
         durable = tempfile.mkdtemp(prefix="plenum_fuzz_s3_")
-        pool = Pool(seed=seed, config=Config(**FAST, kv_backend="native"),
-                    data_dir=durable)
+        pool = _track(Pool(seed=seed,
+                           config=Config(**FAST, kv_backend="native"),
+                           data_dir=durable))
     else:
-        pool = Pool(seed=seed, config=Config(**FAST))
+        pool = _track(Pool(seed=seed, config=Config(**FAST)))
     primary = pool.nodes["Alpha"].master_replica.data.primary_name
 
     users = [Ed25519Signer(seed=(b"fuzz%d-%d" % (seed, i)).ljust(32, b"\0")[:32])
@@ -283,7 +338,7 @@ def run_device_flap_scenario(seed: int) -> None:
                                cooldown=rng.float(0.5, 1.5)),
         budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
                               warm_max=1.0, cold_max=1.0))
-    pool = Pool(seed=seed, config=Config(**FAST), verifier=sup)
+    pool = _track(Pool(seed=seed, config=Config(**FAST), verifier=sup))
     # the supervisor's whole state machine runs on SIM time: any failing
     # seed replays exactly
     sup.set_clock(pool.timer.get_current_time)
@@ -358,7 +413,7 @@ def run_lying_reader_scenario(seed: int) -> None:
     from test_reads import FOREVER, LyingPlane, make_driver
 
     rng = SimRandom(seed * 6151 + 13)
-    pool = Pool(seed=seed, config=Config(**FAST))
+    pool = _track(Pool(seed=seed, config=Config(**FAST)))
     user = Ed25519Signer(seed=(b"liar%d" % seed).ljust(32, b"\0")[:32])
     assert _order_and_time(pool, signed_nym(pool.trustee, user, 1), 2) \
         is not None
@@ -452,12 +507,12 @@ LYING_READER_SEEDS = 20
 @pytest.mark.parametrize("bucket", range(4))
 def test_sim_lying_reader_fuzz(bucket):
     for seed in range(bucket * 5, (bucket + 1) * 5):
-        run_lying_reader_scenario(seed)
+        _run_with_artifacts(run_lying_reader_scenario, seed)
 
 
 def test_sim_lying_reader_smoke():
     """One lying_reader scenario always runs in the default suite."""
-    run_lying_reader_scenario(2)
+    _run_with_artifacts(run_lying_reader_scenario, 2)
 
 
 def test_sim_lying_reader_stale_replay():
@@ -506,12 +561,12 @@ def test_sim_lying_reader_stale_replay():
 @pytest.mark.parametrize("bucket", range(4))
 def test_sim_device_flap_fuzz(bucket):
     for seed in range(bucket * 5, (bucket + 1) * 5):
-        run_device_flap_scenario(seed)
+        _run_with_artifacts(run_device_flap_scenario, seed)
 
 
 def test_sim_device_flap_smoke():
     """One device_flap scenario always runs in the default suite."""
-    run_device_flap_scenario(3)
+    _run_with_artifacts(run_device_flap_scenario, 3)
 
 
 # 100 seeds, bucketed so failures show their seed range and xdist can split
@@ -520,7 +575,7 @@ def test_sim_device_flap_smoke():
 def test_sim_view_change_fuzz(bucket):
     for seed in range(bucket * (N_SEEDS // 10),
                       (bucket + 1) * (N_SEEDS // 10)):
-        run_scenario(seed)
+        _run_with_artifacts(run_scenario, seed)
 
 
 def test_sim_fuzz_smoke():
@@ -532,5 +587,41 @@ def test_sim_fuzz_smoke():
         kind = rng.integer(0, 5)
         if kind not in seen:
             seen.add(kind)
-            run_scenario(seed)
+            _run_with_artifacts(run_scenario, seed)
         seed += 1
+
+
+def test_fuzz_failure_artifact_includes_all_rings(tmp_path):
+    """The failure path itself: a failing rung must leave every node's
+    flight-recorder ring on disk and name the artifact dir in the
+    assertion (the acceptance shape for 'fuzz failures arrive with their
+    last-seconds story')."""
+    import glob
+    import json
+    import shutil
+
+    def failing_scenario(seed):
+        pool = _track(Pool(seed=seed, config=Config(**FAST)))
+        user = Ed25519Signer(seed=b"artifact-user".ljust(32, b"\0")[:32])
+        assert _order_and_time(pool, signed_nym(pool.trustee, user, 1), 2) \
+            is not None
+        raise AssertionError("synthetic rung failure")
+
+    with pytest.raises(AssertionError) as exc:
+        _run_with_artifacts(failing_scenario, 7)
+    msg = str(exc.value)
+    assert "flight-recorder rings of all nodes" in msg
+    art_dir = msg.rsplit(": ", 1)[1].rstrip("]")
+    try:
+        dumps = sorted(glob.glob(art_dir + "/*-flight.json"))
+        assert len(dumps) == 4, dumps          # one ring per node
+        for path in dumps:
+            with open(path) as fh:
+                snap = json.load(fh)
+            # the rings hold the pre-failure story: the ordered request's
+            # span events are there
+            stages = {e[1] for e in snap["events"]}
+            assert "ordered" in stages and "reply" in stages, \
+                (path, sorted(stages))
+    finally:
+        shutil.rmtree(art_dir, ignore_errors=True)

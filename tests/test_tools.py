@@ -294,6 +294,67 @@ def test_distinct_signers_config_orders_owner_writes():
     assert r["distinct_signers"] == 40
 
 
+def test_replay_reproduces_span_sequence():
+    """Record/replay x tracing determinism guard: replaying a recorded
+    node under the mock clock reproduces a BYTE-IDENTICAL span sequence.
+    Span timestamps come only from the injectable timer and payloads only
+    from message content (wall_durations=False strips the perf_counter
+    stage durations, the one legitimately non-deterministic field), so
+    any divergence here means a span site leaked wall state into the
+    trace — the property the flight-recorder postmortems rely on."""
+    from plenum_tpu.common.event_bus import ExternalBus
+    from plenum_tpu.common.timer import MockTimer
+    from plenum_tpu.common.tracing import Tracer, span_sequence
+    from plenum_tpu.config import Config
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.network import SimNetwork, SimRandom
+    from plenum_tpu.node import Node, NodeBootstrap
+    from plenum_tpu.node.recorder import Recorder, attach_recorder, replay
+    from plenum_tpu.storage.kv_memory import KvMemory
+    from test_pool import NODES, make_genesis, signed_nym
+
+    genesis, trustee = make_genesis(NODES)
+    timer = MockTimer()
+    net = SimNetwork(timer, SimRandom(11))
+    config = Config(Max3PCBatchWait=0.05)
+    recorder = Recorder(KvMemory(), now=timer.get_current_time)
+    nodes = {}
+    for name in NODES:
+        bus = net.create_peer(name)
+        components = NodeBootstrap(name, genesis_txns=genesis).build()
+        tracer = Tracer(name, timer.get_current_time,
+                        wall_durations=False) if name == "Alpha" else None
+        nodes[name] = Node(name, timer, bus, components, config=config,
+                           tracer=tracer)
+        if name == "Alpha":
+            # before connect_all: the Connected events must be recorded
+            attach_recorder(nodes[name], recorder)
+    net.connect_all()
+
+    user = Ed25519Signer(seed=b"replay-span-user".ljust(32, b"\0"))
+    req = signed_nym(trustee, user, 1)
+    for name in NODES:
+        nodes[name].handle_client_message(req.to_dict(), "cli")
+    for _ in range(100):
+        for node in nodes.values():
+            node.prod()
+        timer.advance(0.05)
+    live = span_sequence(nodes["Alpha"].tracer.snapshot())
+    assert b'"ordered"' in live and b'"reply"' in live
+
+    # fresh Alpha from the same genesis; feed the recorded stream back
+    first_ts = next(ts for ts, *_ in recorder.iter_records())
+    timer2 = MockTimer(start=first_ts)
+    bus2 = ExternalBus(send_handler=lambda msg, dst: None)
+    components2 = NodeBootstrap("Alpha", genesis_txns=genesis).build()
+    tracer2 = Tracer("Alpha", timer2.get_current_time,
+                     wall_durations=False)
+    node2 = Node("Alpha", timer2, bus2, components2, config=config,
+                 tracer=tracer2)
+    replay(recorder.iter_records(), node2, timer2)
+    assert span_sequence(tracer2.snapshot()) == live
+
+
 def test_log_analyzer_unit(tmp_path):
     """Analyzer halves: error clustering over text, per-view timeline
     over structured events (ref scripts/process_logs redesign)."""
