@@ -224,6 +224,12 @@ def main():
             ppb = t["commit_stage"].get("pairings_per_batch")
             if ppb is not None and "pairings_per_batch" not in result:
                 result["pairings_per_batch"] = ppb
+    # closed-loop batch-controller acceptance: where the knobs ENDED
+    # (batch size / wait / in-flight depth / coalescing) and the rolling
+    # per-stage p50/p95 vs the SLO that steered them, per config
+    for t, prefix in ((cpu, "cpu"), (tcp, "tcp"), (tcpsvc, "tcpsvc")):
+        if t and t.get("controller"):
+            result[f"{prefix}_controller"] = t["controller"]
     # tracing plane: per-stage critical-path p50/p95, sampled waterfalls,
     # and how much of the measured e2e latency the stage sum attributes
     if traced and traced.get("trace"):
@@ -309,6 +315,12 @@ def main():
                 c5["propagate_bytes_per_txn"]
         if c5.get("commit_stage"):
             result["config5_commit_stage"] = c5["commit_stage"]
+        # pipelining A/B (legacy static knobs vs deep window + controller)
+        # + the host-contention calibration that diagnosed the r04/r05
+        # "regression" as a loaded bench host, not ordering cost
+        for k in ("legacy_tps", "calib_ms", "controller"):
+            if c5.get(k) is not None:
+                result[f"config5_{k}"] = c5[k]
         # verified read plane acceptance: reads/s at 90:10 read:write,
         # measured per-read fanout (target 2 vs legacy 2n), and the
         # client-side proof-verify p50/p95 the read budget rides on

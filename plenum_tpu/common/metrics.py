@@ -81,6 +81,13 @@ class MetricsName:
     READ_PROOFLESS = "read_plane.proofless"
     READ_ANCHOR_UPDATES = "read_plane.anchor_updates"
     # consensus
+    # closed-loop batch controller (consensus/batch_controller.py): knob
+    # gauges (read back via `last`) + a cumulative decision counter
+    BATCH_CTL_SIZE = "batch_ctl.size"
+    BATCH_CTL_WAIT = "batch_ctl.wait"
+    BATCH_CTL_DEPTH = "batch_ctl.depth"
+    BATCH_CTL_COALESCE = "batch_ctl.coalesce"
+    BATCH_CTL_DECISIONS = "batch_ctl.decisions"
     VIEW_CHANGES = "consensus.view_changes"
     SUSPICIONS = "consensus.suspicions"
     BACKUP_INSTANCE_REMOVED = "consensus.backup_instance_removed"

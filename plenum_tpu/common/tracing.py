@@ -65,6 +65,7 @@ ORDERED = "ordered"                  # commit quorum -> Ordered emitted
 APPLY = "apply"                      # uncommitted batch apply completed
 # Pool-keyed (key = ""):
 DURABLE = "durable"                  # group-commit flush closed (data: seqs)
+CONTROLLER = "controller"            # batch-controller decision (data: knobs)
 CRYPTO_DISPATCH = "crypto_dispatch"  # signature batch dispatched (data: kind)
 READ_BATCH = "read_batch"            # read plane served a tick's queries
 

@@ -17,7 +17,47 @@ class Config:
     # --- 3PC batching (ref plenum/config.py:256-258) ---
     Max3PCBatchSize: int = 1000
     Max3PCBatchWait: float = 0.1        # ref default 3s; we run a faster loop
-    Max3PCBatchesInFlight: int = 4
+    # Deep in-flight window: how far the primary's speculative uncommitted
+    # batches may run AHEAD of the last committed one before fresh cuts
+    # pause (still clamped by the [low, low+LOG_SIZE] watermark window and
+    # reverted wholesale on view change). The reference pinned this at 4,
+    # which made every slow commit stall all fresh cuts; the batch
+    # controller steers the EFFECTIVE depth within [4, this] at runtime.
+    Max3PCBatchesInFlight: int = 64
+
+    # --- closed-loop batch controller (consensus/batch_controller.py) ---
+    # AIMD steering of batch size / partial-batch wait / in-flight depth /
+    # group-commit coalescing from rolling per-stage latency attribution
+    # (queue wait, 3PC span, durable flush — all stamped on the injectable
+    # timer) toward the latency SLO below. False freezes every knob at its
+    # static config value.
+    BATCH_CONTROLLER: bool = True
+    # p95 latency target (seconds) for the SUM of the controller's three
+    # attributed stages: oldest-request queue wait at cut + cut->commit-
+    # quorum span + durable-flush span (each p95 taken over its own
+    # rolling window — a conservative, pipelining-agnostic bound on a
+    # request's batch-path latency, NOT a single batch's cut->flush
+    # measurement; note the queue stage deliberately contains the batch
+    # wait itself, so the SLO must comfortably exceed BATCH_WAIT_MAX)
+    BATCH_SLO_P95: float = 0.5
+    # decision cadence on the node timer (seconds)
+    BATCH_CONTROL_INTERVAL: float = 0.5
+    # bounds the controller roams within: Max3PCBatchWait is the STARTING
+    # wait; the controller may grow it to BATCH_WAIT_MAX when per-batch
+    # fixed costs dominate (coalesce harder) or shrink it to BATCH_WAIT_MIN
+    # when queueing dominates. Max3PCBatchSize stays the hard size cap.
+    BATCH_WAIT_MIN: float = 0.005
+    # half the SLO: a fully-grown wait must not trip the SLO by itself
+    # (the queue stage contains the deliberate batch wait)
+    BATCH_WAIT_MAX: float = 0.25
+    BATCH_SIZE_MIN: int = 16
+    # how many ready Ordered batches may coalesce under ONE group-commit
+    # scope per drain — the hard cap; the controller starts at min(8, cap)
+    # and steers within [that, this] (+4 when flush amortization pays,
+    # −1 decay under headroom). Deep pipelines can stack dozens of ready
+    # batches, and an unbounded scope would put every earlier batch's
+    # REPLY behind the whole stack's flush.
+    GROUP_COMMIT_MAX_BATCHES: int = 32
 
     # --- checkpoints / watermarks (ref config.py:273-276) ---
     CHK_FREQ: int = 100

@@ -61,7 +61,7 @@ class OrderingService:
                  bls: Optional[BlsBftReplica] = None,
                  config: Optional[Config] = None,
                  get_request: Optional[Callable[[str], Optional[Request]]] = None,
-                 metrics=None, tracer=None):
+                 metrics=None, tracer=None, controller=None):
         self._data = data
         self._timer = timer
         # per-phase 3PC timing (ref metrics_collector.py's 3PC names):
@@ -77,6 +77,14 @@ class OrderingService:
         self._bls = bls
         self._config = config or Config()
         self._get_request = get_request or (lambda digest: None)
+        # closed-loop batch controller (batch_controller.py): when present
+        # its steered knobs replace the static Max3PCBatchSize /
+        # Max3PCBatchWait / Max3PCBatchesInFlight reads, and the primary
+        # feeds it timer-stamped batch-lifecycle samples
+        self._controller = controller
+        # (view, pp_seq_no) -> cut stamp on the injectable timer; feeds
+        # the controller's cut -> commit-quorum span on the primary
+        self._cut_ts: dict[tuple[int, int], float] = {}
 
         # 3PC logs (all keyed by (view_no, pp_seq_no))
         self.sent_preprepares: dict[tuple[int, int], PrePrepare] = {}
@@ -103,7 +111,13 @@ class OrderingService:
         # PRE-PREPARE seq-no (ref last_sent_pp_store_helper.py).
         self.on_backup_pp_sent = None
 
-        self._stasher = StashingRouter()
+        # wrong-instance traffic is rejected by the accept pre-filter
+        # before any dispatch bookkeeping (at f+1 instances, 8 of 9 router
+        # dispatches on the shared bus are another instance's messages);
+        # _validate keeps its own inst_id check for direct callers
+        self._stasher = StashingRouter(
+            accept=lambda m: getattr(m, "inst_id", self._data.inst_id)
+            == self._data.inst_id)
         self._stasher.subscribe(PrePrepare, self.process_preprepare)
         self._stasher.subscribe(Prepare, self.process_prepare)
         self._stasher.subscribe(Commit, self.process_commit)
@@ -114,10 +128,6 @@ class OrderingService:
         bus.subscribe(NewViewCheckpointsApplied,
                       self.process_new_view_checkpoints_applied)
 
-        # ledger_id -> time the oldest queued request arrived; a partial
-        # batch is cut only after Max3PCBatchWait so small flushes coalesce
-        # (the accumulate-then-flush policy of SURVEY.md §7 stage 6)
-        self._queue_first_ts: dict[int, float] = {}
         # ledger_id -> absolute deadline for the next freshness batch
         self._freshness_deadline: dict[int, float] = {}
         # (orig_view, pp_seq_no) -> cited digest: NewView batches we lack
@@ -149,9 +159,12 @@ class OrderingService:
             return
         ledger_id = (self._executor.ledger_id_for(req)
                      if self._executor else DOMAIN_LEDGER_ID)
-        self.request_queues.setdefault(ledger_id, OrderedDict())[msg.digest] = None
-        self._queue_first_ts.setdefault(ledger_id,
-                                        self._timer.get_current_time())
+        # queue VALUES are enqueue stamps (injectable timer): the partial-
+        # batch wait is measured from the oldest queued request's own
+        # stamp, so no code path can restart a waiting request's clock.
+        # setdefault: a duplicate ReqKey must not refresh the stamp.
+        self.request_queues.setdefault(ledger_id, OrderedDict()).setdefault(
+            msg.digest, self._timer.get_current_time())
         self._stasher.process_all_stashed(StashReason.MISSING_REQUESTS)
         # a NewView re-proposal deferred on THIS request (the primary
         # lacked it): resume the pass — idempotent, skips batches already
@@ -215,31 +228,46 @@ class OrderingService:
         (ref send_3pc_batch :1961). Returns number of batches sent."""
         sent = 0
         now = self._timer.get_current_time()
+        # effective knobs: controller-steered when the loop is closed,
+        # static config otherwise
+        ctl = self._controller
+        max_size = (ctl.batch_size if ctl is not None
+                    else self._config.Max3PCBatchSize)
+        max_wait = (ctl.batch_wait if ctl is not None
+                    else self._config.Max3PCBatchWait)
+        depth = (ctl.depth if ctl is not None
+                 else self._config.Max3PCBatchesInFlight)
         ledgers = [ledger_id] if ledger_id is not None else list(self.request_queues)
         for lid in ledgers:
             queue = self.request_queues.setdefault(lid, OrderedDict())
             if not queue and not force_empty:
-                self._queue_first_ts.pop(lid, None)
                 continue
-            # partial batches wait up to Max3PCBatchWait for more requests
-            # (full ones cut immediately) — the previously-dead batching knob
-            if (not force_empty
-                    and len(queue) < self._config.Max3PCBatchSize
-                    and now - self._queue_first_ts.get(lid, now)
-                    < self._config.Max3PCBatchWait):
+            # Partial batches wait up to the batch wait for more requests
+            # (full ones cut immediately). The wait is measured from the
+            # OLDEST queued request's own enqueue stamp (the queue value):
+            # the previous per-ledger clock was re-armed every prod tick
+            # that left leftovers behind — e.g. while the in-flight gate
+            # held — so under a steady trickle a partial batch could wait
+            # far past the configured bound.
+            if (not force_empty and len(queue) < max_size
+                    and now - next(iter(queue.values())) < max_wait):
                 continue
             while queue or force_empty:
                 if self._data.pp_seq_no + 1 > self._data.high_watermark:
                     break
-                # bound the pipeline depth (ref Max3PCBatchesInFlight)
+                # bound the SPECULATIVE window: how far uncommitted applies
+                # may run ahead of the last committed batch. Deep by
+                # default (the watermark window above is the hard protocol
+                # bound; revert-on-view-change unwinds the whole stack),
+                # controller-steered so a saturated pool backs off.
                 if (not force_empty and self._data.pp_seq_no
-                        - self._data.last_ordered_3pc[1]
-                        >= self._config.Max3PCBatchesInFlight):
+                        - self._data.last_ordered_3pc[1] >= depth):
                     break
                 digests = []
+                oldest_cut = now
                 bodyless = []
-                while queue and len(digests) < self._config.Max3PCBatchSize:
-                    digest = queue.popitem(last=False)[0]
+                while queue and len(digests) < max_size:
+                    digest, enq_ts = queue.popitem(last=False)
                     # finalize-without-body guard (digest-gossip): a batch
                     # must never cite a request whose body this primary
                     # does not hold — re-queue it and pull the body
@@ -247,24 +275,32 @@ class OrderingService:
                         bodyless.append(digest)
                     else:
                         digests.append(digest)
+                        oldest_cut = min(oldest_cut, enq_ts)
+                # Bodyless digests are re-queued with a FRESH stamp: they
+                # cannot be batched until a body lands anyway, so the
+                # restart is harmless, it throttles the RequestPropagates
+                # retry below to once per batch wait, and a byzantine
+                # never-arriving body cannot sit at the queue head aging
+                # the wait gate (and the controller's queue-wait
+                # attribution) forever.
                 for digest in bodyless:
-                    queue[digest] = None
+                    queue[digest] = now
                 if bodyless:
                     self._bus.send(RequestPropagates(
                         bad_requests=tuple(bodyless)))
                 if not digests and not force_empty:
                     break        # everything queued is awaiting its body
-                self._send_one_batch(lid, digests)
+                # queue wait attributed from the oldest request actually
+                # CUT (a stale bodyless head must not inflate the sample)
+                self._send_one_batch(lid, digests,
+                                     queue_wait=max(0.0, now - oldest_cut))
                 sent += 1
                 if force_empty:
                     break
-            if queue:
-                self._queue_first_ts[lid] = now     # leftovers start waiting
-            else:
-                self._queue_first_ts.pop(lid, None)
         return sent
 
-    def _send_one_batch(self, ledger_id: int, digests: list[str]) -> None:
+    def _send_one_batch(self, ledger_id: int, digests: list[str],
+                        queue_wait: float = 0.0) -> None:
         reqs = [r for r in (self._get_request(d) for d in digests) if r is not None]
         pp_time = self._timer.get_current_time()
         view_no = self._data.view_no
@@ -299,6 +335,9 @@ class OrderingService:
         key = (view_no, pp_seq_no)
         self.sent_preprepares[key] = pre_prepare
         self.prePrepares[key] = pre_prepare
+        if self._controller is not None:
+            self._controller.note_batch_cut(queue_wait, len(digests))
+            self._cut_ts[key] = pp_time
         if self._metrics is not None:
             self._phase_ts[key] = [self._timer.get_current_time(), None]
         if self._tracer.enabled:
@@ -814,6 +853,12 @@ class OrderingService:
                 self._metrics.add_event(MetricsName.COMMIT_PHASE_TIME,
                                         now - ts[1])
             self._metrics.add_event(MetricsName.ORDERING_TIME, now - ts[0])
+        t_cut = self._cut_ts.pop(key, None)
+        if t_cut is not None and self._controller is not None:
+            # cut -> commit quorum on the injectable timer: the 3PC span
+            # sample the controller steers depth/size against
+            self._controller.note_ordered(
+                self._timer.get_current_time() - t_cut)
         if self._tracer.enabled:
             self._tracer.emit(tracing.ORDERED, pp.digest,
                               {"seq": key[1],
@@ -876,12 +921,12 @@ class OrderingService:
             pp = self.prePrepares.get((batch_id.view_no, batch_id.pp_seq_no))
             if pp is not None:
                 queue = self.request_queues.setdefault(ledger_id, OrderedDict())
+                now = self._timer.get_current_time()
                 for digest in pp.req_idr:
-                    queue[digest] = None
-                # start the batch-wait clock: without this the partial-batch
-                # gate would postpone re-proposing reverted requests forever
-                self._queue_first_ts.setdefault(
-                    ledger_id, self._timer.get_current_time())
+                    # re-enqueue with a fresh batch-wait stamp (the original
+                    # enqueue time died with the reverted batch); setdefault
+                    # so a digest already waiting keeps its older stamp
+                    queue.setdefault(digest, now)
             count += 1
         return count
 
@@ -988,6 +1033,7 @@ class OrderingService:
         """Entering a view change: revert uncommitted work, remember old-view
         pre-prepares for possible re-ordering (ref :2380)."""
         self._phase_ts.clear()      # timings don't span views
+        self._cut_ts.clear()        # controller spans don't span views
         self.revert_unordered_batches()
         # ALL pre-prepares (ordered ones too) become old-view material: a
         # NewView may cite an already-ordered batch, and both the re-sending
@@ -1163,7 +1209,8 @@ class OrderingService:
         """Drop 3PC log entries at or below a stabilized checkpoint."""
         seq = stable_3pc[1]
         for store in (self.prePrepares, self.sent_preprepares,
-                      self.prepares, self.commits, self._phase_ts):
+                      self.prepares, self.commits, self._phase_ts,
+                      self._cut_ts):
             for k in [k for k in store if k[1] <= seq]:
                 del store[k]
         # certificate lists follow the same lifetime as the 3PC logs

@@ -47,7 +47,8 @@ class Replica:
                  external_internal_bus: Optional[InternalBus] = None,
                  metrics=None,
                  ic_vote_store=None,
-                 tracer=None):
+                 tracer=None,
+                 controller=None):
         self.name = replica_name(node_name, inst_id)
         self.inst_id = inst_id
         self.config = config or Config()
@@ -64,10 +65,15 @@ class Replica:
         if bls is not None:
             bls.set_quorums(self._data.quorums)
 
+        # closed-loop batch controller: a MASTER-instance concern (backup
+        # instances shadow-order the same traffic; steering their batching
+        # would fight the monitor's master-vs-backup comparison)
+        self.batch_controller = controller if self._data.is_master else None
         self.ordering = OrderingService(
             data=self._data, timer=timer, bus=self.internal_bus,
             network=network, executor=executor, bls=bls, config=self.config,
-            get_request=get_request, metrics=metrics, tracer=tracer)
+            get_request=get_request, metrics=metrics, tracer=tracer,
+            controller=self.batch_controller)
         self.checkpointer = CheckpointService(
             data=self._data, bus=self.internal_bus, network=network,
             config=self.config,

@@ -148,11 +148,22 @@ class SimNetwork:
             targets = [n for n in self._peers if n != frm]
         else:
             targets = [d for d in dst]
+        # pack-once broadcast: one wire serialization shared by every
+        # target of this send (a real transport packs a broadcast frame
+        # once too). Keyed by object identity, and the cache value PINS
+        # the message object: a Mutate rule's per-destination replacement
+        # may be garbage-collected as soon as its _route returns, and a
+        # later replacement allocated at the recycled address would
+        # otherwise hit the dead entry and deliver the previous
+        # mutation's bytes. Holding the reference (and re-checking `is`)
+        # makes identity-keying sound for the send's lifetime.
+        pack_cache: dict[int, tuple[Any, dict, bytes]] = {}
         for d in targets:
             self.sent_count += 1
-            self._route(msg, frm, d)
+            self._route(msg, frm, d, pack_cache)
 
-    def _route(self, msg: Any, frm: str, dst: str) -> None:
+    def _route(self, msg: Any, frm: str, dst: str,
+               pack_cache: Optional[dict] = None) -> None:
         # Last-added rule wins, like a filter stack.
         for rule in reversed(self._rules):
             if not all(sel(msg, frm, dst) for sel in rule.selectors):
@@ -172,17 +183,23 @@ class SimNetwork:
                 continue        # mutated message keeps flowing down the chain
             if isinstance(rule.action, Deliver):
                 delay = self._random.float(rule.action.min_delay, rule.action.max_delay)
-                self._schedule(delay, msg, frm, dst)
+                self._schedule(delay, msg, frm, dst, pack_cache)
                 return
         delay = self._random.float(self.min_latency, self.max_latency)
-        self._schedule(delay, msg, frm, dst)
+        self._schedule(delay, msg, frm, dst, pack_cache)
 
-    def _schedule(self, delay: float, msg: Any, frm: str, dst: str) -> None:
+    def _schedule(self, delay: float, msg: Any, frm: str, dst: str,
+                  pack_cache: Optional[dict] = None) -> None:
         if self._wire_roundtrip and isinstance(msg, MessageBase):
             # Serialize now (sender's view), deserialize at delivery — exactly
             # what a real wire does, so schema violations fail loudly in sims.
-            d = msg.to_dict()
-            data = pack(d)
+            cached = pack_cache.get(id(msg)) if pack_cache is not None else None
+            if cached is None or cached[0] is not msg:
+                d = msg.to_dict()
+                cached = (msg, d, pack(d))
+                if pack_cache is not None:
+                    pack_cache[id(msg)] = cached
+            _, d, data = cached
             row = self.tx_msgs.setdefault(d.get("op", "?"), [0, 0])
             row[0] += 1
             row[1] += len(data)

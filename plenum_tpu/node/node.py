@@ -223,6 +223,14 @@ class Node:
             hasher=domain_ledger.hasher if domain_ledger else None,
             tracer=self.tracer)
 
+        # closed-loop batch controller (consensus/batch_controller.py):
+        # steers batch size / wait / in-flight depth / group-commit
+        # coalescing from timer-stamped stage samples; one per node,
+        # wired into the MASTER ordering service and the drain loop below
+        from plenum_tpu.consensus.batch_controller import make_controller
+        self.batch_controller = make_controller(
+            self.config, timer, tracer=self.tracer, metrics=self.metrics)
+
         # RBFT: f+1 protocol instances by default (ref replicas.py:19),
         # recomputed as pool membership changes f; an explicit
         # instance_count PINS the count (BASELINE config 2 runs 3)
@@ -728,7 +736,8 @@ class Node:
             instance_count=self._n_instances(),
             metrics=self.metrics if inst_id == 0 else None,
             ic_vote_store=ic_store,
-            tracer=self.tracer if inst_id == 0 else None)
+            tracer=self.tracer if inst_id == 0 else None,
+            controller=self.batch_controller if inst_id == 0 else None)
         if bls is not None:
             bls.report_bad_signature = lambda sender, r=replica: \
                 r.internal_bus.send(RaisedSuspicion(
@@ -1542,36 +1551,56 @@ class Node:
                 exec_floor = msg.pp_seq_no
             if not to_exec:
                 continue
-            # GROUP COMMIT: every ready batch commits under ONE write_batch
+            # GROUP COMMIT: ready batches commit under ONE write_batch
             # scope per store — the flush coalesces across batches
             # (catchup-style multi-batch commit). REPLIES go out only after
             # the scope closes: a client ack must never precede the durable
-            # flush backing it.
-            committed_per_msg: list[list[dict]] = []
-            t0 = time.perf_counter()
-            with self.c.executor.group_commit():
-                for msg in to_exec:
-                    self.metrics.add_event(MetricsName.ORDERED_BATCH_SIZE,
-                                           len(msg.req_idr))
-                    with self.metrics.measure_time(
-                            MetricsName.EXECUTE_BATCH_TIME):
-                        committed_per_msg.append(self._commit_ordered(msg))
-                    self._last_executed_pp_seq = msg.pp_seq_no
-            self.metrics.add_event(MetricsName.COMMIT_DURABLE_TIME,
-                                   time.perf_counter() - t0)
-            self.metrics.add_event(MetricsName.GROUP_COMMIT_BATCHES,
-                                   len(to_exec))
-            if self.tracer.enabled:
-                # batch linkage rides pp_seq_no (Ordered carries no batch
-                # digest); wall duration only when the tracer allows it —
-                # perf_counter deltas are not replay-deterministic
-                data = {"seqs": [m.pp_seq_no for m in to_exec]}
-                if self.tracer.wall_durations:
-                    data["dur"] = time.perf_counter() - t0
-                self.tracer.emit(tracing.DURABLE, "", data)
-            with self.metrics.measure_time(MetricsName.COMMIT_REPLY_TIME):
-                for msg, committed in zip(to_exec, committed_per_msg):
-                    self._reply_batch(msg, committed)
+            # flush backing it. Coalescing is CAPPED (controller-steered):
+            # a deep pipeline can stack dozens of ready batches, and an
+            # unbounded scope would put the first batch's replies behind
+            # the whole stack's flush.
+            limit = max(1, (self.batch_controller.group_commit_max
+                            if self.batch_controller is not None
+                            else self.config.GROUP_COMMIT_MAX_BATCHES))
+            while to_exec:
+                chunk, to_exec = to_exec[:limit], to_exec[limit:]
+                committed_per_msg: list[list[dict]] = []
+                t0 = time.perf_counter()
+                t0_timer = self.timer.get_current_time()
+                with self.c.executor.group_commit():
+                    for msg in chunk:
+                        self.metrics.add_event(MetricsName.ORDERED_BATCH_SIZE,
+                                               len(msg.req_idr))
+                        with self.metrics.measure_time(
+                                MetricsName.EXECUTE_BATCH_TIME):
+                            committed_per_msg.append(self._commit_ordered(msg))
+                        self._last_executed_pp_seq = msg.pp_seq_no
+                self.metrics.add_event(MetricsName.COMMIT_DURABLE_TIME,
+                                       time.perf_counter() - t0)
+                self.metrics.add_event(MetricsName.GROUP_COMMIT_BATCHES,
+                                       len(chunk))
+                if (self.batch_controller is not None
+                        and self.replicas.master.data.is_primary):
+                    # flush span on the injectable timer (0 under mock
+                    # time — deterministic): the controller's durable
+                    # stage. Only the acting master primary feeds its
+                    # controller — on every other node the loop would
+                    # otherwise tick on durable-only samples and drift
+                    # the knobs nobody reads there.
+                    self.batch_controller.note_durable(
+                        self.timer.get_current_time() - t0_timer,
+                        len(chunk))
+                if self.tracer.enabled:
+                    # batch linkage rides pp_seq_no (Ordered carries no batch
+                    # digest); wall duration only when the tracer allows it —
+                    # perf_counter deltas are not replay-deterministic
+                    data = {"seqs": [m.pp_seq_no for m in chunk]}
+                    if self.tracer.wall_durations:
+                        data["dur"] = time.perf_counter() - t0
+                    self.tracer.emit(tracing.DURABLE, "", data)
+                with self.metrics.measure_time(MetricsName.COMMIT_REPLY_TIME):
+                    for msg, committed in zip(chunk, committed_per_msg):
+                        self._reply_batch(msg, committed)
         return done
 
     def _commit_ordered(self, msg: Ordered) -> list[dict]:
@@ -1694,4 +1723,7 @@ class Node:
             "ledgers": ledgers,
             "metrics": self.metrics.summary(),
             "monitor": self.monitor.stats(),
+            "batch_controller": (self.batch_controller.trajectory()
+                                 if self.batch_controller is not None
+                                 else None),
         }

@@ -311,39 +311,91 @@ def config4_viewchange_under_load(n_txns: int = 150,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _host_calib_ms() -> float:
+    """Fixed deterministic CPU spin, timed. The sim25 figure is a pure
+    single-process CPU measurement, so host contention scales it directly
+    — the BENCH_r04/r05 'regression' (47-52 -> 13-15 TPS) reproduced at
+    ~45-53 TPS on an idle host with the very same code, while the bench
+    rounds ran it last in a round that had just hammered the host with
+    multi-process TCP pools. This calibration figure rides the bench line
+    so a contended round is READABLE as contended (calib_ms inflates with
+    the same factor) instead of masquerading as an ordering regression."""
+    import hashlib
+    t0 = time.perf_counter()
+    block = b"\0" * 65536
+    h = hashlib.sha256()
+    for _ in range(200):
+        h.update(block)
+    return round((time.perf_counter() - t0) * 1000, 2)
+
+
+def _sim25_once(n_txns: int, timeout: float, config_overrides=None) -> dict:
+    import plenum_tpu.tools.local_pool as lp
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import NYM
+
+    (names, nodes, timer, trustee,
+     replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(
+         25, "cpu", config_overrides=config_overrides)
+    reqs = []
+    for i in range(n_txns):
+        user = Ed25519Signer(seed=(b"s25_%05d" % i).ljust(32, b"\0")[:32])
+        req = Request(trustee.identifier, i + 1,
+                      {"type": NYM, "dest": user.identifier,
+                       "verkey": user.verkey_b58})
+        req.signature = trustee.sign_b58(req.signing_bytes())
+        reqs.append(req)
+    done, dt = _drive_inprocess(names, nodes, timer, replies, ReplyCls,
+                                plane, reqs, timeout)
+    wire = net.bytes_summary()
+    prop = sum(c["bytes"] for op, c in wire["by_type"].items()
+               if op in ("PROPAGATE", "PROPAGATE_BATCH"))
+    stage = lp.commit_stage_stats(nodes[names[0]].metrics)
+    ctl = getattr(nodes[names[0]], "batch_controller", None)
+    return {"nodes": 25, "txns_ordered": done, "txns_requested": n_txns,
+            "tps": round(done / dt, 1) if dt else 0.0,
+            "wire_bytes_per_txn": round(wire["total_bytes"] / done)
+            if done else None,
+            "propagate_bytes_per_txn": round(prop / done)
+            if done else None,
+            **({"controller": ctl.trajectory()} if ctl is not None else {}),
+            **({"commit_stage": stage} if stage else {})}
+
+
 def config5_sim25(n_txns: int = 60, timeout: float = 180.0) -> dict:
     """25-node simulated pool (SimNetwork fabric, one process) ordering
-    datum — the scale test's shape (tests/test_scale.py) with a number."""
-    import plenum_tpu.tools.local_pool as lp
-    from plenum_tpu.common.node_messages import Reply
+    datum — the scale test's shape (tests/test_scale.py) with a number.
 
+    Runs an A/B: the default deep-pipelined + controller-steered ordering
+    vs the legacy static knobs (in-flight window 4, no controller), plus a
+    host-contention calibration so a loaded bench host can't masquerade as
+    an ordering regression (see _host_calib_ms). Tracing note: this config
+    runs the NullTracer fast path — it keeps NO tracing overhead, and the
+    calib figure is the only non-pool work it pays for."""
     try:
-        (names, nodes, timer, trustee,
-         replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(25, "cpu")
-        from plenum_tpu.common.request import Request
-        from plenum_tpu.crypto.ed25519 import Ed25519Signer
-        from plenum_tpu.execution.txn import NYM
-        reqs = []
-        for i in range(n_txns):
-            user = Ed25519Signer(seed=(b"s25_%05d" % i).ljust(32, b"\0")[:32])
-            req = Request(trustee.identifier, i + 1,
-                          {"type": NYM, "dest": user.identifier,
-                           "verkey": user.verkey_b58})
-            req.signature = trustee.sign_b58(req.signing_bytes())
-            reqs.append(req)
-        done, dt = _drive_inprocess(names, nodes, timer, replies, ReplyCls,
-                                    plane, reqs, timeout)
-        wire = net.bytes_summary()
-        prop = sum(c["bytes"] for op, c in wire["by_type"].items()
-                   if op in ("PROPAGATE", "PROPAGATE_BATCH"))
-        stage = lp.commit_stage_stats(nodes[names[0]].metrics)
-        return {"nodes": 25, "txns_ordered": done, "txns_requested": n_txns,
-                "tps": round(done / dt, 1) if dt else 0.0,
-                "wire_bytes_per_txn": round(wire["total_bytes"] / done)
-                if done else None,
-                "propagate_bytes_per_txn": round(prop / done)
-                if done else None,
-                **({"commit_stage": stage} if stage else {})}
+        calib = _host_calib_ms()
+        # One DISCARDED warm-up pass, then 3 runs per arm INTERLEAVED and
+        # medians taken: single sim25 passes ride a ±20% host-noise band
+        # (the r04/r05 lesson), and the first pool in a process runs
+        # measurably cold — an A/B that always ran one arm first
+        # systematically penalized it (measured: same arm 54.7 first vs
+        # 67.6 fourth in one process).
+        legacy_cfg = {"BATCH_CONTROLLER": False, "Max3PCBatchesInFlight": 4}
+        _sim25_once(n_txns, timeout)             # warm-up, discarded
+        runs, legacy_runs = [], []
+        for _ in range(3):
+            runs.append(_sim25_once(n_txns, timeout))
+            legacy_runs.append(_sim25_once(n_txns, timeout,
+                                           config_overrides=legacy_cfg))
+        runs.sort(key=lambda r: r["tps"])
+        legacy_runs.sort(key=lambda r: r["tps"])
+        out = runs[1]
+        out["tps_spread"] = {"min": runs[0]["tps"], "max": runs[-1]["tps"]}
+        out["calib_ms"] = calib
+        out["tracing_overhead"] = "none (NullTracer fast path)"
+        out["legacy_tps"] = legacy_runs[1].get("tps")
+        return out
     except Exception as e:                       # pragma: no cover
         return {"error": f"{type(e).__name__}: {e}"}
 

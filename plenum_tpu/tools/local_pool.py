@@ -60,7 +60,7 @@ def build_genesis(names, node_data_extra=None):
 
 
 def build_pool(n_nodes: int, backend: str, seed: int = 1,
-               trace: bool = False):
+               trace: bool = False, config_overrides: dict = None):
     from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID, Reply
     from plenum_tpu.common.timer import QueueTimer
     from plenum_tpu.common.tracing import Tracer
@@ -78,7 +78,8 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1,
     # batches amortize the per-batch BLS sign+aggregate-verify; p99
     # halves vs 5ms while p50 holds)
     config = Config(Max3PCBatchWait=0.05, crypto_backend=backend,
-                    STATE_FRESHNESS_UPDATE_INTERVAL=600.0)
+                    STATE_FRESHNESS_UPDATE_INTERVAL=600.0,
+                    **(config_overrides or {}))
     replies: dict[str, list] = {n: [] for n in names}
     nodes = {}
     # co-hosted nodes share ONE coalescing crypto plane: the verify kernel
@@ -151,14 +152,15 @@ def commit_stage_stats(metrics) -> dict:
 
 
 def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
-             timeout: float = 120.0, trace: bool = False) -> dict:
+             timeout: float = 120.0, trace: bool = False,
+             config_overrides: dict = None) -> dict:
     from plenum_tpu.common.request import Request
     from plenum_tpu.crypto.ed25519 import Ed25519Signer
     from plenum_tpu.execution.txn import NYM
 
     (names, nodes, timer, trustee,
      replies, Reply, DOMAIN_LEDGER_ID, plane, net) = build_pool(
-         n_nodes, backend, trace=trace)
+         n_nodes, backend, trace=trace, config_overrides=config_overrides)
 
     # pre-sign the whole workload so client-side signing isn't measured
     requests = []
@@ -250,8 +252,14 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                            ("breaker_state", "breaker_opens",
                             "fallback_batches", "hedge_wins",
                             "deadline_misses", "device_batches")}
+    # controller trajectory from the master PRIMARY (Node1 under the
+    # round-robin selector): final knob positions + the rolling per-stage
+    # p50/p95 vs the SLO that put them there — the bench line's view of
+    # the closed loop
+    ctl = getattr(nodes[names[0]], "batch_controller", None)
     return {
         **({"trace": trace_summary} if trace_summary else {}),
+        **({"controller": ctl.trajectory()} if ctl is not None else {}),
         **({"commit_stage": stage} if stage else {}),
         **({"crypto_plane": plane_stats,
             "backend_state": {"closed": "ok", "half_open": "fallback",

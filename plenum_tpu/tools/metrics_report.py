@@ -194,6 +194,20 @@ def derive_summary(folds: dict[str, dict], span_s: float,
     if "crypto.bls_local_fallbacks" in folds:
         out["bls_local_fallbacks"] = int(
             cum("crypto.bls_local_fallbacks") or 0)
+    # closed-loop batch controller (docs/performance.md "Pipelined
+    # ordering"): where the steered knobs sit (latest gauge) and how many
+    # decisions the loop has made — a flat decision count under load
+    # means the loop is not seeing samples (wrong node, or disabled)
+    ctl_size = folds.get("batch_ctl.size", {})
+    if ctl_size.get("last") is not None:
+        out["batch_controller"] = {
+            "batch_size": int(ctl_size["last"]),
+            "wait_ms": _ms(folds.get("batch_ctl.wait", {}).get("last")),
+            "depth": int(folds.get("batch_ctl.depth", {}).get("last") or 0),
+            "coalesce": int(
+                folds.get("batch_ctl.coalesce", {}).get("last") or 0),
+            "decisions": int(cum("batch_ctl.decisions") or 0),
+        }
     # verified read plane (docs/reads.md): volume, cache effectiveness,
     # proof mix, and the proof-generation stage p50/p95 — a read-latency
     # regression must localize to proof gen vs everything else, and a

@@ -120,9 +120,14 @@ class _NodeIndex:
         self.durable_by_seq: dict[int, float] = {}
         self.stage_durs: dict[str, list[float]] = {}
         self.anomalies: list[tuple[float, str, dict]] = []
+        # batch-controller decisions, in order: the control trajectory
+        # (knob positions + the stage p95s that moved them)
+        self.control: list[tuple[float, dict]] = []
         for t, stage, key, data in dump["events"]:
             at = t + offset
             self.first.setdefault((stage, key), at)
+            if stage == tracing.CONTROLLER:
+                self.control.append((at, data or {}))
             if stage in (tracing.PP_SENT, tracing.PP_RECV):
                 for req in (data or {}).get("reqs", ()):
                     self.batch_of_req.setdefault(
@@ -231,9 +236,10 @@ def assemble(dumps: list[dict]) -> dict:
     anomalies = sorted((a for idx in indexes
                         for a in ((t, idx.node, kind, data)
                                   for t, kind, data in idx.anomalies)))
+    controller = {idx.node: idx.control for idx in indexes if idx.control}
     return {"nodes": sorted(offsets), "offsets": offsets,
             "requests": requests, "attribution": attribution,
-            "anomalies": anomalies}
+            "anomalies": anomalies, "controller": controller}
 
 
 def attribution_summary(report: dict) -> dict:
@@ -264,6 +270,13 @@ def summarize(report: dict, sample: int = 3) -> dict:
                 "stages_ms": {k: round(v * 1000, 3)
                               for k, v in wf["stages"].items()},
                 "total_ms": round(wf["total"] * 1000, 3)}
+    # control trajectory: the steering node's decision count + final knobs
+    control = None
+    for node, decisions in sorted(report.get("controller", {}).items(),
+                                  key=lambda kv: -len(kv[1])):
+        control = {"node": node, "decisions": len(decisions),
+                   "final": decisions[-1][1]}
+        break
     return {
         "requests_traced": len(report["requests"]),
         "attribution": attribution,
@@ -272,6 +285,7 @@ def summarize(report: dict, sample: int = 3) -> dict:
         "stage_sum_ratio_p50": round(percentile(ratios, 0.5), 4)
         if ratios else None,
         "anomalies": len(report["anomalies"]),
+        **({"controller": control} if control else {}),
     }
 
 
@@ -284,6 +298,13 @@ def _print_report(report: dict, last_n: int) -> None:
     print(hdr + "\n  " + "-" * (len(hdr) - 2))
     for name, s in attribution_summary(report).items():
         print(f"  {name:12} {s['p50_ms']:>10} {s['p95_ms']:>10} {s['n']:>8}")
+    for node, decisions in sorted(report.get("controller", {}).items()):
+        print(f"\ncontrol trajectory @{node} ({len(decisions)} decisions):")
+        for t, d in decisions[-last_n * 2:]:
+            print(f"  {t:.3f} {d.get('verdict', '?'):16} "
+                  f"size={d.get('batch_size')} wait={d.get('wait_ms')}ms "
+                  f"depth={d.get('depth')} coalesce={d.get('coalesce')} "
+                  f"e2e_p95={d.get('e2e_p95_ms')}ms slo={d.get('slo_ms')}ms")
     shown = 0
     for digest, per_node in sorted(report["requests"].items()):
         if shown >= last_n:
@@ -319,6 +340,18 @@ def _synthetic_dumps() -> list[dict]:
             [0.040, tracing.ORDERED, batch, {"seq": 1, "votes": 2}],
             [0.045, tracing.DURABLE, "", {"seqs": [1], "dur": 0.005}],
             [0.046, tracing.REPLY, req, {"seq": 1}],
+            # batch-controller decisions: the control trajectory the
+            # report must surface next to the waterfalls it steered
+            [0.050, tracing.CONTROLLER, "",
+             {"verdict": "grow:headroom", "batch_size": 1000,
+              "wait_ms": 50.0, "depth": 5, "coalesce": 32,
+              "p95_ms": {"queue": 3.0, "ordering": 19.0, "durable": 0.0},
+              "e2e_p95_ms": 22.0, "slo_ms": 500.0, "fill": 0.06}],
+            [0.055, tracing.CONTROLLER, "",
+             {"verdict": "grow:fixed-cost", "batch_size": 1000,
+              "wait_ms": 75.0, "depth": 5, "coalesce": 32,
+              "p95_ms": {"queue": 3.0, "ordering": 600.0, "durable": 0.0},
+              "e2e_p95_ms": 603.0, "slo_ms": 500.0, "fill": 0.06}],
         ]}
     # replica epoch 50s off the primary AND its wall anchor reads 10 ms
     # slow (NTP-grade skew): anchor alignment alone leaves pp_recv BEFORE
@@ -366,6 +399,14 @@ def self_check() -> int:
         problems.append("causality alignment failed (negative network)")
     if not report["anomalies"]:
         problems.append("anomaly timeline empty")
+    ctl = report.get("controller", {}).get("P")
+    if not ctl or len(ctl) != 2:
+        problems.append(f"controller trajectory missing/short: {ctl}")
+    else:
+        summary = summarize(report)
+        final = summary.get("controller", {}).get("final", {})
+        if final.get("verdict") != "grow:fixed-cost":
+            problems.append(f"controller final decision wrong: {final}")
     print(json.dumps({"check": "ok" if not problems else "FAIL",
                       "problems": problems,
                       "attribution": att}))

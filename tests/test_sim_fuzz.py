@@ -578,6 +578,25 @@ def test_sim_view_change_fuzz(bucket):
         _run_with_artifacts(run_scenario, seed)
 
 
+def test_sim_fuzz_deep_window():
+    """Existing scenario kinds under an AGGRESSIVELY deep pipeline:
+    size-1 batches and a watermark-wide in-flight window keep many
+    speculative uncommitted batches in flight straight through the fault,
+    so revert-on-view-change and catchup re-staging run against a deep
+    stack instead of the old 4-batch one. Seed 3 draws the primary
+    blackout (partition of the primary), seed 4 the lossy network; plus
+    one device_flap run with the crypto plane as the fault."""
+    saved = dict(FAST)
+    FAST.update(Max3PCBatchSize=1, Max3PCBatchesInFlight=300)
+    try:
+        _run_with_artifacts(run_scenario, 3)            # primary blackout
+        _run_with_artifacts(run_scenario, 4)            # lossy network
+        _run_with_artifacts(run_device_flap_scenario, 4)
+    finally:
+        FAST.clear()
+        FAST.update(saved)
+
+
 def test_sim_fuzz_smoke():
     """One scenario of each kind always runs in the default suite."""
     seen: set[int] = set()
