@@ -332,6 +332,19 @@ def main():
                   "server_cache_hit_rate"):
             if c6.get(k) is not None:
                 result[f"config6_{k}"] = c6[k]
+        # million-client ingress plane acceptance (docs/ingress.md):
+        # 10k simulated clients at 95:5 read:write — observer-served
+        # verified reads, batched front-door auth (auth_batch_mean >> 1),
+        # and the overload A/B (bounded queue + explicit sheds vs the
+        # no-ingress arm's unbounded inbox)
+        c7 = bc.config7_ingress_10k(n_ops=3000)
+        result["config7_ingress_reads_per_s"] = c7.get("reads_per_s",
+                                                       c7.get("error"))
+        for k in ("clients", "observer_served", "auth_batch_mean",
+                  "ingress_admitted", "ingress_shed", "writes_ordered",
+                  "read_fanout", "overload_ab"):
+            if c7.get(k) is not None:
+                result[f"config7_{k}"] = c7[k]
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))

@@ -29,10 +29,15 @@ class PoolClient:
         self.f = f
         self._conns: dict[str, tuple] = {}
 
+    def _addr_of(self, name: str) -> tuple[str, int]:
+        """Dial-address lookup seam: subclasses serving extra tiers
+        (VerifyingReadClient's observers) widen THIS, not _conn."""
+        return self.node_addrs[name]
+
     async def _conn(self, name: str):
         conn = self._conns.get(name)
         if conn is None:
-            host, port = self.node_addrs[name]
+            host, port = self._addr_of(name)
             conn = await asyncio.open_connection(host, port)
             self._conns[name] = conn
         return conn

@@ -80,6 +80,29 @@ class MetricsName:
     READ_PROOFS_MERKLE = "read_plane.proofs_merkle"
     READ_PROOFLESS = "read_plane.proofless"
     READ_ANCHOR_UPDATES = "read_plane.anchor_updates"
+    # ingress plane (ingress/plane.py): admitted/shed counters, the
+    # queue-wait and total-queue-depth distributions (sampled -> p50/p95
+    # in the report), per-dispatch auth batch size (sampled -> the batch
+    # size histogram the amortization claim rides on), auth rejects, and
+    # the per-client fairness spread sampled at controller decisions
+    INGRESS_ADMITTED = "ingress.admitted"
+    INGRESS_SHED = "ingress.shed"
+    INGRESS_QUEUE_WAIT = "ingress.queue_wait"
+    INGRESS_QUEUE_DEPTH = "ingress.queue_depth"
+    INGRESS_AUTH_BATCH = "ingress.auth_batch"
+    INGRESS_AUTH_FAIL = "ingress.auth_fail"
+    INGRESS_CLIENTS = "ingress.clients"
+    INGRESS_FAIRNESS_SPREAD = "ingress.fairness_spread"
+    # ingress admission controller knob gauges (read back via `last`) +
+    # cumulative decision counter, mirroring batch_ctl.*
+    INGRESS_CTL_ADMIT = "ingress_ctl.admit_max"
+    INGRESS_CTL_WATERMARK = "ingress_ctl.watermark"
+    INGRESS_CTL_DECISIONS = "ingress_ctl.decisions"
+    # observer read fan-out (ingress/observer_reads.py)
+    OBSERVER_PUSHES = "observer.pushes"
+    OBSERVER_MS_ADOPTED = "observer.ms_adopted"
+    OBSERVER_MS_REJECTED = "observer.ms_rejected"
+    OBSERVER_STALE_SUPPRESSED = "observer.stale_suppressed"
     # consensus
     # closed-loop batch controller (consensus/batch_controller.py): knob
     # gauges (read back via `last`) + a cumulative decision counter
@@ -231,6 +254,8 @@ SAMPLED_NAMES = frozenset({
     MetricsName.BLS_PAIRINGS_PER_BATCH,
     MetricsName.CRYPTO_DISPATCH_BUDGET,
     MetricsName.READ_PROOF_GEN_TIME,
+    MetricsName.INGRESS_QUEUE_WAIT, MetricsName.INGRESS_QUEUE_DEPTH,
+    MetricsName.INGRESS_AUTH_BATCH,
 })
 SAMPLE_CAP = 256
 

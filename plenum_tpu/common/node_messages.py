@@ -272,6 +272,23 @@ class Reject(MessageBase):
 
 
 @wire_message
+class LoadShed(MessageBase):
+    """Explicit admission-control refusal from the ingress plane
+    (ingress/plane.py): the request was never queued — shed-before-wedge.
+    Distinct from REQNACK (which judges the request itself): a shed says
+    nothing about validity, only that the front door is over its
+    watermark, so a client may retry after backing off."""
+    typename = "LOAD_SHED"
+    identifier: str
+    req_id: int
+    reason: str
+    retry_after: float = 0.0          # advisory client backoff (seconds)
+
+    def validate(self) -> None:
+        self._require_non_negative("retry_after")
+
+
+@wire_message
 class Reply(MessageBase):
     typename = "REPLY"
     result: dict                      # committed txn incl. seq_no, merkle proof
@@ -315,6 +332,21 @@ class BatchCommitted(MessageBase):
     txn_root: str
     seq_no_start: int
     seq_no_end: int
+    # newest BLS multi-signature the pushing validator holds for this
+    # ledger (MultiSignature.to_list()), so observers can anchor verified
+    # reads (ingress/observer_reads.py). OPTIONAL and EXCLUDED from the
+    # observer's f+1 content quorum: honest validators legitimately
+    # aggregate different COMMIT-sig subsets (different participant
+    # lists), and the sig is self-verifying against the pool BLS keys —
+    # it needs verification, not agreement.
+    multi_sig: Optional[tuple] = None
+
+    def quorum_dict(self) -> dict:
+        """The content the observer push quorum votes on (multi_sig
+        stripped — see field comment)."""
+        d = self.to_dict()
+        d.pop("multi_sig", None)
+        return d
 
 
 @wire_message

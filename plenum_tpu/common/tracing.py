@@ -63,7 +63,13 @@ PREPARE_QUORUM = "prepare_quorum"    # n-f matching PREPAREs
 COMMIT_SENT = "commit_sent"          # own COMMIT broadcast
 ORDERED = "ordered"                  # commit quorum -> Ordered emitted
 APPLY = "apply"                      # uncommitted batch apply completed
+# Ingress-plane (front door; request-keyed where a digest exists):
+ING_ADMIT = "ing_admit"              # request admitted into its client queue
+ING_SHED = "ing_shed"                # explicit load-shed reply (data: reason)
 # Pool-keyed (key = ""):
+ING_AUTH = "ing_auth"                # ingress auth batch dispatched (data: n, sigs)
+ING_VERDICT = "ing_verdict"          # ingress auth verdicts landed (data: ok, fail)
+ING_CONTROLLER = "ing_controller"    # admission-controller decision (data: knobs)
 DURABLE = "durable"                  # group-commit flush closed (data: seqs)
 CONTROLLER = "controller"            # batch-controller decision (data: knobs)
 CRYPTO_DISPATCH = "crypto_dispatch"  # signature batch dispatched (data: kind)

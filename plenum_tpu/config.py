@@ -179,6 +179,37 @@ class Config:
     # PRE-PREPARE until a checkpoint-lag catchup 100 batches later
     EXECUTED_REQ_RETENTION: float = 120.0
 
+    # --- ingress plane (ingress/plane.py): the pool's front door ---
+    # per-client bounded queue: one flooding client can hold at most this
+    # many writes queued before ITS OWN new arrivals shed (other clients'
+    # queues are untouched — fairness before the global watermark)
+    INGRESS_CLIENT_QUEUE_CAP: int = 32
+    # global watermarks over the SUM of all client queues: at the high
+    # mark new arrivals shed (explicit LoadShed reply) until the total
+    # drains below the low mark — hysteresis so the plane sheds decisively
+    # instead of flapping at the boundary (shed-before-wedge)
+    INGRESS_HIGH_WATERMARK: int = 4096
+    INGRESS_LOW_WATERMARK: int = 1024
+    # per-tick weighted-fair dequeue budget into the batched verifier; the
+    # ingress controller steers the effective budget within [MIN, MAX]
+    INGRESS_ADMIT_MAX: int = 512
+    INGRESS_ADMIT_MIN: int = 64
+    # how often the plane drains its queues into one auth batch
+    INGRESS_TICK_INTERVAL: float = 0.02
+    # AIMD admission controller (ingress/controller.py): steers the
+    # dequeue budget and the effective shed watermark from queue-wait p95
+    # toward the SLO below. False freezes both knobs at config values.
+    INGRESS_CONTROLLER: bool = True
+    INGRESS_SLO_P95: float = 0.25       # queue-wait p95 target (seconds)
+    INGRESS_CONTROL_INTERVAL: float = 0.5
+
+    # --- observer read fan-out (ingress/observer_reads.py) ---
+    # an observer whose newest verified anchor is older than this serves
+    # PROOFLESS (the client escalates to a validator) instead of shipping
+    # a stale proof the client would reject anyway; defaults to the read
+    # plane's client-side freshness bound
+    OBSERVER_ANCHOR_LAG_MAX: float = 900.0
+
     # --- crypto backend seam: 'cpu' or 'jax' (the north star switch) ---
     crypto_backend: str = "cpu"
     # Pad/flush knobs of the device batch plane (plenum_tpu/crypto/batch_plane.py)

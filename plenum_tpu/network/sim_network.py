@@ -146,6 +146,10 @@ class SimNetwork:
     def _send(self, frm: str, msg: Any, dst) -> None:
         if dst is None:
             targets = [n for n in self._peers if n != frm]
+        elif isinstance(dst, str):
+            # a bare name must address ONE peer — iterating a string
+            # would silently split it into characters and drop the send
+            targets = [dst]
         else:
             targets = [d for d in dst]
         # pack-once broadcast: one wire serialization shared by every

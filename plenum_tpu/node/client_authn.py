@@ -140,6 +140,14 @@ class CoreAuthNr:
     def authenticate_batch(self, requests: Sequence[Request]) -> np.ndarray:
         return self.collect_batch(self.submit_batch(requests), wait=True)
 
+    @staticmethod
+    def token_item_count(token) -> int:
+        """Signature items staged behind one submit_batch token — the
+        measured auth batch size (in device-verify items, which exceeds
+        the request count for multi-signed requests)."""
+        spans, _hard_fail, _vtoken, _n = token
+        return spans[-1][1] if spans else 0
+
 
 class ReqAuthenticator:
     """Registry of authenticators; all registered must accept
@@ -169,6 +177,14 @@ class ReqAuthenticator:
 
     def submit_batch(self, requests: Sequence[Request]):
         return [a.submit_batch(requests) for a in self._authnrs]
+
+    def token_item_count(self, tokens) -> int:
+        """Device-verify items staged by the FIRST (core) authenticator's
+        dispatch for a submit_batch token list — the figure the ingress
+        plane publishes as its measured auth batch size."""
+        if not tokens:
+            return 0
+        return CoreAuthNr.token_item_count(tokens[0])
 
     def collect_batch(self, tokens, wait: bool = True) -> Optional[np.ndarray]:
         """None while ANY registered authenticator's device is busy."""

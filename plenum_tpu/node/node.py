@@ -1173,6 +1173,21 @@ class Node:
     def handle_client_message(self, msg: dict, frm: str) -> None:
         self._client_inbox.append((msg, frm))
 
+    def submit_preverified(self, request: Request, frm: str) -> None:
+        """Ingress-plane seam (ingress/plane.py): the request's signatures
+        were already verified through THIS node's own authenticator in the
+        plane's batched dispatch, and its static validation already ran at
+        admission — re-dispatching here would double the device work. Pays
+        the same settle pipeline as the in-node client path (ack / dedup
+        Reply / propagate, or action execution), so everything downstream
+        is indistinguishable from a request the node verified itself."""
+        if self.c.read_manager.is_query_type(request.txn_type):
+            self._answer_queries([(request, frm)])
+            return
+        if self.tracer.enabled:
+            self.tracer.emit(tracing.INGRESS, request.digest, {"frm": frm})
+        self._settle_client(request, frm, True)
+
     def _receive_propagate(self, msg: Propagate, frm: str) -> None:
         self._propagate_inbox.append((msg, frm))
 
@@ -1641,13 +1656,29 @@ class Node:
                     break                 # would wedge observers on a root
                 reqs.append(state.request.to_dict())      # mismatch forever
             if complete:
+                # newest multi-sig for this ledger rides the push so
+                # observers can anchor VERIFIED reads (they check it
+                # against the pool BLS keys before adopting; it is
+                # excluded from their f+1 push-content quorum — see
+                # BatchCommitted.multi_sig). Prefer this batch's own
+                # sig; a lagging aggregation falls back to the read
+                # plane's current anchor.
+                ms = None
+                bls_store = self.c.db.bls_store
+                if bls_store is not None and msg.state_root:
+                    ms = bls_store.get(msg.state_root)
+                if ms is None:
+                    anchor = self.read_plane.anchor_for(msg.ledger_id)
+                    ms = anchor.ms if anchor is not None else None
                 self.observable.append_input(BatchCommitted(
                     requests=tuple(reqs), ledger_id=msg.ledger_id, inst_id=0,
                     view_no=msg.view_no, pp_seq_no=msg.pp_seq_no,
                     pp_time=msg.pp_time, state_root=msg.state_root,
                     txn_root=msg.txn_root,
                     seq_no_start=txn_lib.txn_seq_no(committed[0]),
-                    seq_no_end=txn_lib.txn_seq_no(committed[-1])))
+                    seq_no_end=txn_lib.txn_seq_no(committed[-1]),
+                    multi_sig=tuple(ms.to_list()) if ms is not None
+                    else None))
             else:
                 self.spylog.append(("observer_push_skipped",
                                     (msg.view_no, msg.pp_seq_no)))

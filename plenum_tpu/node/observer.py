@@ -118,8 +118,14 @@ class NodeObserver:
             return False                            # gap: needs catchup
 
         key = (batch.ledger_id, batch.seq_no_start)
+        # quorum content excludes the advisory multi_sig attachment:
+        # honest validators legitimately aggregate DIFFERENT commit-sig
+        # subsets, and voting on it would split identical batches into
+        # separate buckets and starve the f+1 quorum. The sig is
+        # self-verifying (checked against the pool BLS keys by the
+        # observer's read gate), so it needs verification, not agreement.
         digest = hashlib.sha256(
-            signing_serialize(batch.to_dict())).hexdigest()
+            signing_serialize(batch.quorum_dict())).hexdigest()
         votes = self._votes.setdefault(key, {})
         votes[frm] = (digest, batch)
         if sum(1 for d, _ in votes.values() if d == digest) < self.f + 1:

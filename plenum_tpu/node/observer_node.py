@@ -46,6 +46,7 @@ logger = logging.getLogger(__name__)
 
 # one wire-framing implementation for the whole package: length-prefixed
 # frames with the transport's max-frame guard
+from plenum_tpu.ingress.observer_reads import FROM_CONFIG
 from plenum_tpu.network.tcp_stack import HandshakeError, _read_frame
 
 
@@ -57,7 +58,13 @@ class ObserverNode:
     def __init__(self, name: str, genesis_txns: dict,
                  addrs: dict[str, tuple[str, int]], f: int = 1,
                  data_dir: Optional[str] = None,
-                 storage_backend: str = "memory"):
+                 storage_backend: str = "memory",
+                 client_port: Optional[int] = None,
+                 client_host: str = "0.0.0.0",
+                 anchor_lag_max=FROM_CONFIG):
+        import time as _time
+
+        from plenum_tpu.ingress.observer_reads import ObserverReadGate
         from plenum_tpu.node.bootstrap import NodeBootstrap
         self.name = name
         self.addrs = dict(addrs)
@@ -65,6 +72,15 @@ class ObserverNode:
             name, genesis_txns=genesis_txns, data_dir=data_dir,
             storage_backend=storage_backend).build()
         self.observer = NodeObserver(components, f=f)
+        # read fan-out (ROADMAP item 3): serve PR 4 read_proof envelopes
+        # from the replicated state at the last VERIFIED BLS anchor;
+        # clients dial client_port exactly like a validator's client port
+        self.client_port = client_port
+        self.client_host = client_host
+        self.read_gate = ObserverReadGate(
+            components, self._genesis_bls_keys(genesis_txns),
+            n_nodes=len(self.addrs), now=_time.time,
+            anchor_lag_max=anchor_lag_max)
         self._conns: dict[str, tuple] = {}         # validator -> (reader, writer)
         self._batches: asyncio.Queue = asyncio.Queue(maxsize=1000)
         # (validator, ledger_id, seq_no) -> Future for in-flight GET_TXN
@@ -77,6 +93,24 @@ class ObserverNode:
         # chain through the gap path. (ledger, start) -> {validator: (digest, batch)}
         self._gap_votes: dict[tuple, dict[str, tuple[str, BatchCommitted]]] = {}
         self.batches_applied = 0
+
+    @staticmethod
+    def _genesis_bls_keys(genesis_txns: dict) -> dict[str, str]:
+        """alias -> BLS verkey from the pool genesis NODE txns — the keys
+        the read gate verifies pushed multi-sigs against. (Static genesis
+        keys: key-rotation-aware observers would re-derive from their
+        replicated pool state; rotation is out of scope here, matching
+        the verifying read clients' static key map.)"""
+        from plenum_tpu.common.node_messages import POOL_LEDGER_ID
+        keys: dict[str, str] = {}
+        for txn in genesis_txns.get(POOL_LEDGER_ID, ()):
+            try:
+                data = txn["txn"]["data"]["data"]
+                if data.get("blskey"):
+                    keys[data["alias"]] = data["blskey"]
+            except (KeyError, TypeError):
+                continue
+        return keys
 
     # --- connection management -------------------------------------------
 
@@ -184,8 +218,14 @@ class ObserverNode:
             if batch.seq_no_start > ledger.size + 1:
                 if self._gap_quorum(validator, batch):
                     await self._fill_gap(validator, batch)
-            elif self.observer.process_batch(batch, frm=validator):
-                self.batches_applied += 1
+            else:
+                applied = self.observer.process_batch(batch, frm=validator)
+                if applied:
+                    self.batches_applied += 1
+                # every push feeds the read gate: applied batches record
+                # roots + invalidate cached reads, and ANY push's
+                # multi-sig advances the serving anchor once it verifies
+                self.read_gate.on_push(batch, applied)
 
     def _gap_quorum(self, validator: str, batch: BatchCommitted) -> bool:
         """One vote per validator per (ledger, start); f+1 content-identical
@@ -193,8 +233,10 @@ class ObserverNode:
         import hashlib
         from plenum_tpu.common.serialization import signing_serialize
         key = (batch.ledger_id, batch.seq_no_start)
+        # multi_sig excluded, same as NodeObserver.process_batch: honest
+        # validators attach different (all-valid) aggregations
         digest = hashlib.sha256(
-            signing_serialize(batch.to_dict())).hexdigest()
+            signing_serialize(batch.quorum_dict())).hexdigest()
         # one in-flight gap vote per validator per ledger: a new start from
         # the same validator supersedes its old one, so the bucket count is
         # bounded by pool size — a Byzantine pusher minting ever-new starts
@@ -237,6 +279,36 @@ class ObserverNode:
         if self.observer.catch_up(
                 batch, lambda lid, seq: prefetched.get(seq)):
             self.batches_applied += 1
+            self.read_gate.on_push(batch, True)
+
+    # --- serving verified reads to clients --------------------------------
+
+    async def _serve_client(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        """One client connection on the observer's client port: the same
+        length-prefixed framing as a validator's client port, answering
+        READ queries from the replicated state through the read gate
+        (ObserverReadGate.serve — the one serving path the in-process
+        SimObserver shares, so the twins cannot diverge)."""
+        try:
+            while True:
+                frame = await _read_frame(reader)
+                try:
+                    msg = unpack(frame)
+                except Exception:
+                    return                     # desynced stream: drop it
+                if not isinstance(msg, dict):
+                    continue
+                payload = pack(self.read_gate.serve(msg).to_dict())
+                writer.write(len(payload).to_bytes(4, "big") + payload)
+                await writer.drain()
+        except (OSError, HandshakeError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     # --- lifecycle --------------------------------------------------------
 
@@ -244,9 +316,18 @@ class ObserverNode:
         tasks = [asyncio.create_task(self._maintain(v, stop))
                  for v in self.addrs]
         tasks.append(asyncio.create_task(self._apply_loop(stop)))
+        server = None
         try:
+            # inside the try: a bind failure (port in use) must still
+            # cancel the maintain/apply tasks on the way out
+            if self.client_port is not None:
+                server = await asyncio.start_server(
+                    self._serve_client, self.client_host, self.client_port)
             await stop.wait()
         finally:
+            if server is not None:
+                server.close()
+                await server.wait_closed()
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)

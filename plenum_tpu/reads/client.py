@@ -40,6 +40,11 @@ class ReadClientStats:
         self.timeouts = 0
         self.msgs_sent = 0
         self.replies_seen = 0
+        # observer tier (ingress/observer_reads.py): reads served by an
+        # observer rung, and proofless observer replies that escalated
+        # the ladder to a validator (anchor lag / unanchorable replica)
+        self.observer_ok = 0
+        self.observer_escalations = 0
         self.verify_s: list[float] = []
 
     def note_verify(self, dt: float) -> None:
@@ -55,6 +60,9 @@ class ReadClientStats:
                "timeouts": self.timeouts,
                "msgs_sent": self.msgs_sent,
                "replies_seen": self.replies_seen}
+        if self.observer_ok or self.observer_escalations:
+            out["observer_ok"] = self.observer_ok
+            out["observer_escalations"] = self.observer_escalations
         if self.reads:
             out["fanout"] = round(
                 (self.msgs_sent + self.replies_seen) / self.reads, 2)
@@ -106,16 +114,33 @@ def ladder_order(names: Sequence[str], request: Request) -> list[str]:
 
 
 class VerifyingReadClient(PoolClient):
-    """One proof-verified reply per read, over the node client ports."""
+    """One proof-verified reply per read, over the node client ports.
+
+    With `observer_addrs`, reads try the OBSERVER tier first (verified
+    reads scale horizontally off the pool — ingress/observer_reads.py)
+    and fail over to validators on forgery, timeout, or a proofless
+    observer reply (anchor lag escalates, it never breaks the ladder);
+    only a proofless VALIDATOR reply means the pool cannot anchor yet
+    and escalates to the legacy f+1 broadcast — which never includes
+    observers (f counts validators; the quorum stays a validator quorum).
+    """
 
     def __init__(self, node_addrs: dict, f: int,
                  bls_keys: Mapping[str, str],
                  freshness_s: float = proofs.DEFAULT_FRESHNESS_S,
-                 now: Optional[Callable[[], float]] = None):
+                 now: Optional[Callable[[], float]] = None,
+                 observer_addrs: Optional[dict] = None):
         super().__init__(node_addrs, f)
+        self.observer_addrs = dict(observer_addrs or {})
+        self._all_addrs = {**self.observer_addrs, **self.node_addrs}
         self.checker = ReadCheck(bls_keys, freshness_s=freshness_s,
                                  now=now, n_nodes=len(node_addrs))
         self.stats = self.checker.stats
+
+    def _addr_of(self, name: str) -> tuple:
+        # the read ladder also dials observers; the broadcast fallback
+        # (PoolClient.submit) still iterates node_addrs only
+        return self._all_addrs[name]
 
     async def submit_read(self, request: Request, timeout: float = 30.0,
                           per_node_timeout: float = 5.0) -> dict:
@@ -124,8 +149,9 @@ class VerifyingReadClient(PoolClient):
         self.stats.reads += 1
         data = pack(request.to_dict())
         req_key = (request.identifier, request.req_id)
-        for rung, name in enumerate(ladder_order(list(self.node_addrs),
-                                                 request)):
+        ladder = (ladder_order(list(self.observer_addrs), request)
+                  + ladder_order(list(self.node_addrs), request))
+        for rung, name in enumerate(ladder):
             if rung:
                 self.stats.failovers += 1
             await self._send_one(name, data)
@@ -141,8 +167,15 @@ class VerifyingReadClient(PoolClient):
             ok, reason = self.checker.check(request, msg.get("result", {}))
             if ok:
                 self.stats.single_reply_ok += 1
+                if name in self.observer_addrs:
+                    self.stats.observer_ok += 1
                 return msg
             if reason == proofs.NO_PROOF:
+                if name in self.observer_addrs:
+                    # anchor-lagged observer escalates to the next rung
+                    # (a validator CAN prove); never straight to broadcast
+                    self.stats.observer_escalations += 1
+                    continue
                 break                    # pool can't prove: broadcast
         # escalation: the legacy f+1 matching-reply broadcast — reached
         # when the pool cannot anchor proofs yet or every proof-bearing
@@ -170,11 +203,15 @@ class SimReadDriver:
                  node_names: Sequence[str],
                  bls_keys: Mapping[str, str],
                  freshness_s: float = proofs.DEFAULT_FRESHNESS_S,
-                 now: Optional[Callable[[], float]] = None):
+                 now: Optional[Callable[[], float]] = None,
+                 observer_names: Optional[Sequence[str]] = None):
         self._submit = submit
         self._collect = collect
         self._pump = pump
         self.node_names = list(node_names)
+        # observer tier, tried BEFORE validators (same escalation rules
+        # as VerifyingReadClient: observer proofless -> next rung)
+        self.observer_names = list(observer_names or [])
         self.checker = ReadCheck(bls_keys, freshness_s=freshness_s,
                                  now=now, n_nodes=len(node_names))
         self.stats = self.checker.stats
@@ -185,9 +222,11 @@ class SimReadDriver:
         """-> the verified result dict, or None when every rung failed
         (caller escalates to its own broadcast path)."""
         self.stats.reads += 1
-        for rung, name in enumerate(order if order is not None
-                                    else ladder_order(self.node_names,
-                                                      request)):
+        if order is None:
+            order = (ladder_order(self.observer_names, request)
+                     + ladder_order(self.node_names, request))
+        observers = set(self.observer_names)
+        for rung, name in enumerate(order):
             if rung:
                 self.stats.failovers += 1
             self._submit(name, request)
@@ -200,8 +239,13 @@ class SimReadDriver:
             ok, reason = self.checker.check(request, result)
             if ok:
                 self.stats.single_reply_ok += 1
+                if name in observers:
+                    self.stats.observer_ok += 1
                 return result
             if reason == proofs.NO_PROOF:
+                if name in observers:
+                    self.stats.observer_escalations += 1
+                    continue             # a validator can still prove
                 break
         self.stats.fallbacks += 1
         return None

@@ -499,6 +499,241 @@ def config6_read_plane(n_reads: int = 1800, write_every: int = 9,
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def config7_ingress_10k(n_clients: int = 10_000, n_ops: int = 3000,
+                        burst_clients: int = 200, burst_per_client: int = 10,
+                        timeout: float = 180.0) -> dict:
+    """10k-simulated-client, 95:5 read:write mix through the whole
+    ingress plane (docs/ingress.md):
+
+      * writes enter each node through an IngressPlane — admission
+        control, weighted-fair dequeue, and ONE batched Ed25519 dispatch
+        per tick through the ReqAuthenticator seam (the published
+        auth_batch_mean must be >> 1 for the amortization claim);
+      * reads are served by TWO observers replicating via BatchCommitted
+        pushes (multi-sig verified before anchoring) with client-side
+        proof verification (SimReadDriver, observer tier first);
+      * an overload A/B floods one front door: the ingress arm holds
+        queue depth at the watermark with explicit LoadShed replies and
+        the pool KEEPS ordering (zero wedges), while the no-ingress arm
+        swallows the whole burst into the node inbox unboundedly.
+    """
+    import plenum_tpu.tools.local_pool as lp
+    from plenum_tpu.client.sim_clients import (SimClientPopulation,
+                                               burst_writes)
+    from plenum_tpu.common.node_messages import BatchCommitted
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import NYM
+    from plenum_tpu.ingress import IngressPlane, SimObserver
+    from plenum_tpu.reads import SimReadDriver
+
+    try:
+        (names, nodes, timer, trustee,
+         replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(4, "cpu")
+        bls_keys = lp.pool_bls_keys(names)
+
+        # observers BEFORE traffic: pushes only cover live batches.
+        # build_genesis is deterministic per name set, so the observers
+        # bootstrap from byte-identical genesis txns
+        genesis, _ = lp.build_genesis(names)
+        observers = {
+            f"obs{i + 1}": SimObserver(
+                f"obs{i + 1}", genesis, names, bls_keys,
+                now=timer.get_current_time, f=1, anchor_lag_max=None)
+            for i in range(2)}
+        for obs in observers.values():
+            obs.register(lambda v, msg, o=obs: nodes[v]
+                         .handle_client_message(msg, o.client_id))
+
+        ingress = {n: IngressPlane(nodes[n], tick=False) for n in names}
+
+        def route_pushes():
+            """Move BatchCommitted pushes out of the validator client
+            outboxes into the observers."""
+            for v in names:
+                keep = []
+                for ts, msg, client in replies[v]:
+                    obs = observers.get(
+                        client[4:] if client.startswith("obs:") else "")
+                    if obs is not None and isinstance(msg, BatchCommitted):
+                        obs.deliver_push(msg, v)
+                    else:
+                        keep.append((ts, msg, client))
+                replies[v][:] = keep
+
+        def step():
+            timer.service()
+            for node in nodes.values():
+                node.prod()
+            for ing in ingress.values():
+                ing.service()
+            route_pushes()
+
+        # setup: 20 read-target DIDs ordered through the INGRESS plane
+        users = []
+        t0 = time.perf_counter()
+        for i in range(20):
+            user = Ed25519Signer(seed=(b"i7%08d" % i).ljust(32, b"\0")[:32])
+            users.append(user)
+            req = Request(trustee.identifier, i + 1,
+                          {"type": NYM, "dest": user.identifier,
+                           "verkey": user.verkey_b58})
+            req.signature = trustee.sign_b58(req.signing_bytes())
+            for n in names:
+                ingress[n].submit(req.to_dict(), "setup")
+        domain = nodes[names[0]].c.db.get_ledger(DOMAIN)
+        while domain.size < 21 and time.perf_counter() < t0 + 60.0:
+            step()
+        if domain.size < 21:
+            return {"error": f"setup ordered only {domain.size - 1}/20"}
+        base_size = domain.size
+
+        # --- the 95:5 mixed drive ------------------------------------
+        def submit(name, req):
+            if name in observers:
+                observers[name].handle_client_message(req.to_dict(), "rdr")
+            else:
+                nodes[name].handle_client_message(req.to_dict(), "rdr")
+
+        def collect(name):
+            if name in observers:
+                out = [m.result for m, c in observers[name].sent
+                       if isinstance(m, ReplyCls)]
+                observers[name].sent.clear()
+                return out
+            out = [m for _, m, c in replies[name]
+                   if isinstance(m, ReplyCls) and c == "rdr"]
+            replies[name][:] = [e for e in replies[name]
+                                if not (isinstance(e[1], ReplyCls)
+                                        and e[2] == "rdr")]
+            return [m.result for m in out]
+
+        def pump(seconds):
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                step()
+
+        driver = SimReadDriver(submit, collect, pump, names, bls_keys,
+                               freshness_s=1e9,
+                               now=timer.get_current_time,
+                               observer_names=sorted(observers))
+        pop = SimClientPopulation(n_clients, trustee,
+                                  [u.identifier for u in users], seed=7)
+        served = writes = 0
+        t0 = time.perf_counter()
+        # wave-shaped drive: each wave's writes land in the ingress
+        # queues FIRST and ride the tick's ONE auth dispatch together
+        # (real front doors see concurrent arrivals, not one write per
+        # service tick); the wave's reads then run against the observers
+        ops = list(pop.ops(n_ops))
+        wave_size = 100
+        for w0 in range(0, len(ops), wave_size):
+            if time.perf_counter() > t0 + timeout:
+                break
+            wave = ops[w0:w0 + wave_size]
+            for client_id, kind, req in wave:
+                if kind == "write":
+                    for n in names:
+                        ingress[n].submit(req.to_dict(), client_id)
+                    writes += 1
+            step()
+            for client_id, kind, req in wave:
+                if kind == "read":
+                    if driver.read(req, per_node_s=2.0,
+                                   step_s=0.001) is not None:
+                        served += 1
+        # drain the tail of in-flight writes
+        t_drain = time.perf_counter() + 20.0
+        while (domain.size - base_size) < writes and \
+                time.perf_counter() < t_drain:
+            step()
+        dt = time.perf_counter() - t0
+        # SNAPSHOT before the overload arms order their own flood writes
+        writes_ordered = domain.size - base_size
+        s = driver.stats.summary()
+        ing_sum = ingress[names[0]].summary()
+
+        # --- overload A/B --------------------------------------------
+        # arm A: flood ONE ingress front door; queue depth stays at the
+        # watermark, the surplus sheds explicitly, the pool keeps
+        # ordering. Watermarks scale with the burst (a quarter of it) so
+        # the A/B sheds decisively at any parameterization and still
+        # drains in seconds.
+        burst = burst_writes(trustee, burst_clients, burst_per_client,
+                             seed=7)
+        wm = max(32, len(burst) // 4)
+        flood_cfg = nodes[names[0]].config.replace(
+            INGRESS_HIGH_WATERMARK=wm,
+            INGRESS_LOW_WATERMARK=max(8, wm // 4),
+            INGRESS_CLIENT_QUEUE_CAP=max(2, burst_per_client // 2),
+            INGRESS_CONTROLLER=False)
+        flood_ing = IngressPlane(nodes[names[0]], config=flood_cfg,
+                                 tick=False)
+        size_before = domain.size
+        for client, req in burst:
+            flood_ing.submit(req.to_dict(), client)
+
+        def flood_step():
+            step()
+            flood_ing.service()          # tick=False: serviced here
+
+        t_flood = time.perf_counter() + 15.0
+        while time.perf_counter() < t_flood and flood_ing.queue_depth:
+            flood_step()
+        # the queue drains into dispatches before ordering completes:
+        # give the pool a bounded window to show it KEPT ordering the
+        # admitted subset (the zero-wedge claim), not just shedding
+        admitted = flood_ing.stats["admitted"]
+        t_flood = time.perf_counter() + 20.0
+        while domain.size - size_before < admitted and \
+                time.perf_counter() < t_flood:
+            flood_step()
+        fa = flood_ing.summary()
+        arm_a = {
+            "burst": len(burst),
+            "watermark": wm,
+            "queue_depth_peak": fa["queue_depth_max"],
+            "bounded": fa["queue_depth_max"] <= wm,
+            "shed": fa["shed"],
+            "admitted": admitted,
+            "auth_batch_mean": fa.get("auth_batch_mean"),
+            "ordered_after_flood": domain.size - size_before,
+            "inbox_peak": max((len(nodes[n]._client_inbox)
+                               for n in names), default=0),
+        }
+        # arm B: the same burst straight into the node inbox — nothing
+        # sheds, the inbox swallows the whole flood (unbounded growth)
+        for client, req in burst:
+            nodes[names[0]].handle_client_message(req.to_dict(), client)
+        arm_b = {"burst": len(burst),
+                 "inbox_depth_after_burst":
+                     len(nodes[names[0]]._client_inbox)}
+        t_flood = time.perf_counter() + 30.0
+        while nodes[names[0]]._client_inbox and \
+                time.perf_counter() < t_flood:
+            step()
+
+        return {
+            "clients": n_clients, "ops": n_ops,
+            "reads_served": served, "writes_submitted": writes,
+            "writes_ordered": writes_ordered,
+            "reads_per_s": round(served / dt, 1) if dt else 0.0,
+            "observer_served": s.get("observer_ok", 0),
+            "read_fanout": s.get("fanout"),
+            "verify_ms_p50": s.get("verify_ms_p50"),
+            "verify_ms_p95": s.get("verify_ms_p95"),
+            "auth_batch_mean": ing_sum.get("auth_batch_mean"),
+            "auth_batches": ing_sum.get("auth_batches"),
+            "ingress_admitted": ing_sum.get("admitted"),
+            "ingress_shed": ing_sum.get("shed"),
+            **({"ingress_controller": ing_sum["controller"]}
+               if "controller" in ing_sum else {}),
+            "overload_ab": {"ingress": arm_a, "no_ingress": arm_b},
+        }
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def config1b_distinct_signers(n_txns: int = 200,
                               timeout: float = 120.0) -> dict:
     """Diverse-client honesty datum: every write signed by a DIFFERENT
@@ -554,7 +789,8 @@ def main():
                      ("config3", config3_bls_proof_reads),
                      ("config4", config4_viewchange_under_load),
                      ("config5", config5_sim25),
-                     ("config6", config6_read_plane)):
+                     ("config6", config6_read_plane),
+                     ("config7", config7_ingress_10k)):
         print(name, json.dumps(fn()), flush=True)
 
 

@@ -243,6 +243,61 @@ def derive_summary(folds: dict[str, dict], span_s: float,
             section["proof_gen_ms_mean"] = _ms(gen["mean"])
         out["read_plane"] = {k: v for k, v in section.items()
                              if v is not None}
+    # ingress plane (docs/ingress.md): admission vs shed volume, the
+    # queue-depth and queue-wait distributions an overloaded front door
+    # shows first, the auth batch-size histogram the amortization claim
+    # rides on, per-client fairness spread, and where the admission
+    # controller's knobs ended up
+    adm = folds.get("ingress.admitted", {})
+    if adm.get("count") or folds.get("ingress.shed", {}).get("count"):
+        section = {
+            "admitted": int(s("ingress.admitted")),
+            "shed": int(s("ingress.shed")),
+            "auth_failed": int(s("ingress.auth_fail")),
+            "active_clients_last": last("ingress.clients"),
+        }
+        for metric, label, scale in (
+                ("ingress.queue_depth", "queue_depth", 1.0),
+                ("ingress.queue_wait", "queue_wait_ms", 1000.0),
+                ("ingress.auth_batch", "auth_batch", 1.0)):
+            f = folds.get(metric, {})
+            samples = f.get("samples")
+            if samples:
+                section[f"{label}_p50"] = round(
+                    percentile(samples, 0.5) * scale, 2)
+                section[f"{label}_p95"] = round(
+                    percentile(samples, 0.95) * scale, 2)
+            elif f.get("mean") is not None:
+                section[f"{label}_mean"] = round(f["mean"] * scale, 2)
+        ab = folds.get("ingress.auth_batch", {})
+        if ab.get("count"):
+            section["auth_batches"] = int(ab["count"])
+            section["auth_batch_mean"] = round(ab["mean"], 1)
+        fs = folds.get("ingress.fairness_spread", {})
+        if fs.get("mean") is not None:
+            # 1.0 = perfectly even per-batch split across active clients
+            section["fairness_spread_mean"] = round(fs["mean"], 2)
+            section["fairness_spread_max"] = round(fs.get("max") or 0, 2)
+        ctl = folds.get("ingress_ctl.admit_max", {})
+        if ctl.get("last") is not None:
+            section["controller"] = {
+                "admit_max": int(ctl["last"]),
+                "watermark": int(
+                    folds.get("ingress_ctl.watermark", {}).get("last")
+                    or 0),
+                "decisions": int(cum("ingress_ctl.decisions") or 0),
+            }
+        out["ingress"] = {k: v for k, v in section.items()
+                          if v is not None}
+    # observer read fan-out: push intake + anchor verification verdicts
+    # and the stale-suppression count (proofless escalations to the pool)
+    if folds.get("observer.pushes", {}).get("count"):
+        out["observer_reads"] = {
+            "pushes": int(s("observer.pushes")),
+            "ms_adopted": int(s("observer.ms_adopted")),
+            "ms_rejected": int(s("observer.ms_rejected")),
+            "stale_suppressed": int(s("observer.stale_suppressed")),
+        }
     return {k: v for k, v in out.items() if v is not None}
 
 

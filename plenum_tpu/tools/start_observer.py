@@ -30,6 +30,14 @@ def main(argv=None):
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--kv", default="memory",
                     choices=["memory", "file", "native"])
+    ap.add_argument("--client-port", type=int, default=None,
+                    help="serve verified reads (read_proof envelopes) to "
+                         "clients on this port")
+    ap.add_argument("--anchor-lag-max", type=float, default=None,
+                    help="serve proofless (clients escalate to a "
+                         "validator) once the newest verified anchor is "
+                         "older than this; default: "
+                         "Config.OBSERVER_ANCHOR_LAG_MAX")
     args = ap.parse_args(argv)
 
     genesis = load_genesis_files(args.base_dir)
@@ -38,8 +46,13 @@ def main(argv=None):
         data = txn["txn"]["data"]["data"]
         addrs[data["alias"]] = (data["client_ip"], data["client_port"])
 
+    from plenum_tpu.ingress.observer_reads import FROM_CONFIG
     obs = ObserverNode(args.name, genesis, addrs, f=args.f,
-                       data_dir=args.data_dir, storage_backend=args.kv)
+                       data_dir=args.data_dir, storage_backend=args.kv,
+                       client_port=args.client_port,
+                       anchor_lag_max=FROM_CONFIG
+                       if args.anchor_lag_max is None
+                       else args.anchor_lag_max)
 
     async def run():
         stop = asyncio.Event()

@@ -45,8 +45,13 @@ from typing import Optional
 from plenum_tpu.common import tracing
 from plenum_tpu.common.metrics import percentile
 
-# waterfall stage names, in pipeline order, with their span endpoints
+# waterfall stage names, in pipeline order, with their span endpoints.
+# front_door only exists for requests that entered through the ingress
+# plane (ing_admit -> the node-pipeline ingress point: client queue wait
+# + the batched auth dispatch); requests hitting the node directly have
+# no ing_admit point and the stage folds away — totals stay exact.
 _WATERFALL = (
+    ("front_door", tracing.ING_ADMIT, tracing.INGRESS),
     ("crypto", tracing.INGRESS, tracing.AUTH),
     ("propagate", tracing.AUTH, tracing.PROPAGATE_QUORUM),
     ("queue", tracing.PROPAGATE_QUORUM, "pp"),
@@ -162,6 +167,7 @@ class _NodeIndex:
             t_ord = self.first.get((tracing.ORDERED, bdigest))
             t_dur = self.durable_by_seq.get(seq)
         return {
+            tracing.ING_ADMIT: self.first.get((tracing.ING_ADMIT, digest)),
             tracing.INGRESS: self.first.get((tracing.INGRESS, digest)),
             tracing.AUTH: self.first.get((tracing.AUTH, digest)),
             tracing.PROPAGATE_QUORUM:
@@ -329,6 +335,7 @@ def _synthetic_dumps() -> list[dict]:
         "node": "P", "clock_domain": "wall",
         "mono_anchor": 0.0, "wall_anchor": 100.0, "dumped_at": 1.0,
         "anomalies": 0, "events": [
+            [0.008, tracing.ING_ADMIT, req, {"frm": "cli"}],
             [0.010, tracing.INGRESS, req, {"frm": "cli"}],
             [0.012, tracing.AUTH, req, {"ok": True}],
             [0.015, tracing.PROPAGATE_QUORUM, req, {"votes": 2}],

@@ -168,3 +168,99 @@ def test_gap_vote_buffer_bounded_per_validator():
 
     assert not obs._gap_quorum("A", mk(50))
     assert obs._gap_quorum("B", mk(50))
+
+
+def _mk_batch(start, multi_sig=None):
+    from plenum_tpu.common.node_messages import BatchCommitted
+    return BatchCommitted(requests=(), ledger_id=1, inst_id=0, view_no=0,
+                          pp_seq_no=start, pp_time=0.0,
+                          state_root="00" * 32, txn_root="00" * 32,
+                          seq_no_start=start, seq_no_end=start,
+                          multi_sig=multi_sig)
+
+
+def test_gap_quorum_ignores_multi_sig_variation():
+    """Two validators pushing the SAME gapped batch with DIFFERENT
+    multi-sig attachments (honest aggregation subsets differ) must still
+    arm the f+1 gap-fill — the advisory sig is excluded from the content
+    digest."""
+    from unittest.mock import MagicMock
+
+    from plenum_tpu.node.observer_node import ObserverNode
+
+    obs = ObserverNode.__new__(ObserverNode)
+    obs._gap_votes = {}
+    inner = MagicMock()
+    inner.f = 1
+    ledger = MagicMock()
+    ledger.size = 0
+    inner.c.db.get_ledger.return_value = ledger
+    obs.observer = inner
+
+    ms_a = ("sigA", ["Node1", "Node2", "Node3"],
+            [1, "aa" * 32, "bb" * 32, "cc" * 32, 1.0])
+    ms_b = ("sigB", ["Node2", "Node3", "Node4"],
+            [1, "aa" * 32, "bb" * 32, "cc" * 32, 1.0])
+    assert not obs._gap_quorum("A", _mk_batch(50, multi_sig=ms_a))
+    assert obs._gap_quorum("B", _mk_batch(50, multi_sig=ms_b))
+
+
+def test_push_quorum_ignores_multi_sig_variation_in_node_observer():
+    """Same property on the live-push path (NodeObserver.process_batch):
+    content-identical batches with different multi-sigs converge; a
+    batch with DIFFERENT CONTENT still does not."""
+    import dataclasses
+
+    from plenum_tpu.client.wallet import Wallet
+    from plenum_tpu.execution.txn import NYM
+    from plenum_tpu.node.bootstrap import NodeBootstrap
+    from plenum_tpu.node.observer import NodeObserver
+    from test_pool import make_genesis
+
+    genesis, _trustee = make_genesis(["Alpha", "Beta", "Gamma", "Delta"])
+    obs = NodeObserver(NodeBootstrap(
+        "obsq", genesis_txns=genesis).build(), f=1)
+
+    wallet = Wallet("w")
+    trustee_id = wallet.add_identifier(
+        seed=b"trustee-seed".ljust(32, b"\0"))
+    user = wallet.add_identifier(seed=b"obs-quorum-user".ljust(32, b"\0"))
+    req = wallet.sign_request(
+        {"type": NYM, "dest": user, "verkey": wallet.verkey_of(user)},
+        identifier=trustee_id)
+
+    # derive the true post-batch roots on a TWIN replica: apply
+    # uncommitted, read the roots, revert — the pushed batch must cite
+    # roots the observer's own recomputation reproduces
+    twin = NodeBootstrap("twin", genesis_txns=genesis).build()
+    roots = twin.write_manager.apply_batch(1, [req], 1.0, 0, 1)[2]
+    twin.write_manager.revert_last_batch(1)
+    txn_root, state_root = roots["txn_root"], roots["state_root"]
+
+    real = dataclasses.replace(
+        _mk_batch(2), requests=(req.to_dict(),), ledger_id=1,
+        pp_seq_no=1, pp_time=1.0, txn_root=txn_root,
+        state_root=state_root)
+    ms_a = ("sigA", ["Alpha", "Beta", "Gamma"],
+            [1, state_root, "bb" * 32, txn_root, 1.0])
+    ms_b = ("sigB", ["Beta", "Gamma", "Delta"],
+            [1, state_root, "bb" * 32, txn_root, 1.0])
+    assert not obs.process_batch(
+        dataclasses.replace(real, multi_sig=ms_a), frm="Alpha")
+    # different content from Beta must NOT complete Alpha's quorum
+    assert not obs.process_batch(
+        dataclasses.replace(real, pp_time=2.0, multi_sig=ms_a),
+        frm="Beta")
+    # same content, different multi-sig: quorum completes, batch applies
+    assert obs.process_batch(
+        dataclasses.replace(real, multi_sig=ms_b), frm="Gamma")
+    assert obs.c.db.get_ledger(1).size == 2
+
+
+def test_observer_node_genesis_bls_keys():
+    from plenum_tpu.node.observer_node import ObserverNode
+    from test_pool import make_genesis
+    genesis, _ = make_genesis(["Alpha", "Beta"])
+    keys = ObserverNode._genesis_bls_keys(genesis)
+    assert set(keys) == {"Alpha", "Beta"}
+    assert all(isinstance(v, str) and v for v in keys.values())

@@ -500,6 +500,171 @@ def run_lying_reader_scenario(seed: int) -> None:
     assert_safety(pool)
 
 
+# --- scenario kind `client_flood`: the FRONT DOOR is under attack -----------
+# Seed-driven bursts of hot clients (including bad-signature floods) hit
+# per-node ingress planes while honest steady clients keep writing. The
+# plane must shed the surplus EXPLICITLY (LoadShed replies, bounded
+# queues), bad-signature floods must die in the batched verifier without
+# ever reaching the pool, honest traffic must keep ordering within its
+# SLO, and the node's raw client inbox must never wedge. Composable with
+# the crypto-plane fault (device_flap's supervised verifier): a shed
+# storm during CPU fallback stays bounded.
+
+
+def _ingress_order_and_time(pool, ingress, req, expect_size: float,
+                            timeout: float = 25.0, inbox_peaks=None):
+    """Submit through EVERY node's ingress plane; -> sim seconds until
+    every node's domain ledger reaches expect_size, or None."""
+    t0 = pool.timer.get_current_time()
+    for n in pool.names:
+        ingress[n].submit(req.to_dict(), "steady")
+    elapsed = 0.0
+    while elapsed < timeout:
+        pool.run(0.5)
+        elapsed += 0.5
+        if inbox_peaks is not None:
+            inbox_peaks.append(max(len(pool.nodes[n]._client_inbox)
+                                   for n in pool.names))
+        if all(len(_domain_txns(pool.nodes[n])) >= expect_size
+               for n in pool.names):
+            return pool.timer.get_current_time() - t0
+    return None
+
+
+def run_client_flood_scenario(seed: int, faulted_plane=None) -> None:
+    from plenum_tpu.client.sim_clients import burst_writes
+    from plenum_tpu.common.node_messages import LoadShed
+    from plenum_tpu.ingress import IngressPlane
+
+    rng = SimRandom(seed * 48611 + 7)
+    cap = rng.integer(2, 6)
+    config = Config(**FAST, INGRESS_CLIENT_QUEUE_CAP=cap,
+                    INGRESS_SLO_P95=0.2, INGRESS_CONTROL_INTERVAL=0.5)
+    verifier = faulted_plane[0] if faulted_plane is not None else None
+    pool = _track(Pool(seed=seed, config=config, verifier=verifier))
+    if faulted_plane is not None:
+        sup, faulty = faulted_plane
+        sup.set_clock(pool.timer.get_current_time)
+        faulty.set_clock(pool.timer.get_current_time)
+    ingress = {n: IngressPlane(pool.nodes[n]) for n in pool.names}
+    inbox_peaks: list[int] = []
+
+    users = [Ed25519Signer(seed=(b"cf%d-%d" % (seed, i)).ljust(32, b"\0")[:32])
+             for i in range(2)]
+    honest = [signed_nym(pool.trustee, u, i + 1)
+              for i, u in enumerate(users)]
+
+    # pre-flood: honest ordering through the plane, timed (the SLO datum)
+    pre = _ingress_order_and_time(pool, ingress, honest[0], 2,
+                                  inbox_peaks=inbox_peaks)
+    assert pre is not None, f"seed {seed}: healthy plane failed to order"
+
+    if faulted_plane is not None:
+        # crypto-plane fault lands BEFORE the flood: the shed storm rides
+        # hedged CPU-fallback verdicts end to end
+        kind = ("wedge", "drop", "corrupt")[rng.integer(0, 2)]
+        getattr(faulted_plane[1], kind)()
+
+    # the flood: hot clients burst well past their per-client caps; half
+    # the seeds flood VALID-shaped bad signatures (they must die in the
+    # ingress auth batch, not in the pool)
+    n_hot = rng.integer(8, 24)
+    per_client = cap + rng.integer(3, 8)
+    bad = rng.integer(0, 2) == 0
+    burst = burst_writes(pool.trustee, n_hot, per_client, seed=seed,
+                         bad_sigs=bad)
+    for client, req in burst:
+        for n in pool.names:
+            ingress[n].submit(req.to_dict(), client)
+    # honest steady client writes DURING the flood: its queue is its own,
+    # so fairness (not luck) keeps it inside the SLO
+    during = _ingress_order_and_time(
+        pool, ingress, honest[1],
+        len(_domain_txns(pool.nodes[pool.names[0]])) + 1,
+        timeout=30.0, inbox_peaks=inbox_peaks)
+    deadline = pre + (15.0 if faulted_plane is not None else 8.0)
+    assert during is not None, \
+        f"seed {seed}: honest client starved during flood (bad={bad})"
+    assert during <= deadline, \
+        f"seed {seed}: honest order took {during:.1f}s > {deadline:.1f}s"
+
+    # explicit sheds, never silent: every over-cap burst write got a
+    # LoadShed reply on every node
+    expect_shed = n_hot * (per_client - cap)
+    for n in pool.names:
+        assert ingress[n].stats["shed"] >= expect_shed, \
+            f"seed {seed}: {n} shed {ingress[n].stats['shed']} < " \
+            f"{expect_shed}"
+        sheds = [m for m, _ in pool.client_msgs[n]
+                 if isinstance(m, LoadShed)]
+        assert len(sheds) >= expect_shed, f"seed {seed}: missing replies"
+        # bounded queues: depth never exceeded what the caps allow
+        assert ingress[n].stats["queue_depth_max"] <= \
+            (n_hot + 2) * cap + 2, f"seed {seed}: queue grew past caps"
+    if bad:
+        # the bad-signature flood died at the front door: auth rejects
+        # recorded, and NOT ONE flood write reached the ledger
+        assert any(ingress[n].stats["auth_fail"] > 0 for n in pool.names), \
+            f"seed {seed}: bad-sig flood never hit the batched verifier"
+        assert len(_domain_txns(pool.nodes[pool.names[0]])) == 3, \
+            f"seed {seed}: a bad-signature write ordered"
+    # the pool never wedged: the raw client inbox stayed near-empty the
+    # whole run (writes ride ingress, never the inbox)
+    assert max(inbox_peaks) <= 10, \
+        f"seed {seed}: client inbox grew to {max(inbox_peaks)}"
+    if faulted_plane is not None:
+        st = faulted_plane[0].supervisor_stats()
+        assert st["fallback_batches"] >= 1, \
+            f"seed {seed}: flood under fault never took the CPU fallback"
+        assert st["max_stall_s"] <= st["max_budget_s"] + 0.3, \
+            f"seed {seed}: shed storm stalled past the deadline budget"
+    assert_safety(pool)
+
+
+def run_client_flood_with_device_flap(seed: int) -> None:
+    """client_flood composed with device_flap: the shared crypto plane is
+    faulted before the flood, so every shed decision and every batched
+    verdict rides the supervisor's hedged CPU fallback."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.faults import FaultyVerifier
+    from plenum_tpu.parallel.supervisor import (CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    rng = SimRandom(seed * 75403 + 11)
+    faulty = FaultyVerifier(CpuEd25519Verifier())
+    sup = SupervisedVerifier(
+        faulty, fallback=CpuEd25519Verifier(),
+        breaker=CircuitBreaker(fail_threshold=2,
+                               cooldown=rng.float(0.5, 1.5)),
+        budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
+                              warm_max=1.0, cold_max=1.0))
+    run_client_flood_scenario(seed, faulted_plane=(sup, faulty))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_client_flood_fuzz(bucket):
+    for seed in range(bucket * 5, (bucket + 1) * 5):
+        _run_with_artifacts(run_client_flood_scenario, seed)
+
+
+def test_sim_client_flood_smoke():
+    """One client_flood scenario always runs in the default suite."""
+    _run_with_artifacts(run_client_flood_scenario, 2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(2))
+def test_sim_client_flood_device_flap_fuzz(bucket):
+    for seed in range(bucket * 3, (bucket + 1) * 3):
+        _run_with_artifacts(run_client_flood_with_device_flap, seed)
+
+
+def test_sim_client_flood_device_flap_smoke():
+    """One composed flood+crypto-fault scenario in the default suite."""
+    _run_with_artifacts(run_client_flood_with_device_flap, 1)
+
+
 LYING_READER_SEEDS = 20
 
 
