@@ -33,21 +33,38 @@ JAX_POOL_TIMEOUT_S = int(os.environ.get("BENCH_JAX_TIMEOUT", "1500"))
 # compile (~minutes on a tunneled TPU) + run; env override for testing
 
 
+def _probe_relay_with_retry(attempts: int = 3, backoff_s: float = 5.0):
+    """Bounded retry of the relay probe: a relay mid-restart (the BENCH_r05
+    failure was a momentarily-down tunnel costing the WHOLE round's device
+    figures) gets `attempts` chances a few seconds apart before the jax
+    pool is skipped. Total added cost when the relay is genuinely down:
+    (attempts-1) * backoff_s + probe timeouts — seconds, never minutes."""
+    import time as _time
+    from plenum_tpu.tools.tpu_probe import probe_relay
+    probe = probe_relay()
+    for _ in range(attempts - 1):
+        if probe["up"]:
+            return probe
+        _time.sleep(backoff_s)
+        probe = probe_relay()
+    return probe
+
+
 def _run_jax_pool_subprocess():
     """-> stats dict or {'error': ...}.
 
-    Probes the device relay first (3 s TCP connect): when nothing listens
-    at 127.0.0.1:8082/8083 the jax backend hangs during init rather than
-    failing, and the watchdog below would burn its full JAX_POOL_TIMEOUT_S
-    discovering that.  A dead relay now costs seconds, not 25 minutes
-    (VERDICT r3 weak #4).
+    Probes the device relay first (3 s TCP connect, with bounded retry):
+    when nothing listens at 127.0.0.1:8082/8083 the jax backend hangs
+    during init rather than failing, and the watchdog below would burn its
+    full JAX_POOL_TIMEOUT_S discovering that.  A dead relay now costs
+    seconds, not 25 minutes (VERDICT r3 weak #4).
     """
-    from plenum_tpu.tools.tpu_probe import probe_relay
-    probe = probe_relay()
+    probe = _probe_relay_with_retry()
     if not probe["up"]:
         detail = " ".join(f"{p}={i['state']}" for p, i in probe["ports"].items())
         return {"error": f"device relay down at {probe['ts']} ({detail}); "
-                         "skipped jax pool without touching the tunnel"}
+                         "skipped jax pool without touching the tunnel "
+                         "(after bounded retry)"}
     code = (
         "import json\n"
         "from plenum_tpu.tools.local_pool import run_load\n"
@@ -347,6 +364,38 @@ def main():
                 result[f"config7_{k}"] = c7[k]
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
+    # fused-pipeline A/B on JAX-ON-CPU — published UNCONDITIONALLY: its
+    # own try block (an earlier config raising must not blank it) AND
+    # independent of relay state — same code path the TPU runs,
+    # provenance tagged via jax_source
+    try:
+        from plenum_tpu.tools import bench_configs as bc
+        c8 = bc.config8_pipeline_ab(n_txns=150)
+        if "error" in c8:
+            result["config8_pipeline_ab"] = c8["error"]
+        else:
+            result["config8_pipeline_ab"] = {
+                k: c8[k] for k in
+                ("jax_source", "pipeline_tps", "percall_tps",
+                 "pipeline_items_per_dispatch",
+                 "percall_items_per_dispatch", "coalescing_ratio",
+                 "pipeline_dedup_ratio", "pipeline_dispatches",
+                 "percall_dispatches", "pipeline_compiled_shapes",
+                 "pipeline_unpinned_shapes", "pipeline_p50_ms",
+                 "percall_p50_ms") if c8.get(k) is not None}
+            # the device columns must never go blank or mislead again:
+            # when the live relay gave nothing, the JAX-on-CPU pipeline
+            # figure stands in WITH its provenance named — it also
+            # REPLACES the plain-cpu fallback values the degraded-mode
+            # block above emits, which run none of the jax code path
+            if c8.get("pipeline_tps") and (
+                    "jax_tps" not in result
+                    or result.get("jax_source") == "cpu-fallback"):
+                result["jax_tps"] = c8["pipeline_tps"]
+                result["jax_p50_ms"] = c8.get("pipeline_p50_ms")
+                result["jax_source"] = "jax-on-cpu-pipeline"
+    except Exception as e:
+        result["config8_pipeline_ab"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -135,6 +135,20 @@ class MetricsName:
     # shared crypto plane
     SIG_BATCH_FILL_TIME = "crypto.sig_batch_fill_time"
     SIG_DISPATCH_TIME = "crypto.sig_dispatch_time"
+    # fused crypto pipeline (parallel/pipeline.py): one event per device
+    # wave (coalesced caller items riding it, occupancy at dispatch, pad
+    # waste), cumulative dedup/dispatch gauges sampled at flush, and the
+    # controller's knob gauges (read back via `last`)
+    PIPELINE_DISPATCHES = "pipeline.dispatches"
+    PIPELINE_ITEMS_PER_DISPATCH = "pipeline.items_per_dispatch"
+    PIPELINE_OCCUPANCY = "pipeline.occupancy"
+    PIPELINE_PAD_WASTE = "pipeline.pad_waste"
+    PIPELINE_DEDUP_RATIO = "pipeline.dedup_ratio"
+    PIPELINE_BUCKET_HIT_RATE = "pipeline.bucket_hit_rate"
+    PIPELINE_COMPILED_SHAPES = "pipeline.compiled_shapes"
+    PIPELINE_CTL_FLUSH_WAIT = "pipeline_ctl.flush_wait"
+    PIPELINE_CTL_BUCKET_FLOOR = "pipeline_ctl.bucket_floor"
+    PIPELINE_CTL_DECISIONS = "pipeline_ctl.decisions"
     # transport
     NODE_MSGS_IN = "transport.node_msgs_in"
     NODE_FRAMES_OUT = "transport.node_frames_out"

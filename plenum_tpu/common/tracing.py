@@ -74,6 +74,12 @@ DURABLE = "durable"                  # group-commit flush closed (data: seqs)
 CONTROLLER = "controller"            # batch-controller decision (data: knobs)
 CRYPTO_DISPATCH = "crypto_dispatch"  # signature batch dispatched (data: kind)
 READ_BATCH = "read_batch"            # read plane served a tick's queries
+# fused crypto pipeline (parallel/pipeline.py): one event per resolved
+# device wave — submit->pack->dispatch->collect spans (all stamped on the
+# pipeline's injectable clock), plus bucket id / item count / pad waste;
+# trace_report renders these as the `device` waterfall stage
+DEVICE = "device"
+DEVICE_CONTROLLER = "device_controller"  # pipeline-controller decision
 
 ANOMALY_PREFIX = "anomaly."
 

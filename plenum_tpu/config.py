@@ -216,6 +216,35 @@ class Config:
     CRYPTO_BATCH_MAX: int = 4096
     CRYPTO_BATCH_PAD_POW2: bool = True
 
+    # --- fused crypto pipeline (parallel/pipeline.py) ---
+    # One submission ring coalescing Ed25519 client-auth, BLS batch
+    # checks, and Merkle hashing across consensus stages AND co-hosted
+    # nodes, with double-buffered device dispatch. False keeps every call
+    # site on its per-call dispatch path (the construction seam returns
+    # None; the disabled cost is one `is None` check at wiring time).
+    # Device backends (jax / jax-sharded) construct it by default; the
+    # plain cpu backend never does — the ring's coalescing pays for a
+    # device round trip, not for a host loop.
+    CRYPTO_PIPELINE: bool = True
+    # pinned pad-bucket ladder (pow2 steps): every ed25519 wave pads to a
+    # bucket in [MIN, MAX] so steady state never meets a novel XLA shape
+    PIPELINE_MIN_BUCKET: int = 64
+    PIPELINE_MAX_BUCKET: int = 4096
+    # how long a partial wave is held for more submitters before it
+    # auto-dispatches; the pipeline controller roams within [MIN, MAX]
+    PIPELINE_FLUSH_WAIT: float = 0.005
+    PIPELINE_FLUSH_WAIT_MIN: float = 0.001
+    PIPELINE_FLUSH_WAIT_MAX: float = 0.05
+    # closed-loop steering (PipelineController): decisions on sample
+    # arrivals past this interval; False freezes both knobs at config
+    PIPELINE_CONTROLLER: bool = True
+    PIPELINE_CONTROL_INTERVAL: float = 0.5
+    # submit->dispatch queue-wait p95 target the flush hold steers toward
+    PIPELINE_SLO_P95: float = 0.05
+    # unique SHA messages below this per flush stay on hashlib (one
+    # tunneled-TPU dispatch costs more than ~1k host hashes)
+    PIPELINE_SHA_MIN_BATCH: int = 1024
+
     # --- storage ---
     kv_backend: str = "memory"          # 'memory' | 'file'
 

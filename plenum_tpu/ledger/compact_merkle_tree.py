@@ -66,6 +66,16 @@ class CompactMerkleTree:
         get = self._level_hash
         new_at_level: dict[int, bytes] = {i: h for i, h in
                                           zip(range(base, level_count), leaf_hashes)}
+        # Fused wave (MTU-style): a hasher advertising hash_wave_levels
+        # computes ALL wide interior levels in ONE device program — the
+        # per-level host hops below then only run for the narrow top-of-
+        # tree spine (<=1 new node per level) the fused program leaves.
+        fused = getattr(self.hasher, "hash_wave_levels", None)
+        if fused is not None and len(leaf_hashes) >= 2:
+            state = self._extend_fused(fused, level, level_start,
+                                       level_count, new_at_level)
+            if state is not None:
+                level, level_start, level_count, new_at_level = state
         while level_count >= 2:
             parent_first = level_start // 2
             parent_count = level_count // 2
@@ -90,7 +100,61 @@ class CompactMerkleTree:
         self.tree_size += len(leaf_hashes)
         self._peaks = self._compute_peaks(self.tree_size)
 
-    # --- node access ------------------------------------------------------
+    def _extend_fused(self, fused, level, level_start, level_count,
+                      new_at_level):
+        """Run the wide levels of one append wave through the hasher's
+        fused device program; -> the per-level loop's continuation state,
+        or None when the fused path declines (small wave / missing
+        boundary / already-stored parent) and the loop runs from scratch.
+
+        The metadata mirrors the loop exactly: a wave's new nodes are a
+        contiguous suffix [level_start, level_count) per level, so at most
+        one OLD node (the left boundary at level_start-1, present iff
+        level_start is odd) joins each level's pairing, and the count of
+        parents formed is (level_count//2) - (level_start//2)."""
+        store = self.hash_store
+        new_hashes = [new_at_level[i]
+                      for i in range(level_start, level_count)]
+        bounds, offs, counts = [], [], []
+        starts = []                # level_start per fused level
+        ls, cnt, m = level_start, level_count, len(new_hashes)
+        while m >= 2 and cnt >= 2:
+            parent_first = ls // 2
+            parent_count = cnt // 2
+            p = parent_count - parent_first
+            if p <= 0:
+                break
+            if store.try_get_node(level + len(counts) + 1,
+                                  parent_first) is not None:
+                return None        # overlap with stored nodes: slow path
+            off = ls & 1
+            bound = None
+            if off:
+                try:
+                    bound = self._level_hash(level + len(counts), ls - 1)
+                except KeyError:
+                    return None    # boundary missing: slow path
+            starts.append(parent_first)
+            bounds.append(bound)
+            offs.append(off)
+            counts.append(p)
+            ls, cnt, m = parent_first, parent_count, p
+        if not counts:
+            return None
+        got = fused(new_hashes, bounds, offs, counts)
+        if got is None:
+            return None            # hasher declined (below its threshold)
+        out_level = level
+        new_parent: dict[int, bytes] = new_at_level
+        ls2, cnt2 = level_start, level_count
+        for l, parents in enumerate(got):
+            new_parent = {}
+            for j, h in enumerate(parents):
+                store.put_node(out_level + 1, starts[l] + j, h)
+                new_parent[starts[l] + j] = h
+            out_level += 1
+            ls2, cnt2 = starts[l], cnt2 // 2
+        return out_level, ls2, cnt2, new_parent
 
     def _level_hash(self, level: int, idx: int) -> bytes:
         if level == 0:

@@ -35,6 +35,58 @@ class TreeHasher:
         return [self.hash_children(l, r) for l, r in pairs]
 
 
+def fused_wave_levels(new_hashes, bounds, offs, counts, note_shape=None):
+    """One fused device program for an append wave's interior levels
+    (ops/sha256.merkle_wave) — the shared implementation behind every
+    hasher's `hash_wave_levels`.
+
+    new_hashes: the wave's new level-0 digests (32-byte each).
+    bounds[l]:  the old left-boundary digest level l pairs with, or None.
+    offs[l]:    1 when level l uses its boundary.
+    counts[l]:  how many parents level l really forms (the valid prefix).
+
+    Returns per-level lists of parent digests for the first
+    min(len(counts), log2(bucket)) levels; the CALLER finishes any deeper
+    (single-node spine) levels on host. note_shape, when given, is called
+    with the compiled-shape key so the pipeline's recompile guard can
+    count it.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from plenum_tpu.ops.sha256 import (bytes_to_digests, digests_to_bytes,
+                                       merkle_wave)
+    n = len(new_hashes)
+    bucket = _pow2_at_least(max(2, n))
+    depth = bucket.bit_length() - 1          # log2(bucket) program levels
+    if note_shape is not None:
+        note_shape(("merkle", bucket))
+    new0 = np.zeros((bucket, 8), dtype=np.uint32)
+    new0[:n] = bytes_to_digests(list(new_hashes))
+    bnd = np.zeros((depth, 8), dtype=np.uint32)
+    off = np.zeros(depth, dtype=np.int32)
+    levels = min(depth, len(counts))
+    for l in range(levels):
+        if offs[l] and bounds[l] is not None:
+            bnd[l] = bytes_to_digests([bounds[l]])[0]
+            off[l] = 1
+    outs = merkle_wave(jnp.asarray(new0), jnp.asarray(bnd),
+                       jnp.asarray(off))
+    result = []
+    for l in range(levels):
+        want = counts[l]
+        result.append(digests_to_bytes(np.asarray(outs[l])[:want])
+                      if want else [])
+    return result
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 class JaxTreeHasher(TreeHasher):
     """Device backend: batched SHA-256 (plenum_tpu/ops/sha256.py).
 
@@ -43,12 +95,22 @@ class JaxTreeHasher(TreeHasher):
     verifier.
     """
 
-    def __init__(self, min_batch: int = 1024):
+    def __init__(self, min_batch: int = 1024, fuse_min: int = None):
         # Below min_batch the dispatch overhead beats the VPU win — hashlib
         # does 1024 sha256 in under a millisecond while one tunneled-TPU
         # dispatch costs tens of milliseconds, so only catchup-scale batch
         # verification and bulk appends go to the device.
         self._min_batch = min_batch
+        # fused append waves pay ONE dispatch for all interior levels, so
+        # they amortize earlier than the flat batch threshold
+        self._fuse_min = min_batch if fuse_min is None else fuse_min
+
+    def hash_wave_levels(self, new_hashes, bounds, offs, counts):
+        """Fused interior levels for one append wave, or None to decline
+        (small waves stay on the hashlib per-level path)."""
+        if len(new_hashes) < self._fuse_min:
+            return None
+        return fused_wave_levels(new_hashes, bounds, offs, counts)
 
     def hash_leaves(self, leaves: Sequence[bytes]) -> list[bytes]:
         if len(leaves) < self._min_batch:

@@ -61,6 +61,9 @@ class NodeComponents(NamedTuple):
     bls_store: BlsStore
     plugins: list = []          # effective plugin objects (init'd by Node)
     action_manager: object = None
+    # fused crypto pipeline (parallel/pipeline.py) the node's crypto
+    # seams ride when constructed with one; co-hosted nodes share it
+    pipeline: object = None
 
 
 class NodeBootstrap:
@@ -74,7 +77,8 @@ class NodeBootstrap:
                  verifier_min_batch: int = 128,
                  storage_backend: str = "native",
                  plugins=None,
-                 verifier=None):
+                 verifier=None,
+                 pipeline=None):
         self.name = name
         self.genesis = genesis_txns or {}
         self.data_dir = data_dir
@@ -93,6 +97,11 @@ class NodeBootstrap:
         # CoalescingVerifier so their dispatches ride a single device
         # program per cycle (crypto/ed25519.py CoalescingVerifier)
         self.verifier = verifier
+        # fused crypto pipeline (parallel/pipeline.py): when given, the
+        # authenticator, every ledger's tree hasher, and the BLS batch
+        # checks all stage into its shared ring (co-hosted nodes pass ONE
+        # instance — that sharing IS the cross-node coalescing/dedup)
+        self.pipeline = pipeline
 
     # --- storage factories -------------------------------------------------
 
@@ -133,9 +142,12 @@ class NodeBootstrap:
     def _ledger(self, ledger_id: int, label: str) -> Ledger:
         # crypto_backend routes to EVERY ledger's tree hasher — with "jax"
         # the batch appends/proof paths run on device (the north-star seam;
-        # ref tree_hasher.py:4 + SURVEY.md §7 stage 2/3)
+        # ref tree_hasher.py:4 + SURVEY.md §7 stage 2/3); with a pipeline,
+        # hashing coalesces/dedups through its shared SHA lane instead
+        hasher = (self.pipeline.tree_hasher() if self.pipeline is not None
+                  else make_tree_hasher(self.crypto_backend))
         tree = CompactMerkleTree(
-            make_tree_hasher(self.crypto_backend),
+            hasher,
             hash_store=HashStore(self._kv(f"{label}_hashes")))
         return Ledger(tree, self._kv(f"{label}_log"),
                       genesis_txns=self.genesis.get(ledger_id, ()))
@@ -195,12 +207,19 @@ class NodeBootstrap:
 
         self._replay_genesis_state(db, nym, node_handler, write_manager)
 
-        # client authN over the Ed25519 provider seam (cpu | jax)
+        # client authN over the Ed25519 provider seam (cpu | jax); with a
+        # pipeline the batches stage into the shared ring instead of
+        # dispatching alone
+        if self.verifier is not None:
+            authn_verifier = self.verifier
+        elif self.pipeline is not None:
+            authn_verifier = self.pipeline.verifier()
+        else:
+            authn_verifier = make_verifier(
+                self.crypto_backend, min_batch=self.verifier_min_batch)
         authnr = ReqAuthenticator()
         authnr.register_authenticator(CoreAuthNr(
-            self.verifier or make_verifier(self.crypto_backend,
-                                           min_batch=self.verifier_min_batch),
-            get_verkey=nym.get_verkey))
+            authn_verifier, get_verkey=nym.get_verkey))
 
         # BLS: signer from seed; registry fed from pool state
         bls_signer = BlsCryptoSigner(seed=self.bls_seed)
@@ -212,7 +231,8 @@ class NodeBootstrap:
         return NodeComponents(db, write_manager, read_manager, executor,
                               authnr, pool_manager, nym, node_handler,
                               bls_signer, bls_register, bls_store,
-                              self.effective_plugins, action_manager)
+                              self.effective_plugins, action_manager,
+                              self.pipeline)
 
     def _replay_genesis_state(self, db, nym, node_handler, wm) -> None:
         """Replay committed ledger txns through handlers into state (restart

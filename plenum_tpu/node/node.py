@@ -179,6 +179,15 @@ class Node:
                 sup.breaker.on_transition = (
                     lambda old, new: self.tracer.anomaly(
                         "breaker", {"from": old, "to": new}))
+        # fused crypto pipeline: the last-attached node's tracer records
+        # the shared ring's `device` wave spans (same convention as the
+        # shared plane's metrics hook above), and the ring's flush window
+        # + controller run on this node's injectable clock so sims and
+        # replays steer identically
+        if components.pipeline is not None:
+            components.pipeline.set_clock(timer.get_current_time)
+            if self.tracer.enabled:
+                components.pipeline.tracer = self.tracer
 
         self.pool_manager = components.pool_manager
         self.pool_manager._on_changed = self._on_pool_changed
@@ -540,6 +549,10 @@ class Node:
                                rp["proofless"])
         self.metrics.add_event(MetricsName.READ_ANCHOR_UPDATES,
                                rp["anchor_updates"])
+        # fused crypto pipeline: dispatch/dedup/bucket gauges (the ring is
+        # shared, so like PAIRING_STATS these are host-wide figures)
+        if self.c.pipeline is not None:
+            self.c.pipeline.sample_metrics(self.metrics)
 
     def _flush_metrics(self) -> None:
         """Sample process RSS/GC gauges + one last queue sample, then flush
@@ -704,7 +717,15 @@ class Node:
             # check); otherwise verify locally — the factory encodes both
             from plenum_tpu.parallel.crypto_service import \
                 make_bls_verifier
-            bls_verifier = make_bls_verifier(self.config.crypto_backend)
+            if (self.c.pipeline is not None
+                    and self.config.crypto_backend != "service"):
+                # commit-path batch checks ride the pipeline ring: one
+                # deduped combined pairing check per flush window instead
+                # of one per co-hosted node (the service plane keeps its
+                # own host-wide dedup path)
+                bls_verifier = self.c.pipeline.bls_verifier()
+            else:
+                bls_verifier = make_bls_verifier(self.config.crypto_backend)
             bls = BlsBftReplica(
                 node_name=self.name, bls_signer=self.c.bls_signer,
                 bls_verifier=bls_verifier,
@@ -1209,6 +1230,10 @@ class Node:
     def prod(self) -> int:
         """One event-loop cycle (ref node.py:1037). Returns work count."""
         count = 0
+        if self.c.pipeline is not None:
+            # pump the shared ring: resolve a finished device wave,
+            # promote the double-buffered packed one, pack the next
+            self.c.pipeline.service()
         n = self._service_client_msgs()
         if n:
             self.metrics.add_event(MetricsName.CLIENT_MSGS, n)

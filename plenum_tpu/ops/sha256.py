@@ -138,6 +138,48 @@ def merkle_reduce_pow2(leaf_digests: jax.Array) -> jax.Array:
     return h[0]
 
 
+@jax.jit
+def merkle_wave(new0: jax.Array, bounds: jax.Array,
+                offs: jax.Array) -> tuple:
+    """ALL interior levels of one append wave in ONE device program —
+    the MTU-style fused tree path (PAPERS.md "MTU: The Multifunction Tree
+    Unit"): no host hop between levels, the level-l parents feed level
+    l+1 inside the same XLA program.
+
+    new0:   uint32[N, 8]  — the wave's new level-0 digests, N a power of
+            two (host pads; lanes past the real count compute garbage the
+            host discards — valid lanes never read padded ones, because
+            the pairing is element-wise on a contiguous valid prefix).
+    bounds: uint32[L, 8]  — per level, the OLD left-boundary node the
+            wave's first new node pairs with when the level's first new
+            index is odd (an append wave is a contiguous suffix, so at
+            most ONE old node joins the pairing per level). L = log2(N).
+    offs:   int32[L]      — 1 when that level uses its boundary, else 0.
+            Traced VALUES, not shapes: one compiled program per N serves
+            every base alignment (a per-parity shape would recompile on
+            every append offset).
+
+    Returns a tuple of uint32[N/2, 8], uint32[N/4, 8], ... uint32[1, 8]:
+    each level's parent digests; the host slices each level's valid
+    prefix (it knows the real counts) and stores them.
+    """
+    outs = []
+    cur = new0
+    level = 0
+    while cur.shape[0] >= 2:
+        cap = cur.shape[0]
+        inp = jnp.concatenate([bounds[level][None, :], cur], axis=0)
+        # off=1: pairing starts AT the boundary (slot 0); off=0: skip it.
+        start = (1 - offs[level]).astype(jnp.int32)
+        shifted = jax.lax.dynamic_slice(inp, (start, jnp.int32(0)),
+                                        (cap, 8))
+        parents = hash_interior(shifted[0::2], shifted[1::2])
+        outs.append(parents)
+        cur = parents
+        level += 1
+    return tuple(outs)
+
+
 # --- host-side packing helpers -------------------------------------------
 
 def pad_to_words(data: bytes) -> np.ndarray:

@@ -734,6 +734,103 @@ def config7_ingress_10k(n_clients: int = 10_000, n_ops: int = 3000,
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _pipeline_ab_inproc(n_txns: int = 150, repeat: int = 3) -> dict:
+    """The fused-pipeline A/B, run INSIDE a JAX_PLATFORMS=cpu subprocess
+    (config8_pipeline_ab spawns it): the SAME 4-node write load through
+    (a) the pipeline ring (cross-stage + cross-node coalescing/dedup) and
+    (b) the per-call baseline — every node its own supervised device
+    verifier, every call site's batch dispatched alone. WARMED and
+    INTERLEAVED per the PR 6 methodology (the first pool per process pays
+    the XLA compiles and runs cold; a fixed-order A/B lies), medians of
+    `repeat`. The coalescing figure is mean caller-items-per-device-
+    dispatch: the pipeline arm counts every caller item a wave settles
+    (dedup riders included), the per-call arm counts the supervised
+    verifier's real submitted items — both BEFORE padding."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from plenum_tpu.tools.local_pool import run_load
+
+    arms = {"pipeline": "jax", "percall": "jax-percall"}
+    # config7-style load shape for BOTH arms: a 32-deep trickle through
+    # SMALL per-call-site batches (quota 16 — the shape ingress ticks
+    # produce: many small per-tick auth batches per node) rather than the
+    # headline's 256-deep flood. Per-call dispatches stay tick-sized
+    # while the ring coalesces the same work across stages and co-hosted
+    # nodes into RTT-sized waves — exactly the amortization the pipeline
+    # exists to buy.
+    overrides = {"LISTENER_MESSAGE_QUOTA": 16, "REMOTES_MESSAGE_QUOTA": 16}
+    for b in arms.values():              # cold pass: compiles + warmup
+        run_load(n_nodes=4, n_txns=40, backend=b, timeout=120.0,
+                 config_overrides=overrides)
+    runs: dict[str, list] = {k: [] for k in arms}
+    for _ in range(repeat):
+        for k, b in arms.items():        # interleaved
+            runs[k].append(run_load(n_nodes=4, n_txns=n_txns, backend=b,
+                                    timeout=120.0, window=32,
+                                    config_overrides=overrides))
+
+    def med(rs):
+        good = sorted((r for r in rs if r.get("txns_ordered")),
+                      key=lambda r: r["tps"])
+        return good[len(good) // 2] if good else None
+
+    pipe, percall = med(runs["pipeline"]), med(runs["percall"])
+    out: dict = {"n_txns": n_txns, "repeat": repeat}
+    if pipe is not None:
+        out["pipeline_tps"] = pipe["tps"]
+        out["pipeline_p50_ms"] = pipe.get("p50_latency_ms")
+        ps = pipe.get("pipeline") or {}
+        out["pipeline_items_per_dispatch"] = ps.get("items_per_dispatch")
+        out["pipeline_dedup_ratio"] = ps.get("pipeline_dedup_ratio")
+        out["pipeline_dispatches"] = ps.get("dispatches")
+        out["pipeline_compiled_shapes"] = ps.get("compiled_shapes")
+        out["pipeline_unpinned_shapes"] = ps.get("unpinned_shapes")
+    if percall is not None:
+        out["percall_tps"] = percall["tps"]
+        out["percall_p50_ms"] = percall.get("p50_latency_ms")
+        pc = percall.get("percall") or {}
+        out["percall_items_per_dispatch"] = pc.get("items_per_dispatch")
+        out["percall_dispatches"] = pc.get("device_batches")
+    a = out.get("pipeline_items_per_dispatch")
+    b = out.get("percall_items_per_dispatch")
+    if a and b:
+        out["coalescing_ratio"] = round(a / b, 2)
+    return out
+
+
+def config8_pipeline_ab(n_txns: int = 150,
+                        timeout: float = 900.0) -> dict:
+    """Pipelined-vs-per-call device A/B on JAX-ON-CPU, in a subprocess so
+    the bench process never imports jax against a possibly-wedged tunnel.
+    This figure is published UNCONDITIONALLY (relay up or down) — the
+    round-5 failure mode was a blank device column; JAX-on-CPU runs the
+    exact code path the TPU runs, so the A/B is never blank and its
+    provenance is named (`jax_source`)."""
+    import os
+    import subprocess
+    import sys
+
+    code = ("import json\n"
+            "from plenum_tpu.tools.bench_configs import _pipeline_ab_inproc\n"
+            f"print(json.dumps(_pipeline_ab_inproc(n_txns={n_txns})))\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"error": "pipeline A/B timed out"}
+    for line in reversed(out.stdout.strip().splitlines() or [""]):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            parsed["jax_source"] = "jax-on-cpu"
+            return parsed
+    return {"error": (out.stderr or "no output").strip()[-300:]}
+
+
 def config1b_distinct_signers(n_txns: int = 200,
                               timeout: float = 120.0) -> dict:
     """Diverse-client honesty datum: every write signed by a DIFFERENT
@@ -790,7 +887,8 @@ def main():
                      ("config4", config4_viewchange_under_load),
                      ("config5", config5_sim25),
                      ("config6", config6_read_plane),
-                     ("config7", config7_ingress_10k)):
+                     ("config7", config7_ingress_10k),
+                     ("config8", config8_pipeline_ab)):
         print(name, json.dumps(fn()), flush=True)
 
 

@@ -82,11 +82,24 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1,
                     **(config_overrides or {}))
     replies: dict[str, list] = {n: [] for n in names}
     nodes = {}
-    # co-hosted nodes share ONE coalescing crypto plane: the verify kernel
-    # is serial-depth bound, so n_nodes small dispatches per cycle cost
-    # ~n_nodes times one combined dispatch (crypto/ed25519.py)
+    # co-hosted nodes share ONE crypto plane: the verify kernel is
+    # serial-depth bound, so n_nodes small dispatches per cycle cost
+    # ~n_nodes times one combined dispatch. With CRYPTO_PIPELINE (the
+    # default) that plane is the fused pipeline ring — client-auth
+    # Ed25519, BLS batch checks, AND Merkle hashing all coalesce/dedup
+    # across the co-hosted nodes; otherwise the legacy Ed25519-only
+    # CoalescingVerifier.
     plane = None
-    if backend == "jax":
+    pipeline = None
+    if backend == "jax-percall":
+        # A/B baseline arm (bench_configs.config8_pipeline_ab): every node
+        # runs its own supervised device verifier and every call site's
+        # batch dispatches ALONE — the pre-pipeline per-call behavior the
+        # coalescing win is measured against
+        config = config.replace(crypto_backend="jax",
+                                CRYPTO_PIPELINE=False)
+        backend = "jax"
+    elif backend == "jax":
         from plenum_tpu.crypto.ed25519 import (CoalescingVerifier,
                                                JaxEd25519Verifier)
         # one shape covering the coalesced steady state: every node can
@@ -102,13 +115,26 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1,
         # CPU-speed verdicts (breaker + hedged fallback) instead of
         # blanking the run — the bench line then reports backend_state
         from plenum_tpu.parallel.supervisor import supervise
-        plane = CoalescingVerifier(supervise(
-            JaxEd25519Verifier(min_batch=bucket)))
+        if config.CRYPTO_PIPELINE:
+            from plenum_tpu.parallel.pipeline import CryptoPipeline
+            # the pipeline owns the shape policy: its pinned bucket
+            # ladder covers the coalesced steady state
+            pipeline = CryptoPipeline(
+                ed_inner=supervise(JaxEd25519Verifier(min_batch=1)),
+                config=config.replace(PIPELINE_MAX_BUCKET=max(
+                    bucket, config.PIPELINE_MAX_BUCKET)),
+                sha_device=True,
+                sha_min_device=config.PIPELINE_SHA_MIN_BATCH)
+            plane = pipeline.verifier()
+        else:
+            plane = CoalescingVerifier(supervise(
+                JaxEd25519Verifier(min_batch=bucket)))
     for name in names:
         bus = net.create_peer(name)
-        components = NodeBootstrap(name, genesis_txns=genesis,
-                                   crypto_backend=backend,
-                                   verifier=plane).build()
+        components = NodeBootstrap(
+            name, genesis_txns=genesis, crypto_backend=backend,
+            verifier=None if pipeline is not None else plane,
+            pipeline=pipeline).build()
         # traced runs carry real Tracers (shared in-process clock, so
         # assembly alignment is the identity); untraced runs keep the
         # NullTracer fast path and stay the honest TPS figures
@@ -153,7 +179,10 @@ def commit_stage_stats(metrics) -> dict:
 
 def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
              timeout: float = 120.0, trace: bool = False,
-             config_overrides: dict = None) -> dict:
+             config_overrides: dict = None, window: int = 256) -> dict:
+    """window: max requests in flight while feeding. 256 floods the
+    pipeline (the headline shape); small windows trickle config7-style
+    per-tick batches (the pipeline A/B's coalescing measurement)."""
     from plenum_tpu.common.request import Request
     from plenum_tpu.crypto.ed25519 import Ed25519Signer
     from plenum_tpu.execution.txn import NYM
@@ -194,6 +223,18 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     for n in names:
         replies[n].clear()
 
+    # pipeline warmup contract: compile the pad buckets steady state will
+    # dispatch WHILE THE CLOCK IS NOT RUNNING, then pin — after pin() the
+    # ring only selects compiled shapes (pad up / split), so the timed
+    # phase can never stall on a mid-run XLA compile. The warmup txn
+    # above only reaches the smallest bucket; before prewarm+pin, one
+    # cold 128-bucket wave cost a 25 s retrace+compile mid-measurement
+    # and collapsed this pool from 206 to 5.7 TPS.
+    pipe = getattr(plane, "_pipeline", None) if plane is not None else None
+    if pipe is not None:
+        pipe.prewarm(pipe.buckets[:2])
+        pipe.pin()
+
     n_txns = len(requests)
     t_start = time.perf_counter()
     next_submit = 0
@@ -203,7 +244,7 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     while done < n_txns and time.perf_counter() < deadline:
         # feed in chunks so the propagate pipeline stays busy but inboxes
         # don't balloon
-        while next_submit < n_txns and next_submit - done < 256:
+        while next_submit < n_txns and next_submit - done < window:
             req = requests[next_submit]
             submit_times[req.digest] = time.perf_counter()
             for n in names:
@@ -243,6 +284,7 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
             trace_summary["stage_sum_vs_e2e_p50"] = round(
                 percentile(ratios, 0.5), 4)
     plane_stats = None
+    pipeline_summary = None
     if plane is not None:
         from plenum_tpu.parallel.supervisor import find_supervisor
         sup = find_supervisor(plane)
@@ -252,6 +294,25 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
                            ("breaker_state", "breaker_opens",
                             "fallback_batches", "hedge_wins",
                             "deadline_misses", "device_batches")}
+        pipe = getattr(plane, "_pipeline", None)
+        if pipe is not None:
+            pipeline_summary = pipe.summary()
+    percall = None
+    if backend == "jax-percall":
+        # baseline arm: per-call dispatch accounting straight from each
+        # node's supervised verifier (device_items are REAL items — the
+        # inner pads after the supervisor counts)
+        from plenum_tpu.parallel.supervisor import find_supervisor
+        tb = ti = 0
+        for n in names:
+            v = getattr(nodes[n].c.authenticator.core_authenticator,
+                        "verifier", None)
+            sup = find_supervisor(v)
+            if sup is not None:
+                tb += sup.stats["device_batches"]
+                ti += sup.stats["device_items"]
+        percall = {"device_batches": tb, "device_items": ti,
+                   "items_per_dispatch": round(ti / tb, 2) if tb else 0.0}
     # controller trajectory from the master PRIMARY (Node1 under the
     # round-robin selector): final knob positions + the rolling per-stage
     # p50/p95 vs the SLO that put them there — the bench line's view of
@@ -259,6 +320,8 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     ctl = getattr(nodes[names[0]], "batch_controller", None)
     return {
         **({"trace": trace_summary} if trace_summary else {}),
+        **({"pipeline": pipeline_summary} if pipeline_summary else {}),
+        **({"percall": percall} if percall else {}),
         **({"controller": ctl.trajectory()} if ctl is not None else {}),
         **({"commit_stage": stage} if stage else {}),
         **({"crypto_plane": plane_stats,
@@ -284,7 +347,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=4)
     ap.add_argument("--txns", type=int, default=200)
-    ap.add_argument("--backend", default="cpu", choices=["cpu", "jax"])
+    ap.add_argument("--backend", default="cpu",
+                    choices=["cpu", "jax", "jax-percall"])
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
     stats = run_load(args.nodes, args.txns, args.backend)

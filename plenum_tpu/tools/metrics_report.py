@@ -194,6 +194,42 @@ def derive_summary(folds: dict[str, dict], span_s: float,
     if "crypto.bls_local_fallbacks" in folds:
         out["bls_local_fallbacks"] = int(
             cum("crypto.bls_local_fallbacks") or 0)
+    # fused crypto pipeline (docs/performance.md "Fused device-resident
+    # crypto pipeline"): dispatch volume, coalesced items per dispatch
+    # (the cross-stage amortization figure), the ring's dedup ratio, pad
+    # waste, bucket hit rate, and the steering knobs' latest positions.
+    # A rising compiled_shapes after warmup is the recompile-guard alarm.
+    pd = folds.get("pipeline.dispatches", {})
+    if pd.get("max") is not None:
+        section = {
+            "dispatches": int(cum("pipeline.dispatches") or 0),
+            "dedup_ratio": folds.get("pipeline.dedup_ratio",
+                                     {}).get("last"),
+            "bucket_hit_rate": folds.get("pipeline.bucket_hit_rate",
+                                         {}).get("last"),
+            "compiled_shapes": int(
+                cum("pipeline.compiled_shapes") or 0),
+        }
+        ipd = folds.get("pipeline.items_per_dispatch", {})
+        if ipd.get("mean") is not None:
+            section["items_per_dispatch_mean"] = round(ipd["mean"], 1)
+        pw = folds.get("pipeline.pad_waste", {})
+        if pw.get("mean") is not None:
+            section["pad_waste_mean"] = round(pw["mean"], 3)
+        occ = folds.get("pipeline.occupancy", {})
+        if occ.get("mean") is not None:
+            section["occupancy_mean"] = round(occ["mean"], 1)
+            section["occupancy_max"] = occ.get("max")
+        pctl = folds.get("pipeline_ctl.flush_wait", {})
+        if pctl.get("last") is not None:
+            section["controller"] = {
+                "flush_wait_ms": _ms(pctl["last"]),
+                "bucket_floor": int(folds.get(
+                    "pipeline_ctl.bucket_floor", {}).get("last") or 0),
+                "decisions": int(cum("pipeline_ctl.decisions") or 0),
+            }
+        out["crypto_pipeline"] = {k: v for k, v in section.items()
+                                  if v is not None}
     # closed-loop batch controller (docs/performance.md "Pipelined
     # ordering"): where the steered knobs sit (latest gauge) and how many
     # decisions the loop has made — a flat decision count under load

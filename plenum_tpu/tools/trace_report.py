@@ -128,6 +128,8 @@ class _NodeIndex:
         # batch-controller decisions, in order: the control trajectory
         # (knob positions + the stage p95s that moved them)
         self.control: list[tuple[float, dict]] = []
+        # fused-pipeline device waves (bucket id, item count, pad waste)
+        self.device_waves: list[dict] = []
         for t, stage, key, data in dump["events"]:
             at = t + offset
             self.first.setdefault((stage, key), at)
@@ -151,6 +153,16 @@ class _NodeIndex:
                 if isinstance((data or {}).get("proof_dur"), (int, float)):
                     self.stage_durs.setdefault("read_proof_wall",
                                                []).append(data["proof_dur"])
+            elif stage == tracing.DEVICE:
+                # fused-pipeline wave: submit->pack->dispatch->collect
+                # sub-spans become device_* attribution stages, and the
+                # bucket/pad story is summarized per node
+                d = data or {}
+                for sub in ("queue", "pack", "dispatch"):
+                    if isinstance(d.get(sub), (int, float)):
+                        self.stage_durs.setdefault(
+                            f"device_{sub}", []).append(max(0.0, d[sub]))
+                self.device_waves.append(d)
             if stage.startswith(tracing.ANOMALY_PREFIX):
                 self.anomalies.append(
                     (at, stage[len(tracing.ANOMALY_PREFIX):], data))
@@ -243,9 +255,13 @@ def assemble(dumps: list[dict]) -> dict:
                         for a in ((t, idx.node, kind, data)
                                   for t, kind, data in idx.anomalies)))
     controller = {idx.node: idx.control for idx in indexes if idx.control}
+    # fused-pipeline device waves: the ring is host-shared, so the
+    # last-attached node's tracer holds the full story — merge all
+    device = [w for idx in indexes for w in idx.device_waves]
     return {"nodes": sorted(offsets), "offsets": offsets,
             "requests": requests, "attribution": attribution,
-            "anomalies": anomalies, "controller": controller}
+            "anomalies": anomalies, "controller": controller,
+            "device": device}
 
 
 def attribution_summary(report: dict) -> dict:
@@ -283,6 +299,23 @@ def summarize(report: dict, sample: int = 3) -> dict:
         control = {"node": node, "decisions": len(decisions),
                    "final": decisions[-1][1]}
         break
+    # device waves: bucket histogram + mean pad waste for the bench line
+    device = None
+    waves = report.get("device") or []
+    if waves:
+        buckets: dict = {}
+        for w in waves:
+            buckets[w.get("bucket")] = buckets.get(w.get("bucket"), 0) + 1
+        pads = [w["pad"] / w["bucket"] for w in waves
+                if w.get("bucket") and isinstance(w.get("pad"), (int, float))]
+        device = {"waves": len(waves),
+                  "buckets": {str(k): v for k, v in sorted(
+                      buckets.items(), key=lambda kv: str(kv[0]))},
+                  "pad_waste_mean": round(sum(pads) / len(pads), 3)
+                  if pads else None,
+                  "mean_coalesced": round(
+                      sum(w.get("coalesced", 0) for w in waves)
+                      / len(waves), 2)}
     return {
         "requests_traced": len(report["requests"]),
         "attribution": attribution,
@@ -292,6 +325,7 @@ def summarize(report: dict, sample: int = 3) -> dict:
         if ratios else None,
         "anomalies": len(report["anomalies"]),
         **({"controller": control} if control else {}),
+        **({"device": device} if device else {}),
     }
 
 
@@ -304,6 +338,20 @@ def _print_report(report: dict, last_n: int) -> None:
     print(hdr + "\n  " + "-" * (len(hdr) - 2))
     for name, s in attribution_summary(report).items():
         print(f"  {name:12} {s['p50_ms']:>10} {s['p95_ms']:>10} {s['n']:>8}")
+    waves = report.get("device") or []
+    if waves:
+        n = len(waves)
+        pads = [w["pad"] / w["bucket"] for w in waves if w.get("bucket")]
+        print(f"\ndevice pipeline: {n} waves, "
+              f"mean coalesced {sum(w.get('coalesced', 0) for w in waves) / n:.1f}, "
+              f"pad waste {sum(pads) / len(pads):.1%}" if pads else
+              f"\ndevice pipeline: {n} waves")
+        for w in waves[-last_n:]:
+            print(f"  {w.get('kind', '?'):4} bucket={w.get('bucket')} "
+                  f"n={w.get('n')} coalesced={w.get('coalesced')} "
+                  f"pad={w.get('pad')} queue={1000 * w.get('queue', 0):.2f}ms "
+                  f"pack={1000 * w.get('pack', 0):.2f}ms "
+                  f"dispatch={1000 * w.get('dispatch', 0):.2f}ms")
     for node, decisions in sorted(report.get("controller", {}).items()):
         print(f"\ncontrol trajectory @{node} ({len(decisions)} decisions):")
         for t, d in decisions[-last_n * 2:]:
@@ -347,6 +395,12 @@ def _synthetic_dumps() -> list[dict]:
             [0.040, tracing.ORDERED, batch, {"seq": 1, "votes": 2}],
             [0.045, tracing.DURABLE, "", {"seqs": [1], "dur": 0.005}],
             [0.046, tracing.REPLY, req, {"seq": 1}],
+            # fused-pipeline device wave: the `device` waterfall stage
+            # (submit->pack->dispatch->collect spans + bucket/pad story)
+            [0.047, tracing.DEVICE, "",
+             {"kind": "ed", "bucket": 64, "n": 11, "coalesced": 40,
+              "pad": 53, "queue": 0.004, "pack": 0.0005,
+              "dispatch": 0.009}],
             # batch-controller decisions: the control trajectory the
             # report must surface next to the waterfalls it steered
             [0.050, tracing.CONTROLLER, "",
@@ -399,9 +453,13 @@ def self_check() -> int:
             problems.append(f"stage sum {wf['total']} != span {span}")
     att = attribution_summary(report)
     for need in ("network", "crypto", "ordering", "durable", "reply",
-                 "apply_wall"):
+                 "apply_wall", "device_queue", "device_pack",
+                 "device_dispatch"):
         if need not in att:
             problems.append(f"attribution missing {need}")
+    dev = summarize(report).get("device")
+    if not dev or dev.get("waves") != 1 or "64" not in dev.get("buckets", {}):
+        problems.append(f"device wave summary wrong: {dev}")
     if att.get("network", {}).get("p50_ms", -1) < 0:
         problems.append("causality alignment failed (negative network)")
     if not report["anomalies"]:

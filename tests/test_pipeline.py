@@ -1,0 +1,423 @@
+"""Fused crypto pipeline (parallel/pipeline.py): recompile guard, fused
+Merkle equivalence, ring dedup, double buffering, controller steering,
+supervisor composition, and the disabled-overhead bound."""
+import random
+import time
+
+import numpy as np
+import pytest
+
+from plenum_tpu.config import Config
+from plenum_tpu.crypto.ed25519 import (CpuEd25519Verifier, Ed25519Signer,
+                                       JaxEd25519Verifier)
+from plenum_tpu.parallel.pipeline import (CryptoPipeline,
+                                          PipelineController,
+                                          make_crypto_pipeline)
+
+
+class FakeDeviceVerifier(JaxEd25519Verifier):
+    """Records dispatched batch shapes and answers instantly (verdict
+    content is irrelevant to the shape/buffer tests). Subclassing the jax
+    verifier makes the pipeline treat it as device-backed (bucket pad)."""
+
+    def __init__(self):
+        super().__init__(min_batch=1)
+        self.shapes: list[int] = []
+
+    def submit_batch(self, items):
+        self.shapes.append(len(items))
+        return np.ones(len(items), dtype=bool)
+
+    def collect_batch(self, token, wait=True):
+        return token
+
+
+class ManualDeviceVerifier(FakeDeviceVerifier):
+    """Like FakeDeviceVerifier, but resolution is handed out manually —
+    the double-buffer test controls exactly when a wave 'lands'."""
+
+    def __init__(self):
+        super().__init__()
+        self.pending: list[dict] = []
+
+    def submit_batch(self, items):
+        self.shapes.append(len(items))
+        tok = {"n": len(items), "ready": False}
+        self.pending.append(tok)
+        return tok
+
+    def collect_batch(self, token, wait=True):
+        if not token["ready"] and not wait:
+            return None
+        token["ready"] = True
+        return np.ones(token["n"], dtype=bool)
+
+
+def _junk_items(rng, n):
+    """Unique well-FORMED triples (content correctness is not under test
+    — the fake inner answers all-True). The S half's top byte is zeroed
+    so S < L: the ring settles malformed/malleable lanes as False
+    without dispatching them, and these must reach the device."""
+    return [(rng.randbytes(20), rng.randbytes(63) + b"\x00",
+             rng.randbytes(32)) for _ in range(n)]
+
+
+def _fast_config(**over):
+    return Config(PIPELINE_MIN_BUCKET=16, PIPELINE_MAX_BUCKET=64,
+                  PIPELINE_FLUSH_WAIT=0.0, **over)
+
+
+def test_recompile_guard_flat_across_mixed_waves():
+    """Steady-state compile count stays FLAT across 100 mixed-size waves:
+    after one warmup wave per pinned bucket shape, no novel shape may
+    ever be dispatched (a recompile costs minutes on a tunneled TPU)."""
+    rng = random.Random(11)
+    inner = FakeDeviceVerifier()
+    pipe = CryptoPipeline(ed_inner=inner, config=_fast_config())
+
+    # warmup: one wave per bucket in the pinned ladder (16, 32, 64)
+    for size in (3, 20, 40):
+        tok = pipe.submit_verify(_junk_items(rng, size))
+        pipe.flush()
+        assert pipe.collect_verify(tok) is not None
+    warm_shapes = pipe.compiled_shapes
+    pipe.pin()
+
+    for _ in range(100):
+        tok = pipe.submit_verify(_junk_items(rng, rng.randint(1, 60)))
+        pipe.flush()
+        assert pipe.collect_verify(tok) is not None
+    assert pipe.compiled_shapes == warm_shapes, \
+        "steady state met a novel dispatch shape"
+    assert pipe.stats["unpinned_shapes"] == 0
+    # every dispatched batch landed exactly on a pinned bucket
+    assert set(inner.shapes) <= {16, 32, 64}
+
+
+def test_pinned_enforcement_pads_and_splits_to_compiled_shapes():
+    """After pin(), a wave size with NO compiled bucket must not compile
+    one: it pads up to the smallest compiled bucket that fits or splits
+    at the largest — a novel mid-run shape costs a 25-45 s XLA
+    retrace+compile (the measured 206 -> 5.7 TPS collapse), padding
+    costs microseconds."""
+    rng = random.Random(19)
+    inner = FakeDeviceVerifier()
+    pipe = CryptoPipeline(ed_inner=inner, config=_fast_config())
+    # warm ONLY bucket 16 (the single-txn warmup shape), then pin
+    tok = pipe.submit_verify(_junk_items(rng, 3))
+    pipe.flush()
+    assert pipe.collect_verify(tok) is not None
+    assert pipe.compiled_shapes == 1
+    pipe.pin()
+    # 40 items would naturally pick bucket 64 — enforcement must split
+    # into 16-lane waves instead (the only compiled shape)
+    tok = pipe.submit_verify(_junk_items(rng, 40))
+    out = pipe.collect_verify(tok, wait=True)
+    assert out is not None and len(out) == 40 and out.all()
+    assert set(inner.shapes) == {16}
+    assert pipe.compiled_shapes == 1
+    assert pipe.stats["unpinned_shapes"] == 0
+
+
+def test_prewarm_compiles_ladder_then_steady_state_never_recompiles():
+    rng = random.Random(29)
+    inner = FakeDeviceVerifier()
+    pipe = CryptoPipeline(ed_inner=inner, config=_fast_config())
+    assert pipe.prewarm([16, 32]) == [16, 32]
+    assert set(inner.shapes) == {16, 32}
+    assert pipe.compiled_shapes == 2
+    pipe.pin()
+    # prewarm lanes (all-zero verkey) must not poison the verdict cache
+    assert not pipe._ed_cache
+    for size in (1, 10, 17, 30, 60):
+        tok = pipe.submit_verify(_junk_items(rng, size))
+        out = pipe.collect_verify(tok, wait=True)
+        assert out is not None and len(out) == size
+    assert set(inner.shapes) == {16, 32}       # 60 split as 32+{16,32}
+    assert pipe.stats["unpinned_shapes"] == 0
+    # a cpu-backed (unbucketed) pipeline has no shapes to compile
+    assert CryptoPipeline(ed_inner=CpuEd25519Verifier(),
+                          config=_fast_config()).prewarm([16]) == []
+
+
+def test_bucket_padding_and_overflow_split():
+    rng = random.Random(5)
+    pipe = CryptoPipeline(ed_inner=FakeDeviceVerifier(),
+                          config=_fast_config())
+    # 150 items > max bucket 64: the wave splits, leftovers ride the next
+    tok = pipe.submit_verify(_junk_items(rng, 150))
+    out = pipe.collect_verify(tok, wait=True)
+    assert out is not None and len(out) == 150 and out.all()
+    assert pipe.stats["overflow_waves"] >= 1
+    assert pipe.stats["dispatches"] >= 3          # 64 + 64 + 22
+
+
+def test_malformed_lanes_settle_before_dispatch():
+    """Malformed/malleable items (short sig, wrong-size vk, S >= L) are
+    settled False in the ring and never ride a wave: the dispatched
+    batch length always equals the padded bucket. The device verifier's
+    own staging screen drops such lanes AFTER the ring pads, so letting
+    them through would shrink the real device shape under the one the
+    guard recorded and pin() enforced — a novel mid-run compile."""
+    rng = random.Random(31)
+    inner = FakeDeviceVerifier()
+    pipe = CryptoPipeline(ed_inner=inner, config=_fast_config())
+    good = _junk_items(rng, 10)
+    bad = [
+        (b"m", b"\x01" * 63, b"\x02" * 32),          # short sig
+        (b"m", b"\x01" * 64, b"\x02" * 31),          # short vk
+        (b"m", b"\xff" * 64, b"\x02" * 32),          # S >= L (malleable)
+        (b"m", None, b"\x02" * 32),                  # not bytes at all
+    ]
+    tok = pipe.submit_verify(good + bad)
+    out = pipe.collect_verify(tok, wait=True)
+    assert list(out) == [True] * 10 + [False] * 4
+    assert inner.shapes == [16], \
+        "screened lanes changed the dispatched device shape"
+
+
+def test_ring_dedup_across_submitters():
+    """Co-hosted nodes stage IDENTICAL items; the ring dispatches each
+    unique triple once and publishes the dedup ratio."""
+    rng = random.Random(7)
+    inner = FakeDeviceVerifier()
+    pipe = CryptoPipeline(ed_inner=inner, config=_fast_config())
+    items = _junk_items(rng, 10)
+    v1, v2, v3 = pipe.verifier(), pipe.verifier(), pipe.verifier()
+    toks = [v.submit_batch(items) for v in (v1, v2, v3)]
+    pipe.flush()
+    for v, tok in zip((v1, v2, v3), toks):
+        got = v.collect_batch(tok, wait=True)
+        assert got is not None and len(got) == 10
+    assert pipe.stats["dispatched_items"] == 10      # once, not 30
+    assert pipe.stats["dedup_hits"] == 20
+    assert pipe.dedup_ratio() == pytest.approx(20 / 30)
+    # a later identical batch rides the verdict cache: no new dispatch
+    before = pipe.stats["dispatches"]
+    assert v1.verify_batch(items) is not None
+    assert pipe.stats["dispatches"] == before
+
+
+def test_real_jax_wave_verdicts():
+    """One real device wave end to end (JAX-on-CPU): good and bad
+    signatures come back with the right verdicts through bucket padding
+    and the wave cache."""
+    signer = Ed25519Signer(seed=b"pipeline-wave-test".ljust(32, b"\0"))
+    msgs = [b"wave-%d" % i for i in range(5)]
+    items = [(m, signer.sign(m), signer.verkey) for m in msgs]
+    items.append((b"forged", signer.sign(msgs[0]), signer.verkey))
+    pipe = CryptoPipeline(
+        ed_inner=JaxEd25519Verifier(min_batch=1),
+        config=Config(PIPELINE_MIN_BUCKET=8, PIPELINE_MAX_BUCKET=8,
+                      PIPELINE_FLUSH_WAIT=0.0))
+    got = pipe.verifier().verify_batch(items)
+    assert list(got) == [True] * 5 + [False]
+    assert pipe.stats["dispatches"] == 1
+    # cross-check vs the cpu backend on identical content
+    assert list(CpuEd25519Verifier().verify_batch(items)) == list(got)
+
+
+def test_double_buffer_packs_while_inflight():
+    """Host packs wave N+1 while the device runs wave N; the packed wave
+    dispatches the moment N resolves — without any new flush call."""
+    rng = random.Random(3)
+    inner = ManualDeviceVerifier()
+    pipe = CryptoPipeline(ed_inner=inner, config=_fast_config())
+    t1 = pipe.submit_verify(_junk_items(rng, 20))
+    pipe.flush()                                   # dispatch wave 1
+    assert len(inner.pending) == 1
+    t2 = pipe.submit_verify(_junk_items(rng, 20))
+    pipe.service(force=True)                       # packs wave 2 only
+    assert len(inner.pending) == 1, "dispatched while device busy"
+    assert pipe._ed_packed is not None, "wave 2 not packed during flight"
+    inner.pending[0]["ready"] = True               # wave 1 lands
+    pipe.service()
+    assert len(inner.pending) == 2, "packed wave did not auto-dispatch"
+    inner.pending[1]["ready"] = True
+    assert pipe.collect_verify(t1) is not None
+    assert pipe.collect_verify(t2) is not None
+
+
+def test_controller_steering_replay_identical():
+    """Bucket floor grows on overflow, shrinks on chronic pad waste;
+    flush wait shrinks when queue wait breaks the SLO. Decisions are a
+    pure function of clock-stamped samples — two identical runs decide
+    identically."""
+
+    def run():
+        clock = {"t": 0.0}
+        cfg = Config(PIPELINE_MIN_BUCKET=16, PIPELINE_MAX_BUCKET=256,
+                     PIPELINE_CONTROL_INTERVAL=1.0, PIPELINE_SLO_P95=0.05)
+        ctl = PipelineController(cfg, lambda: clock["t"])
+        log = []
+        # phase 1: overflowing waves -> floor must grow
+        for _ in range(8):
+            clock["t"] += 0.3
+            ctl.note_wave(0.001, 256, 256, overflowed=True)
+            log.append((ctl.bucket_floor, round(ctl.flush_wait, 6)))
+        grown = ctl.bucket_floor
+        # phase 2: tiny fills -> floor decays back
+        for _ in range(12):
+            clock["t"] += 0.3
+            ctl.note_wave(0.001, 2, grown, overflowed=False)
+            log.append((ctl.bucket_floor, round(ctl.flush_wait, 6)))
+        shrunk = ctl.bucket_floor
+        # phase 3: queue waits past the SLO -> flush wait halves
+        for _ in range(8):
+            clock["t"] += 0.3
+            ctl.note_wave(0.2, 12, 16, overflowed=False)
+            log.append((ctl.bucket_floor, round(ctl.flush_wait, 6)))
+        return grown, shrunk, ctl.flush_wait, log, ctl.decisions
+
+    g1, s1, w1, log1, d1 = run()
+    g2, s2, w2, log2, d2 = run()
+    assert g1 > 16, "overflow did not grow the bucket floor"
+    assert s1 < g1, "pad waste did not shrink the floor"
+    assert w1 < Config().PIPELINE_FLUSH_WAIT, \
+        "SLO-breaking queue wait did not shrink the flush hold"
+    assert (g1, s1, w1, log1, d1) == (g2, s2, w2, log2, d2), \
+        "controller decisions are not replay-identical"
+
+
+def test_bls_lane_ring_dedup():
+    """Identical BLS triples staged by co-hosted submitters settle on ONE
+    inner batch_verify over the deduped union."""
+    calls = []
+
+    class FakeBls:
+        def batch_verify(self, items):
+            calls.append(list(items))
+            return [True] * len(items)
+
+    pipe = CryptoPipeline(ed_inner=FakeDeviceVerifier(),
+                          bls_inner=FakeBls(), config=_fast_config())
+    items = [("sig%d" % i, b"msg", "vk%d" % i) for i in range(6)]
+    t1 = pipe.submit_bls(items)
+    t2 = pipe.submit_bls(items)           # the co-hosted twin
+    assert pipe.collect_bls(t1) == [True] * 6
+    assert pipe.collect_bls(t2) == [True] * 6
+    assert len(calls) == 1 and len(calls[0]) == 6
+    assert pipe.stats["bls_unique"] == 6
+    assert pipe.stats["dedup_hits"] >= 6
+
+
+def test_sha_lane_and_tree_hasher_dedup():
+    """The pipelined tree hasher's digests match hashlib exactly, and two
+    replicas hashing the SAME leaf wave pay the work once."""
+    from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from plenum_tpu.ledger.tree_hasher import TreeHasher
+
+    pipe = CryptoPipeline(ed_inner=FakeDeviceVerifier(),
+                          config=_fast_config())
+    h1, h2 = pipe.tree_hasher(), pipe.tree_hasher()
+    ref = TreeHasher()
+    leaves = [b"txn-%d" % i for i in range(40)]
+    assert h1.hash_leaves(leaves) == ref.hash_leaves(leaves)
+    pairs = list(zip(ref.hash_leaves(leaves[0::2]),
+                     ref.hash_leaves(leaves[1::2])))
+    assert h1.hash_children_batch(pairs) == ref.hash_children_batch(pairs)
+    before_unique = pipe.stats["sha_unique"]
+    assert h2.hash_leaves(leaves) == ref.hash_leaves(leaves)
+    assert pipe.stats["sha_unique"] == before_unique, \
+        "replica twin re-hashed cached leaves"
+    # whole trees through the pipelined hasher agree with pure python
+    t_ref = CompactMerkleTree(TreeHasher())
+    t_pipe = CompactMerkleTree(pipe.tree_hasher())
+    rng = random.Random(23)
+    for _ in range(10):
+        chunk = [rng.randbytes(rng.randint(1, 40))
+                 for _ in range(rng.randint(1, 30))]
+        t_ref.extend_batch(chunk)
+        t_pipe.extend_batch(chunk)
+        assert t_ref.root_hash == t_pipe.root_hash
+
+
+def test_fused_merkle_root_equivalence_random():
+    """Fused-wave appends (one device program for all wide interior
+    levels) produce byte-identical roots and proofs vs the pure-Python
+    hasher across random leaf sets and arbitrary base alignments."""
+    from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from plenum_tpu.ledger.tree_hasher import JaxTreeHasher, TreeHasher
+
+    rng = random.Random(41)
+    ref = CompactMerkleTree(TreeHasher())
+    # min_batch huge: leaf hashing stays on hashlib; ONLY the fused
+    # interior path is under test (fuse_min=2 forces it for every wave)
+    fused = CompactMerkleTree(JaxTreeHasher(min_batch=10**9, fuse_min=2))
+    total = 0
+    for step in range(25):
+        chunk = [rng.randbytes(rng.randint(1, 60))
+                 for _ in range(rng.randint(1, 40))]
+        ref.extend_batch(chunk)
+        fused.extend_batch(chunk)
+        total += len(chunk)
+        assert ref.root_hash == fused.root_hash, f"root diverged @{step}"
+        assert ref.tree_size == fused.tree_size
+    for m in (0, 1, total // 3, total - 1):
+        assert ref.inclusion_proof(m) == fused.inclusion_proof(m)
+    for m in (1, 2, total // 2, total):
+        assert ref.consistency_proof(m) == fused.consistency_proof(m)
+
+
+def test_supervisor_composition_wedge_falls_back():
+    """The pipeline dispatches THROUGH the supervised verifier: a wedged
+    device degrades a wave to hedged CPU verdicts (correct, bounded) and
+    the breaker records the failure — device_flap composes unchanged."""
+    from plenum_tpu.parallel.faults import FaultyVerifier
+    from plenum_tpu.parallel.supervisor import (CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+
+    faulty = FaultyVerifier(CpuEd25519Verifier())
+    sup = SupervisedVerifier(
+        faulty, fallback=CpuEd25519Verifier(),
+        breaker=CircuitBreaker(fail_threshold=1, cooldown=60.0),
+        budget=DeadlineBudget(base=0.2, min_s=0.1, warm_max=0.3,
+                              cold_max=0.3))
+    pipe = CryptoPipeline(ed_inner=sup, config=_fast_config())
+    signer = Ed25519Signer(seed=b"pipe-flap".ljust(32, b"\0"))
+    items = [(b"m%d" % i, signer.sign(b"m%d" % i), signer.verkey)
+             for i in range(3)]
+    faulty.wedge()
+    got = pipe.verifier().verify_batch(items)
+    assert list(got) == [True, True, True]
+    assert sup.stats["hedge_wins"] + sup.stats["fallback_batches"] >= 1
+    assert sup.stats["verdict_forks"] == 0
+    # breaker open: the next wave routes straight to CPU, unpadded
+    fresh = [(b"x%d" % i, signer.sign(b"x%d" % i), signer.verkey)
+             for i in range(3)]
+    got2 = pipe.verifier().verify_batch(fresh)
+    assert list(got2) == [True, True, True]
+    assert sup.stats["open_circuit_fallbacks"] >= 1
+
+
+def test_disabled_pipeline_overhead_bound():
+    """CRYPTO_PIPELINE=False (or a cpu backend) returns None from the
+    construction seam, and the per-prod-cycle disabled cost — the
+    `pipeline is not None` gate — stays NullTracer-grade: under 2% of a
+    1 ms/txn budget across 1000 checks."""
+    assert make_crypto_pipeline(Config(CRYPTO_PIPELINE=False), "jax") is None
+    assert make_crypto_pipeline(Config(), "cpu") is None
+    from plenum_tpu.node.bootstrap import NodeBootstrap
+    comp = NodeBootstrap("OverheadNode").build()
+    assert comp.pipeline is None
+    assert not type(comp.authenticator.core_authenticator.verifier
+                    ).__name__.startswith("Pipeline")
+    n = 1000
+    t0 = time.perf_counter()
+    hits = 0
+    for _ in range(n):
+        if comp.pipeline is not None:     # the exact prod-loop gate
+            hits += 1
+    per_check = (time.perf_counter() - t0) / n
+    assert hits == 0
+    assert per_check < 0.02e-3, \
+        f"disabled gate costs {per_check * 1e6:.2f}us per prod cycle"
+
+
+def test_make_crypto_pipeline_constructs_for_device_backends():
+    pipe = make_crypto_pipeline(Config(), "jax")
+    assert pipe is not None
+    from plenum_tpu.parallel.supervisor import find_supervisor
+    assert find_supervisor(pipe.verifier()) is not None, \
+        "pipeline verifier chain hides the supervisor from node wiring"

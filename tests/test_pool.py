@@ -54,12 +54,14 @@ def make_genesis(names, validator_names=None):
 
 class Pool:
     def __init__(self, names=NODES, seed=42, config=None, data_dir=None,
-                 validator_names=None, verifier=None, tracing=True):
+                 validator_names=None, verifier=None, tracing=True,
+                 pipeline=None):
         self.names = list(names)
         self.timer = MockTimer()
         self.net = SimNetwork(self.timer, SimRandom(seed))
         self.config = config or Config(Max3PCBatchWait=0.05)
         self.verifier = verifier          # shared crypto plane (co-hosted)
+        self.pipeline = pipeline          # shared fused crypto pipeline
         self.data_dir = data_dir          # per-node durable storage root
         self.tracing = tracing            # flight recorders on every node
         self.genesis, self.trustee = make_genesis(self.names, validator_names)
@@ -89,7 +91,8 @@ class Pool:
             data_dir=self._node_data_dir(name),
             crypto_backend=self.config.crypto_backend,
             storage_backend=self.config.kv_backend,
-            verifier=self.verifier).build()
+            verifier=self.verifier,
+            pipeline=self.pipeline).build()
         from plenum_tpu.common.tracing import Tracer
         tracer = Tracer(name, self.timer.get_current_time,
                         clock_domain="shared") if self.tracing else None
