@@ -338,6 +338,15 @@ def main():
         for k in ("legacy_tps", "calib_ms", "controller"):
             if c5.get(k) is not None:
                 result[f"config5_{k}"] = c5[k]
+        # WAN topology acceptance: the 25-node pool must keep ordering
+        # over the geo3 and lossy_wan region presets (the delta vs the
+        # flat config5 figure is the honest cost of geography)
+        c9 = bc.config9_wan25(n_txns=40)
+        for preset in ("geo3", "lossy_wan"):
+            got = c9.get(preset)
+            result[f"config9_wan25_{preset}_tps"] = \
+                got.get("tps") if isinstance(got, dict) \
+                else c9.get("error")
         # verified read plane acceptance: reads/s at 90:10 read:write,
         # measured per-read fanout (target 2 vs legacy 2n), and the
         # client-side proof-verify p50/p95 the read budget rides on

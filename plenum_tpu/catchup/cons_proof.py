@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from plenum_tpu.common.backoff import ExponentialBackoff, RttEstimator
 from plenum_tpu.common.node_messages import ConsistencyProof, LedgerStatus
 from plenum_tpu.common.quorums import Quorums
 from plenum_tpu.common.timer import TimerService
@@ -23,7 +24,10 @@ class ConsProofService:
                  send: Callable,
                  on_target: Callable[[int, Optional[tuple[int, str, tuple[int, int]]]], None],
                  timer: Optional[TimerService] = None,
-                 retry_timeout: float = 5.0):
+                 retry_timeout: float = 5.0,
+                 config=None,
+                 rtt: Optional[RttEstimator] = None,
+                 salt: str = ""):
         """on_target(ledger_id, None) = already up to date;
         on_target(ledger_id, (size, root_hex, (view_no, pp_seq_no)))."""
         self.ledger_id = ledger_id
@@ -34,6 +38,22 @@ class ConsProofService:
         self._running = False
         self._timer = timer
         self._retry_timeout = retry_timeout
+        # Adaptive re-request pacing: the first retry waits an
+        # RTT-informed timeout (srtt + 4*rttvar, clamped), consecutive
+        # fruitless retries back off exponentially with seeded jitter up
+        # to CATCHUP_RETRY_MAX. A flat timeout is wrong in BOTH
+        # directions — see common/backoff.py. Falls back to the flat
+        # `retry_timeout` when CATCHUP_ADAPTIVE_TIMEOUTS is off.
+        self._adaptive = bool(getattr(config, "CATCHUP_ADAPTIVE_TIMEOUTS",
+                                      False)) if config is not None else False
+        self._retry_min = getattr(config, "CATCHUP_RETRY_MIN", 0.25)
+        self._retry_max = getattr(config, "CATCHUP_RETRY_MAX", 30.0)
+        self._rtt = rtt if rtt is not None else RttEstimator()
+        self._backoff = ExponentialBackoff(
+            base=retry_timeout, cap=self._retry_max,
+            jitter=0.3, salt=f"cons_proof/{salt}/{ledger_id}")
+        self._sent_at: Optional[float] = None
+        self.rounds = 0          # status broadcasts this catchup round
         self._retry_armed = False
         self._same_status: set[str] = set()
         self._proofs: dict[tuple[int, str], set[str]] = {}
@@ -49,6 +69,8 @@ class ConsProofService:
         self._same_status.clear()
         self._proofs.clear()
         self._last_3pc_votes.clear()
+        self._backoff.reset()
+        self.rounds = 0
         self._broadcast_status()
         # re-broadcast until a quorum forms (ref ConsistencyProofsTimeout
         # re-request): lost replies or peers that were themselves mid-sync
@@ -60,16 +82,34 @@ class ConsProofService:
 
     def _broadcast_status(self) -> None:
         ledger = self._db.get_ledger(self.ledger_id)
+        self.rounds += 1
+        if self._timer is not None:
+            self._sent_at = self._timer.get_current_time()
         self._send(LedgerStatus(ledger_id=self.ledger_id,
                                 txn_seq_no=ledger.size,
                                 merkle_root=ledger.root_hash.hex(),
                                 view_no=None, pp_seq_no=None), None)
 
+    def _note_reply(self) -> None:
+        """First answer to the outstanding broadcast: fold its round trip
+        into the shared RTT estimate (later answers to the same broadcast
+        measure peer spread, not the link — skip them)."""
+        if self._sent_at is not None and self._timer is not None:
+            self._rtt.note(self._timer.get_current_time() - self._sent_at)
+            self._sent_at = None
+
+    def _retry_delay(self) -> float:
+        if not self._adaptive:
+            return self._retry_timeout
+        return self._backoff.next(base=self._rtt.timeout(
+            floor=self._retry_min, cap=self._retry_max,
+            fallback=self._retry_timeout))
+
     def _arm_retry(self) -> None:
         if self._timer is None:
             return
         self._cancel_retry()
-        self._timer.schedule(self._retry_timeout, self._on_retry)
+        self._timer.schedule(self._retry_delay(), self._on_retry)
         self._retry_armed = True
 
     def _cancel_retry(self) -> None:
@@ -92,6 +132,7 @@ class ConsProofService:
         """A peer telling us ITS status in response to ours."""
         if not self._running or msg.ledger_id != self.ledger_id:
             return
+        self._note_reply()
         ledger = self._db.get_ledger(self.ledger_id)
         if msg.txn_seq_no <= ledger.size and \
                 (msg.txn_seq_no < ledger.size or
@@ -103,6 +144,7 @@ class ConsProofService:
     def process_consistency_proof(self, msg: ConsistencyProof, frm: str) -> None:
         if not self._running or msg.ledger_id != self.ledger_id:
             return
+        self._note_reply()
         ledger = self._db.get_ledger(self.ledger_id)
         if msg.seq_no_end <= ledger.size:
             return

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from plenum_tpu.common.backoff import ExponentialBackoff, RttEstimator
 from plenum_tpu.common.node_messages import CatchupRep, CatchupReq
 from plenum_tpu.common.timer import TimerService
 from plenum_tpu.execution.database_manager import DatabaseManager
@@ -23,7 +24,10 @@ class CatchupRepService:
                  peers_provider: Callable[[], list[str]],
                  on_txn_added: Callable[[int, dict], None],
                  on_complete: Callable[[int], None],
-                 retry_timeout: float = 5.0):
+                 retry_timeout: float = 5.0,
+                 config=None,
+                 rtt: Optional[RttEstimator] = None,
+                 salt: str = ""):
         self.ledger_id = ledger_id
         self._db = db
         self._send = send
@@ -42,6 +46,31 @@ class CatchupRepService:
         self._blacklisted_peers: set[str] = set()
         self._retry_scheduled = False
         self._attempt = 0        # rotates peer assignment across retries
+        # --- progress watchdog (provider switching on stall) ---
+        # Verification failures already blacklist (the peer LIED); a peer
+        # that merely STALLS — accepts the CatchupReq and never answers —
+        # previously cost a silent flat-timeout round every retry, forever
+        # if rotation kept landing chunks on it. Now every fruitless
+        # retry gives each peer asked in that pass a strike; at
+        # STALL_STRIKES the peer is sidelined for this round and its
+        # ranges re-split across the rest (sidelining ALL peers clears
+        # the sideline — a wholly-partitioned node keeps asking).
+        self.STALL_STRIKES = 2
+        self._stall_strikes: dict[str, int] = {}
+        self._sidelined_peers: set[str] = set()
+        self._asked_last_pass: set[str] = set()
+        self._progress_marker: Optional[tuple[int, int]] = None
+        self.stats = {"rounds": 0, "provider_switches": 0, "stalls": 0}
+        # adaptive pacing, same policy as ConsProofService
+        self._adaptive = bool(getattr(config, "CATCHUP_ADAPTIVE_TIMEOUTS",
+                                      False)) if config is not None else False
+        self._retry_min = getattr(config, "CATCHUP_RETRY_MIN", 0.25)
+        self._retry_max = getattr(config, "CATCHUP_RETRY_MAX", 30.0)
+        self._rtt = rtt if rtt is not None else RttEstimator()
+        self._backoff = ExponentialBackoff(
+            base=retry_timeout, cap=self._retry_max,
+            jitter=0.3, salt=f"catchup_rep/{salt}/{ledger_id}")
+        self._pass_sent_at: Optional[float] = None
 
     @property
     def is_running(self) -> bool:
@@ -52,6 +81,11 @@ class CatchupRepService:
         self._running = True
         self.diverged = False
         self._blacklisted_peers.clear()   # fresh round, fresh chances
+        self._sidelined_peers.clear()
+        self._stall_strikes.clear()
+        self._asked_last_pass.clear()
+        self._progress_marker = None
+        self._backoff.reset()
         self._target_size = target_size
         self._target_root = target_root_hex
         self._reps.clear()
@@ -88,8 +122,20 @@ class CatchupRepService:
         missing = [s for s in range(start, end + 1) if s not in covered]
         if not missing:
             return
-        peers = [p for p in self._peers() if p not in self._blacklisted_peers] \
-            or list(self._peers())
+        usable = [p for p in self._peers()
+                  if p not in self._blacklisted_peers
+                  and p not in self._sidelined_peers]
+        if not usable:
+            # every provider sidelined/blacklisted: clear the SOFT
+            # sideline (stalls may have been our own partition) and try
+            # the full non-blacklisted set again — only proven liars
+            # stay out
+            self._sidelined_peers.clear()
+            self._stall_strikes.clear()
+            usable = [p for p in self._peers()
+                      if p not in self._blacklisted_peers] \
+                or list(self._peers())
+        peers = usable
         if not peers:
             return
         # contiguous runs of missing seq_nos, round-robined over peers
@@ -112,15 +158,28 @@ class CatchupRepService:
         # itself behind the target) or times out must not be re-asked for the
         # same chunk forever — only verification failures blacklist.
         self._attempt += 1
+        self.stats["rounds"] += 1
+        self._asked_last_pass = set()
+        self._progress_marker = (ledger.size, len(self._reps))
+        self._pass_sent_at = self._timer.get_current_time()
         for i, (lo, hi) in enumerate(split):
+            peer = peers[(i + self._attempt - 1) % len(peers)]
+            self._asked_last_pass.add(peer)
             self._send(CatchupReq(ledger_id=self.ledger_id,
                                   seq_no_start=lo, seq_no_end=hi,
                                   catchup_till=self._target_size),
-                       [peers[(i + self._attempt - 1) % len(peers)]])
+                       [peer])
+
+    def _retry_delay(self) -> float:
+        if not self._adaptive:
+            return self._retry_timeout
+        return self._backoff.next(base=self._rtt.timeout(
+            floor=self._retry_min, cap=self._retry_max,
+            fallback=self._retry_timeout))
 
     def _schedule_retry(self) -> None:
         self._cancel_retry()
-        self._timer.schedule(self._retry_timeout, self._on_retry_timeout)
+        self._timer.schedule(self._retry_delay(), self._on_retry_timeout)
         self._retry_scheduled = True
 
     def _cancel_retry(self) -> None:
@@ -130,8 +189,29 @@ class CatchupRepService:
 
     def _on_retry_timeout(self) -> None:
         self._retry_scheduled = False
-        if self._running:
-            self._request_missing()
+        if not self._running:
+            return
+        self._note_stalls()
+        self._request_missing()
+
+    def _note_stalls(self) -> None:
+        """A retry fired with NOTHING new since the last request pass:
+        everyone asked in that pass gets a stall strike; repeat offenders
+        are sidelined so the next pass re-splits their ranges across
+        responsive providers (the watchdog half of 'switch providers when
+        a chosen node stalls or lies' — lies blacklist at verification)."""
+        ledger = self._db.get_ledger(self.ledger_id)
+        if self._progress_marker is None or \
+                (ledger.size, len(self._reps)) != self._progress_marker:
+            return
+        self.stats["stalls"] += 1
+        for peer in self._asked_last_pass:
+            strikes = self._stall_strikes.get(peer, 0) + 1
+            self._stall_strikes[peer] = strikes
+            if strikes >= self.STALL_STRIKES and \
+                    peer not in self._sidelined_peers:
+                self._sidelined_peers.add(peer)
+                self.stats["provider_switches"] += 1
 
     # --- receiving --------------------------------------------------------
 
@@ -145,6 +225,15 @@ class CatchupRepService:
         if seqs != list(range(seqs[0], seqs[-1] + 1)) or \
                 seqs[-1] > self._target_size:
             return
+        # a well-formed answer: this provider is alive (stall strikes
+        # clear), the link round trip feeds the adaptive retry pacing,
+        # and the backoff ladder restarts from its floor (progress)
+        if self._pass_sent_at is not None:
+            self._rtt.note(self._timer.get_current_time()
+                           - self._pass_sent_at)
+            self._pass_sent_at = None
+        self._stall_strikes.pop(frm, None)
+        self._backoff.reset()
         if seqs[0] not in self._reps:
             self._reps[seqs[0]] = (seqs[-1],
                                    [msg.txns[str(s)] for s in seqs],

@@ -8,7 +8,7 @@ these buses, which is what makes the engine testable without sockets
 """
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Optional
 
 
 class Router:
@@ -68,12 +68,23 @@ class ExternalBus(Router):
             dst = [dst]
         self._send_handler(message, dst)
 
-    def set_incoming_filter(self, accept_frm: Callable[[str], bool]) -> None:
+    def set_incoming_filter(self, accept_frm: Callable[[str], bool],
+                            accept_msg: Optional[
+                                Callable[[Any, str], bool]] = None) -> None:
+        """accept_frm gates by sender alone; accept_msg, when given, may
+        ADDITIONALLY admit a (message, sender) the sender gate refused —
+        the seam that lets catchup-serving traffic from a known-but-not-
+        yet-validator node (membership churn: a joiner syncing to join)
+        through a validators-only bus without opening consensus quorums
+        to non-members."""
         self._incoming_filter = accept_frm
+        self._incoming_msg_filter = accept_msg
 
     def process_incoming(self, message: Any, frm: str) -> None:
         if not self._incoming_filter(frm):
-            return
+            msg_filter = getattr(self, "_incoming_msg_filter", None)
+            if msg_filter is None or not msg_filter(message, frm):
+                return
         for handler in self.handlers_for(message):
             handler(message, frm)
 

@@ -128,6 +128,23 @@ class MetricsName:
     VC_VOTE_TO_START = "consensus.vc_vote_to_start"
     VC_START_TO_NEW_VIEW = "consensus.vc_start_to_new_view"
     VC_NEW_VIEW_TO_ORDER = "consensus.vc_new_view_to_order"
+    # churn/WAN robustness (sampled -> p50/p95 in metrics_report):
+    # whole-episode view-change duration (first stamp -> first post-VC
+    # master order) and whole-round catchup duration (start -> complete),
+    # plus per-catchup request rounds; provider_switches/watchdog kicks
+    # are cumulative counters and degraded is a 0/1 gauge
+    VC_DURATION = "view_change.duration"
+    CATCHUP_DURATION = "catchup.duration"
+    CATCHUP_ROUNDS = "catchup.rounds"
+    CATCHUP_PROVIDER_SWITCHES = "catchup.provider_switches"
+    CATCHUP_WATCHDOG_KICKS = "catchup.watchdog_kicks"
+    CATCHUP_DEGRADED = "catchup.degraded"
+    # membership churn: pool-registry changes observed at commit, the
+    # validator-count gauge, and BLS key rotations detected (old key
+    # evicted from the crypto planes' key tables)
+    MEMBERSHIP_POOL_CHANGES = "membership.pool_changes"
+    MEMBERSHIP_VALIDATORS = "membership.validators"
+    MEMBERSHIP_KEY_ROTATIONS = "membership.key_rotations"
     # queue depths sampled at each metrics flush
     CLIENT_INBOX_DEPTH = "node.client_inbox_depth"
     PROPAGATE_INBOX_DEPTH = "node.propagate_inbox_depth"
@@ -270,6 +287,8 @@ SAMPLED_NAMES = frozenset({
     MetricsName.READ_PROOF_GEN_TIME,
     MetricsName.INGRESS_QUEUE_WAIT, MetricsName.INGRESS_QUEUE_DEPTH,
     MetricsName.INGRESS_AUTH_BATCH,
+    MetricsName.VC_DURATION, MetricsName.CATCHUP_DURATION,
+    MetricsName.CATCHUP_ROUNDS,
 })
 SAMPLE_CAP = 256
 

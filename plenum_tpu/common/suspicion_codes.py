@@ -38,6 +38,7 @@ class Suspicions:
     PPR_AUDIT_TXN_ROOT_WRONG = Suspicion(25, "PRE-PREPARE audit txn root mismatch")
     CATCHUP_NEEDED = Suspicion(26, "node fell behind checkpoint quorum")
     BACKUP_INSTANCE_STALLED = Suspicion(27, "backup instance ordering stalled")
+    PRIMARY_DEMOTED = Suspicion(28, "primary demoted from the validator set")
     NEW_VIEW_INVALID = Suspicion(30, "NEW_VIEW message failed validation")
     INVALID_REQ_SIGNATURE = Suspicion(31, "client request signature invalid")
 

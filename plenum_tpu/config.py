@@ -133,6 +133,38 @@ class Config:
     # --- catchup (ref config.py:297) ---
     CATCHUP_BATCH_SIZE: int = 5
 
+    # --- WAN-degraded retry/timeout hardening (common/backoff.py;
+    #     docs/robustness.md "Degraded WAN and membership churn") ---
+    # catchup re-requests pace on srtt+4*rttvar (RFC 6298 shape) instead
+    # of the flat 5 s timer, with jittered exponential backoff between
+    # fruitless retries; False restores the flat timer everywhere
+    CATCHUP_ADAPTIVE_TIMEOUTS: bool = True
+    CATCHUP_RETRY_MIN: float = 0.25
+    CATCHUP_RETRY_MAX: float = 30.0
+    # node-level catchup progress watchdog: a catchup whose progress key
+    # is frozen across one interval gets kicked (forced provider rotation
+    # + immediate re-request); repeated kicks escalate to a full restart
+    # of the catchup round
+    CATCHUP_WATCHDOG_INTERVAL: float = 5.0
+    CATCHUP_WATCHDOG_RESTART_KICKS: int = 3
+    # graceful degradation: after this many catchup rounds ending in
+    # divergence (committed prefix conflicts with the quorum target) the
+    # node stops retrying, stays OUT of ordering, and keeps serving
+    # verified reads at its last anchored root (read-only degraded mode)
+    CATCHUP_MAX_DIVERGED_ROUNDS: int = 2
+    # view-change escalation timeout stretches (never shrinks) with the
+    # measured RTT: timeout = clamp(NEW_VIEW_TIMEOUT, mult*rto, MAX)
+    VC_ADAPTIVE_TIMEOUTS: bool = True
+    VC_RTT_TIMEOUT_MULT: float = 20.0
+    VC_TIMEOUT_MAX: float = 120.0
+    # view-change storm self-check: this many consecutive view-change
+    # STARTS without one completing suggests the pool disagrees on
+    # something a view change cannot fix — typically a registry split
+    # (a membership txn committed on some validators but not others, so
+    # primary selection diverges and NO view can gather a NEW_VIEW
+    # quorum). Resync the pool ledger instead of escalating forever.
+    VC_STORM_RESYNC_STARTS: int = 3
+
     # --- metrics (ref config.py METRICS_COLLECTOR_TYPE/flush) ---
     METRICS_FLUSH_INTERVAL: float = 10.0
     QUEUE_GAUGE_SAMPLE_INTERVAL: float = 1.0

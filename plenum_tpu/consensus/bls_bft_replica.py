@@ -116,6 +116,13 @@ class BlsBftReplica:
         # late COMMITs arrive; and senders whose sig already failed for a key
         self._pending_order: dict[tuple[int, int], PrePrepare] = {}
         self._known_bad: dict[tuple[int, int], set[str]] = {}
+        # quorum-complete aggregates that a LATE honest sig may still
+        # upgrade: key -> (pre_prepare, participants). Without this a
+        # node on a slow WAN link whose COMMIT always lands after the
+        # n-f quorum is permanently absent from every multi-sig this
+        # node emits (and a just-re-keyed node never visibly rejoins)
+        self._aggregated: dict[tuple[int, int],
+                               tuple[PrePrepare, tuple]] = {}
 
     def set_quorums(self, quorums: Quorums) -> None:
         self._quorums = quorums
@@ -162,30 +169,25 @@ class BlsBftReplica:
         # suspicious and storm view changes on every pool growth.
         if self._ms_key(ms) in self._verified_ms_keys:
             return None
-        # verkeys AS OF the sig's cited pool state when resolvable (key
-        # rotation: the sig predates the new key), else the current register
-        verkeys = []
-        for n in ms.participants:
-            vk = self._key_at(n, ms.value.pool_state_root_hash) \
-                if self._key_at is not None else None
-            verkeys.append(vk or self._register.get_key_by_name(n))
-        if any(v is None for v in verkeys):
+        # keys AND quorum AS OF the sig's cited pool state — the same
+        # epoch resolution process_order aggregates under, so an honest
+        # aggregate passes here BY CONSTRUCTION (each node's aggregate can
+        # pick a different participant subset, so the self-verified
+        # shortcut alone cannot cover membership changes)
+        key_of, reg, quorums = self._epoch_of(ms.value.pool_state_root_hash)
+        vk_of = {n: key_of(n) for n in ms.participants}
+        if any(v is None for v in vk_of.values()):
             return self.PPR_BLS_MULTISIG_WRONG
-        # quorum of the pool AS OF the sig's cited pool state (each node's
-        # aggregate can pick a different participant subset, so the
-        # self-verified shortcut alone cannot cover membership changes)
-        quorums = self._quorums
-        if self._node_reg_at is not None:
-            reg = self._node_reg_at(ms.value.pool_state_root_hash)
-            if reg:
-                if not set(ms.participants) <= set(reg):
-                    return self.PPR_BLS_MULTISIG_WRONG
-                quorums = Quorums(len(reg))
+        if reg is not None and not set(ms.participants) <= set(reg):
+            return self.PPR_BLS_MULTISIG_WRONG
         if not quorums.bls_signatures.is_reached(len(ms.participants)):
             return self.PPR_BLS_MULTISIG_WRONG
-        if not self._verifier.verify_multi_sig(ms.signature,
-                                               ms.value.as_single_value(),
-                                               verkeys):
+        ok = self._verifier.verify_multi_sig(ms.signature,
+                                             ms.value.as_single_value(),
+                                             [vk_of[n] for n in
+                                              ms.participants])
+        self._drop_stale_points(vk_of)
+        if not ok:
             return self.PPR_BLS_MULTISIG_WRONG
         self._remember_verified(ms)
         return None
@@ -224,22 +226,43 @@ class BlsBftReplica:
         pending = self._pending_order.get(key)
         if pending is not None:
             self.process_order(key, pending)
+            return
+        # late sig for an already-aggregated batch: re-aggregate so the
+        # sender joins the multi-sig (verdicts of the existing members
+        # ride the process-wide cache — the upgrade prices one combined
+        # check of the new sig, not n pairings)
+        agg = self._aggregated.get(key)
+        if agg is not None and sender_node not in agg[1]:
+            self.process_order(key, agg[0])
 
     # --- order ------------------------------------------------------------
 
     def process_order(self, key: tuple[int, int],
                       pre_prepare: PrePrepare) -> Optional[MultiSignature]:
+        # Aggregate under the keys and quorum of the EPOCH the sig value
+        # cites (the pre-prepare's pool state root), not the node's current
+        # register: around a rotation or demotion the two differ, and every
+        # validator judging the embedded aggregate re-derives the CITED
+        # epoch (validate_pre_prepare) — an aggregate judged by current
+        # membership would fail on every honest peer and storm view changes
+        # (churn-soak waves: 3-participant sigs citing a 5-node root, and
+        # stale-register aggregates spanning a rotation window).
+        key_of, _reg, quorums = self._epoch_of(pre_prepare.pool_state_root)
+        # one historic-epoch key resolution per signer per call: _key_at
+        # is a historic pool-state read, and this path re-runs on every
+        # late COMMIT
+        vk_of = {n: key_of(n) for n in self._sigs.get(key, {})}
         sigs = {n: s for n, s in self._sigs.get(key, {}).items()
-                if self._register.get_key_by_name(n) is not None
+                if vk_of[n] is not None
                 and n not in self._known_bad.get(key, set())}
-        if not self._quorums.bls_signatures.is_reached(len(sigs)):
+        if not quorums.bls_signatures.is_reached(len(sigs)):
             self._pending_order[key] = pre_prepare      # retry on late sigs
             return None
         value = self._signed_value(pre_prepare).as_single_value()
         t0 = time.perf_counter()
         from plenum_tpu.crypto.bn254 import PAIRING_STATS
         pairings_before = PAIRING_STATS["pairings"]
-        good, bad = self._batch_verify_commits(sigs, value)
+        good, bad = self._batch_verify_commits(sigs, value, vk_of)
         if self.metrics is not None:
             self.metrics.add_event(MetricsName.COMMIT_BLS_VERIFY_TIME,
                                    time.perf_counter() - t0)
@@ -249,11 +272,14 @@ class BlsBftReplica:
             self._known_bad.setdefault(key, set()).add(sender)
             if self.report_bad_signature is not None:
                 self.report_bad_signature(sender)
-        if not self._quorums.bls_signatures.is_reached(len(good)):
+        if not quorums.bls_signatures.is_reached(len(good)):
             self._pending_order[key] = pre_prepare      # retry on late sigs
             return None
         self._pending_order.pop(key, None)
         participants = tuple(sorted(good))
+        prev = self._aggregated.get(key)
+        if prev is not None and set(participants) <= set(prev[1]):
+            return None         # no new honest signer: keep the aggregate
         agg = self._verifier.create_multi_sig([good[n] for n in participants])
         ms = MultiSignature(signature=agg, participants=participants,
                             value=self._signed_value(pre_prepare))
@@ -262,14 +288,38 @@ class BlsBftReplica:
         if len(self._recent_multi_sigs) > 10:
             oldest = next(iter(self._recent_multi_sigs))
             del self._recent_multi_sigs[oldest]
+        self._aggregated.pop(key, None)     # re-insert newest-last
+        self._aggregated[key] = (pre_prepare, participants)
+        while len(self._aggregated) > 10:
+            del self._aggregated[next(iter(self._aggregated))]
         if self._store is not None:
             self._store.put(ms)
         if self.on_multi_sig is not None:
             self.on_multi_sig(ms)
         return ms
 
-    def _batch_verify_commits(self, sigs: dict[str, str],
-                              value: bytes) -> tuple[dict[str, str], list[str]]:
+    def _epoch_of(self, pool_root: str):
+        """-> (key_of, reg, quorums) AS OF `pool_root` — the epoch a
+        multi-sig value cites. Unresolvable history falls back to the
+        current register/quorums. Aggregation (process_order) and
+        validation (validate_pre_prepare) MUST share this resolution:
+        any divergence makes honest aggregates look forged."""
+        quorums = self._quorums
+        reg = None
+        if self._node_reg_at is not None:
+            reg = self._node_reg_at(pool_root) or None
+            if reg:
+                quorums = Quorums(len(reg))
+
+        def key_of(n: str) -> Optional[str]:
+            vk = self._key_at(n, pool_root) \
+                if self._key_at is not None else None
+            return vk or self._register.get_key_by_name(n)
+        return key_of, reg, quorums
+
+    def _batch_verify_commits(self, sigs: dict[str, str], value: bytes,
+                              vk_of: dict[str, Optional[str]]) \
+            -> tuple[dict[str, str], list[str]]:
         """Validate the whole COMMIT set with ONE random-linear-combination
         pairing check (crypto.bls.BlsCryptoVerifier.batch_verify): every
         signer signs the same ordered-batch value, so the combined check
@@ -280,12 +330,22 @@ class BlsBftReplica:
         subsets can be satisfied by error-cancelling signature pairs, the
         RLC cannot)."""
         names = sorted(sigs)
-        items = [(sigs[n], value, self._register.get_key_by_name(n))
-                 for n in names]
+        items = [(sigs[n], value, vk_of[n]) for n in names]
         oks = self._verifier.batch_verify(items)
+        self._drop_stale_points(vk_of)
         good = {n: sigs[n] for n, ok in zip(names, oks) if ok}
         bad = [n for n, ok in zip(names, oks) if not ok]
         return good, bad
+
+    def _drop_stale_points(self, vk_of: dict[str, Optional[str]]) -> None:
+        """A historic-epoch verify (a batch citing a pre-rotation pool
+        root) legitimately decodes the rotated-OUT key — but it must not
+        stay warm in the key table past the check, or the eviction
+        contract node._on_pool_changed enforces is undone by the next
+        in-flight batch."""
+        for n, vk in vk_of.items():
+            if vk is not None and vk != self._register.get_key_by_name(n):
+                self._verifier.evict_key(vk)
 
     @staticmethod
     def _ms_key(ms: MultiSignature) -> tuple:
@@ -303,3 +363,5 @@ class BlsBftReplica:
                                if k > stable_3pc}
         self._known_bad = {k: v for k, v in self._known_bad.items()
                            if k > stable_3pc}
+        self._aggregated = {k: v for k, v in self._aggregated.items()
+                            if k > stable_3pc}

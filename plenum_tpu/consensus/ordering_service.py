@@ -773,10 +773,25 @@ class OrderingService:
         behind a pool whose full-quorum messages it had half-missed).
         None when no such evidence exists."""
         last = self._data.last_ordered_3pc[1]
+        votes_by_key: dict[tuple[int, int], set[str]] = {
+            k: set(v) for k, v in self.commits.items() if k[1] > last + 1}
+        # Commits the admission gate PARKED never reach self.commits, yet
+        # a weak quorum of them is the same proof the pool committed past
+        # us. The blind spot this closes (membership-churn fuzz): a node
+        # whose stale registry makes it wait for a NEW_VIEW that will
+        # never validate stashes the entire pool's ordering traffic under
+        # WAITING_FOR_NEW_VIEW and looks "not behind" forever; likewise a
+        # re-promoted straggler whose gap exceeds the watermark window
+        # (OUTSIDE_WATERMARKS) or whose pool moved views (FUTURE_VIEW).
+        for queue in self._stasher._queues.values():
+            for message, args, _handler in queue:
+                if isinstance(message, Commit) and message.pp_seq_no > last + 1:
+                    votes_by_key.setdefault(
+                        (message.view_no, message.pp_seq_no),
+                        set()).add(args[0] if args else "")
         best = None
-        for k, votes in self.commits.items():
-            if k[1] > last + 1 and \
-                    self._data.quorums.weak.is_reached(len(votes)):
+        for k, votes in votes_by_key.items():
+            if self._data.quorums.weak.is_reached(len(votes)):
                 best = k[1] if best is None else max(best, k[1])
         return best
 

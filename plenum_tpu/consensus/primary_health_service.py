@@ -39,13 +39,19 @@ class PrimaryHealthService:
                  bus: InternalBus,
                  has_pending_work: Callable[[], bool],
                  config: Optional[Config] = None,
-                 network: Optional[ExternalBus] = None):
+                 network: Optional[ExternalBus] = None,
+                 rtt=None):
         self._data = data
         self._timer = timer
         self._bus = bus
         self._has_pending_work = has_pending_work
         self._config = config or Config()
         self._network = network
+        # shared RTT estimate: a stall window tuned for a LAN reads a
+        # merely-slow WAN primary as dead and storms view changes. The
+        # configured timeouts stay the FLOOR (clean networks unchanged);
+        # a measured slow network stretches them (VC_ADAPTIVE_TIMEOUTS).
+        self._rtt = rtt
 
         self._progress_marker = data.last_ordered_3pc
         self._stall_since: Optional[float] = None
@@ -114,6 +120,19 @@ class PrimaryHealthService:
         self._check_ordering_progress(now)
         self._check_freshness(now)
 
+    def _stretch(self, flat: float, mult: float) -> float:
+        """RTT-informed stall window: max(configured flat value, mult
+        measured round trips) — ordering a batch is a few sequential
+        broadcasts, so `mult * rto` bounds how long a HEALTHY primary can
+        legitimately take on this network."""
+        if (self._rtt is None or self._rtt.srtt is None
+                or not getattr(self._config, "VC_ADAPTIVE_TIMEOUTS", False)):
+            return flat
+        cap = getattr(self._config, "VC_TIMEOUT_MAX", 4 * flat)
+        return min(max(flat, cap), max(
+            flat, mult * self._rtt.timeout(floor=0.0, cap=cap,
+                                           fallback=flat)))
+
     def _check_ordering_progress(self, now: float) -> None:
         """Finalized-but-unordered work + no 3PC progress = stalled primary."""
         if not self._has_pending_work():
@@ -122,7 +141,9 @@ class PrimaryHealthService:
         if self._stall_since is None:
             self._stall_since = now
             return
-        if now - self._stall_since >= self._config.ORDERING_PROGRESS_TIMEOUT:
+        timeout = self._stretch(self._config.ORDERING_PROGRESS_TIMEOUT,
+                                mult=10.0)
+        if now - self._stall_since >= timeout:
             self._vote(Suspicions.PRIMARY_STALLED)
             self._stall_since = now          # re-vote each timeout period
 
@@ -133,7 +154,7 @@ class PrimaryHealthService:
         interval = self._config.STATE_FRESHNESS_UPDATE_INTERVAL
         if interval <= 0:
             return        # freshness disabled: mirror _send_freshness_batches
-        limit = interval * 1.5
+        limit = self._stretch(interval * 1.5, mult=10.0)
         if now - self._last_order_time >= limit:
             self._vote(Suspicions.STATE_SIGS_ARE_NOT_UPDATED)
             self._last_order_time = now      # re-vote cadence, not a reset

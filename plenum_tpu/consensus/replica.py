@@ -48,7 +48,8 @@ class Replica:
                  metrics=None,
                  ic_vote_store=None,
                  tracer=None,
-                 controller=None):
+                 controller=None,
+                 rtt=None):
         self.name = replica_name(node_name, inst_id)
         self.inst_id = inst_id
         self.config = config or Config()
@@ -91,7 +92,7 @@ class Replica:
             self.view_changer = ViewChangeService(
                 data=self._data, timer=timer, bus=self.internal_bus,
                 network=network, config=self.config, selector=selector,
-                instance_count=instance_count)
+                instance_count=instance_count, rtt=rtt)
             self.vc_trigger = ViewChangeTriggerService(
                 data=self._data, timer=timer, bus=self.internal_bus,
                 network=network, config=self.config,
@@ -99,7 +100,7 @@ class Replica:
             self.primary_health = PrimaryHealthService(
                 data=self._data, timer=timer, bus=self.internal_bus,
                 has_pending_work=self.has_unordered_work, config=self.config,
-                network=network)
+                network=network, rtt=rtt)
 
         self.internal_bus.subscribe(NewViewAccepted, self._on_new_view_accepted)
         self.internal_bus.subscribe(CheckpointStabilized, self._on_checkpoint_stable)

@@ -155,7 +155,8 @@ class ViewChangeService:
                  network: ExternalBus,
                  config: Optional[Config] = None,
                  selector: Optional[RoundRobinPrimariesSelector] = None,
-                 instance_count: int = 1):
+                 instance_count: int = 1,
+                 rtt=None):
         self._data = data
         self._timer = timer
         self._bus = bus
@@ -164,6 +165,19 @@ class ViewChangeService:
         self._selector = selector or RoundRobinPrimariesSelector()
         self._instance_count = instance_count
         self._builder = NewViewBuilder(data)
+        # shared RTT estimate (node wires the catchup leecher's): a WAN
+        # pool's view change legitimately takes many slow round trips —
+        # the escalation timeout scales UP with measured RTT so a degraded
+        # link doesn't read as a dead primary and storm view+2 escalations.
+        # Never scales DOWN below the configured timeout: the flat config
+        # stays the floor, so clean-LAN behavior is unchanged.
+        self._rtt = rtt
+        self._probe_backoff = None       # armed per view change
+        # PBFT liveness: consecutive failed view changes DOUBLE the next
+        # escalation timeout (reset on completion). Without growth, a WAN
+        # where one view change takes 1.1x the flat timeout escalates
+        # forever — each attempt aborted exactly before it can finish.
+        self._escalations = 0
 
         # per view: author node -> ViewChange
         self._view_changes: dict[int, dict[str, ViewChange]] = {}
@@ -212,6 +226,15 @@ class ViewChangeService:
             checkpoints=tuple((c.view_no, c.seq_no_start, c.seq_no_end, c.digest)
                               for c in self._data.checkpoints),
         )
+        # Votes for views this start skips past can never complete (only
+        # the view we are WAITING in can finish) — retire them now, not
+        # just in _finish: a node that escalates through many views
+        # without ever finishing one otherwise accretes every dead view's
+        # vote set (churn-soak bounded-growth violation: vc_votes grew
+        # one full author-map per abandoned view).
+        self._view_changes = {v: d for v, d in self._view_changes.items()
+                              if v >= proposed}
+        self._acks = {v: d for v, d in self._acks.items() if v >= proposed}
         self._bus.send(ViewChangeStarted(view_no=proposed))
         self._bus.send(PrimarySelected(view_no=proposed,
                                        primaries=tuple(self._data.primaries)))
@@ -222,7 +245,28 @@ class ViewChangeService:
         self._schedule_timeout(proposed)
         self._try_build_or_finish()
 
+    def _new_view_timeout(self) -> float:
+        """Escalation timeout: the flat config value, stretched (never
+        shrunk) by the measured network RTT when adaptive timeouts are on.
+        A view change is bounded by a handful of sequential round trips
+        (VC broadcast -> acks -> NEW_VIEW), so `mult * rto` approximates
+        the protocol's worst path on THIS network."""
+        base = self._config.NEW_VIEW_TIMEOUT
+        cap = getattr(self._config, "VC_TIMEOUT_MAX", 4 * base)
+        if (self._rtt is not None
+                and getattr(self._config, "VC_ADAPTIVE_TIMEOUTS", False)
+                and self._rtt.srtt is not None):
+            mult = getattr(self._config, "VC_RTT_TIMEOUT_MULT", 20.0)
+            base = max(base, mult * self._rtt.timeout(
+                floor=0.0, cap=cap, fallback=base))
+        # binary growth per consecutive escalation (capped): attempt k
+        # gets 2**k the budget, so SOME attempt outlives the network's
+        # actual view-change latency no matter how wrong the config floor
+        return min(cap, base * (2 ** min(self._escalations, 6)))
+
     def _schedule_timeout(self, view_no: int) -> None:
+        timeout = self._new_view_timeout()
+
         def on_timeout():
             if self._data.waiting_for_new_view and self._data.view_no == view_no:
                 # View change didn't complete: VOTE to escalate — through
@@ -232,22 +276,51 @@ class ViewChangeService:
                 # view 11 while the quorum sat at 1). Ref: the reference
                 # routes VC timeouts through instance changes too
                 # (view_change_trigger_service + INSTANCE_CHANGE_TIMEOUT).
+                self._escalations += 1      # next attempt gets 2x budget
                 self._bus.send(VoteForViewChange(
                     suspicion_code=Suspicions.INSTANCE_CHANGE_TIMEOUT.code,
                     view_no=view_no + 1))
                 self._schedule_timeout(view_no)     # keep voting while stuck
-        self._timer.schedule(self._config.NEW_VIEW_TIMEOUT, on_timeout)
+        self._timer.schedule(timeout, on_timeout)
 
-        def request_new_view():
-            # Half-time probe: maybe only the NEW_VIEW itself was lost —
-            # cheaper to re-request it than to escalate views.
-            if (self._data.waiting_for_new_view
-                    and self._data.view_no == view_no
-                    and self._new_view is None):
+        # Re-request probes: maybe only a MESSAGE was lost — far cheaper
+        # to re-ask than to escalate views. The first probe fires at
+        # half-time (as before); on a lossy WAN one probe is one more
+        # coin-flip, so probes now REPEAT on a jittered exponential
+        # backoff until the view change completes or escalates, each one
+        # re-requesting the NEW_VIEW *and* any ViewChange votes a pending
+        # NEW_VIEW cites that we still lack.
+        from plenum_tpu.common.backoff import ExponentialBackoff
+        self._probe_backoff = ExponentialBackoff(
+            base=timeout / 2, cap=timeout, jitter=0.3,
+            salt=f"vc_probe/{self._data.node_name}/{view_no}")
+        self._schedule_probe(view_no)
+
+    def _schedule_probe(self, view_no: int) -> None:
+        backoff = self._probe_backoff
+        if backoff is None:
+            return
+
+        def probe():
+            if (not self._data.waiting_for_new_view
+                    or self._data.view_no != view_no
+                    or self._probe_backoff is not backoff):
+                return                       # completed or escalated past us
+            if self._new_view is None:
                 self._bus.send(MissingMessage(
                     msg_type="NEW_VIEW", key={"view_no": view_no},
                     inst_id=self._data.inst_id, dst=None))
-        self._timer.schedule(self._config.NEW_VIEW_TIMEOUT / 2, request_new_view)
+            if self._pending_new_view is not None:
+                nv, _ = self._pending_new_view
+                held = self._view_changes.get(view_no, {})
+                for author, _digest in nv.view_changes:
+                    if author not in held:
+                        self._bus.send(MissingMessage(
+                            msg_type="VIEW_CHANGE",
+                            key={"view_no": view_no, "author": author},
+                            inst_id=self._data.inst_id, dst=None))
+            self._schedule_probe(view_no)
+        self._timer.schedule(backoff.next(), probe)
 
     # --- collecting votes -------------------------------------------------
 
@@ -447,6 +520,8 @@ class ViewChangeService:
         if not self._data.waiting_for_new_view:
             return
         self._new_view = nv
+        self._probe_backoff = None          # stand the re-request loop down
+        self._escalations = 0               # completed: budget back to floor
         self._data.waiting_for_new_view = False
         self._bus.send(NewViewAccepted(view_no=nv.view_no,
                                        checkpoint=tuple(nv.checkpoint),

@@ -266,6 +266,15 @@ class BlsCryptoVerifier:
             self._vk_cache[verkey] = pt
         return pt
 
+    def evict_key(self, verkey) -> None:
+        """Key rotation: drop the rotated-out verkey's decoded point from
+        the key table (node._on_pool_changed calls this for every BLS
+        rotation it observes). Verdict caches are content-keyed — they
+        cannot return a wrong answer for the new key — but a dead key's
+        warm decode row is cache budget a Byzantine signer leans on."""
+        if isinstance(verkey, str):
+            self._vk_cache.pop(verkey, None)
+
     def is_wellformed_sig(self, signature: str) -> bool:
         """Structural check only (b58 + on-curve): the cheap gate used by
         deferred COMMIT validation; the pairing runs later in aggregate."""

@@ -225,6 +225,11 @@ class CpuEd25519Verifier(Ed25519Verifier):
                 Ed25519PublicKey.from_public_bytes(vk)
         return pk
 
+    def evict_key(self, vk) -> None:
+        """Key rotation: drop the rotated-out key's parsed object."""
+        if isinstance(vk, bytes):
+            self._pk_cache.pop(vk, None)
+
     def verify_batch(self, items: Sequence[VerifyItem]) -> np.ndarray:
         out = np.zeros(len(items), dtype=bool)
         for i, (msg, sig, vk) in enumerate(items):
@@ -307,6 +312,12 @@ class JaxEd25519Verifier(Ed25519Verifier):
         x = _ops.limbs_to_int(rows[0, 0])
         y = _ops.limbs_to_int(rows[0, 1])
         return ((_ops.P - x) % _ops.P, y)
+
+    def evict_key(self, vk) -> None:
+        """Key rotation: drop a rotated-out verkey's staged quarter-point
+        rows from the key table (see BlsCryptoVerifier.evict_key)."""
+        if isinstance(vk, bytes):
+            self._pt_cache.pop(vk, None)
 
     def _dispatch(self, items: Sequence[VerifyItem]):
         if self._compressed_dispatch:

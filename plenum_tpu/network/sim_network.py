@@ -46,6 +46,114 @@ Action = Union[Discard, Deliver, Stash, Mutate]
 Selector = Callable[[Any, str, str], bool]   # (msg, frm, dst) -> bool
 
 
+class LinkProfile(NamedTuple):
+    """One directed link's WAN character. All fields are sim seconds /
+    probabilities / bytes-per-second; every random draw they imply goes
+    through the fabric's SimRandom, so a profiled run replays from its
+    seed exactly like a flat one."""
+    base_delay: float = 0.01     # one-way propagation latency
+    jitter: float = 0.0          # uniform extra delay in [0, jitter]
+    loss: float = 0.0            # per-message drop probability
+    bandwidth: float = 0.0       # serialization cap (bytes/s); 0 = infinite
+
+
+class Topology:
+    """Named regions + per-(region, region) directed LinkProfiles.
+
+    Asymmetry is first-class: the (frm_region, dst_region) key is
+    directed, so an asymmetric route (fat down-link, thin up-link) is two
+    entries. Lookup order: exact directed pair -> ("*", dst) -> (frm, "*")
+    -> default. Peers created after construction (membership churn) are
+    auto-assigned round-robin over the region list so a joining node gets
+    a deterministic placement."""
+
+    def __init__(self, regions: Iterable[str],
+                 links: Optional[dict] = None,
+                 default: Optional[LinkProfile] = None):
+        self.regions = list(regions) or ["region0"]
+        self.links: dict[tuple[str, str], LinkProfile] = dict(links or {})
+        self.default = default or LinkProfile()
+        self._assignment: dict[str, str] = {}
+        self._auto_idx = 0
+
+    def assign(self, name: str, region: Optional[str] = None) -> str:
+        if region is None:
+            region = self.regions[self._auto_idx % len(self.regions)]
+            self._auto_idx += 1
+        self._assignment[name] = region
+        return region
+
+    def assign_round_robin(self, names: Iterable[str]) -> None:
+        for name in names:
+            self.assign(name)
+
+    def region_of(self, name: str) -> str:
+        got = self._assignment.get(name)
+        if got is None:
+            got = self.assign(name)
+        return got
+
+    def set_link(self, frm_region: str, dst_region: str,
+                 profile: LinkProfile) -> None:
+        self.links[(frm_region, dst_region)] = profile
+
+    def profile(self, frm: str, dst: str) -> LinkProfile:
+        a, b = self.region_of(frm), self.region_of(dst)
+        for key in ((a, b), ("*", b), (a, "*")):
+            got = self.links.get(key)
+            if got is not None:
+                return got
+        return self.default
+
+
+def make_topology(preset: str, names: Iterable[str],
+                  n_regions: int = 3) -> Topology:
+    """Region presets for bench/fuzz configs.
+
+    - ``lan``: one region, sub-millisecond, lossless, effectively
+      unbounded bandwidth — the flat fabric restated as a profile.
+    - ``geo3``: `n_regions` geo regions; fast clean intra-region links,
+      40-90 ms inter-region propagation with mild jitter and a 100 Mbit/s
+      serialization cap.
+    - ``lossy_wan``: geo3 degraded — inter-region links lose 3% of
+      messages, jitter widens to 80 ms, bandwidth drops to 20 Mbit/s.
+      This is the profile the churn/view-change hardening is judged
+      under (a view change that only completes on a clean LAN is not a
+      view change).
+    """
+    names = list(names)
+    if preset == "lan":
+        topo = Topology(["lan"], default=LinkProfile(
+            base_delay=0.0002, jitter=0.0003, loss=0.0, bandwidth=125e6))
+        topo.assign_round_robin(names)
+        return topo
+    if preset not in ("geo3", "lossy_wan"):
+        raise ValueError(f"unknown topology preset {preset!r}")
+    regions = [f"geo{i}" for i in range(max(2, n_regions))]
+    intra = LinkProfile(base_delay=0.001, jitter=0.002, loss=0.0,
+                        bandwidth=125e6)
+    if preset == "geo3":
+        inter = LinkProfile(base_delay=0.04, jitter=0.03, loss=0.0,
+                            bandwidth=12.5e6)
+    else:
+        inter = LinkProfile(base_delay=0.06, jitter=0.08, loss=0.03,
+                            bandwidth=2.5e6)
+    links = {}
+    for i, a in enumerate(regions):
+        for j, b in enumerate(regions):
+            if i == j:
+                links[(a, b)] = intra
+            else:
+                # deterministic mild asymmetry: the "far" direction pays
+                # ~25% more propagation (uplink-shaped routes)
+                stretch = 1.0 + 0.25 * ((i + j) % 2 if i < j else 0)
+                links[(a, b)] = inter._replace(
+                    base_delay=inter.base_delay * stretch)
+    topo = Topology(regions, links=links, default=intra)
+    topo.assign_round_robin(names)
+    return topo
+
+
 class Rule(NamedTuple):
     action: Action
     selectors: tuple
@@ -71,7 +179,8 @@ class SimNetwork:
     surviving messages are scheduled for delivery on the shared timer."""
 
     def __init__(self, timer: TimerService, random: Optional[SimRandom] = None,
-                 wire_roundtrip: bool = True):
+                 wire_roundtrip: bool = True,
+                 topology: Optional[Topology] = None):
         self._timer = timer
         self._random = random or SimRandom()
         self._wire_roundtrip = wire_roundtrip
@@ -80,8 +189,20 @@ class SimNetwork:
         self._stashed: list[tuple[Any, str, str]] = []
         self.min_latency = 0.01
         self.max_latency = 0.5
+        # topology-aware fault model: when set, the default delivery path
+        # derives per-message delay/loss/serialization from the directed
+        # (frm, dst) LinkProfile instead of the flat uniform latency.
+        # Explicit Deliver/Discard rules still win (last-added-rule-first),
+        # so targeted scenario faults compose ON TOP of the WAN character.
+        self._topology = topology
+        # directed link -> sim time the link's serializer is busy until
+        # (bandwidth cap: frames queue behind each other, a burst pays
+        # its own transmission time, not just propagation)
+        self._link_busy: dict[tuple[str, str], float] = {}
         self.sent_count = 0
         self.delivered_count = 0
+        self.lost_count = 0          # topology-loss drops (rule Discards
+        #                              are scenario faults, counted apart)
         # per-message-type [count, bytes] over every scheduled delivery —
         # the sim twin of TcpStack.stats["tx_msgs"], so wire-cost claims
         # (digest-gossip) are measurable on the deterministic fabric too
@@ -136,6 +257,14 @@ class SimNetwork:
         self.min_latency = min_value
         self.max_latency = max_value
 
+    def set_topology(self, topology: Optional[Topology]) -> None:
+        self._topology = topology
+        self._link_busy.clear()
+
+    @property
+    def topology(self) -> Optional[Topology]:
+        return self._topology
+
     def _replay_stashed(self) -> None:
         stashed, self._stashed = self._stashed, []
         for msg, frm, dst in stashed:
@@ -189,11 +318,36 @@ class SimNetwork:
                 delay = self._random.float(rule.action.min_delay, rule.action.max_delay)
                 self._schedule(delay, msg, frm, dst, pack_cache)
                 return
+        topo = self._topology
+        if topo is not None:
+            prof = topo.profile(frm, dst)
+            if prof.loss and self._random.float(0.0, 1.0) <= prof.loss:
+                self.lost_count += 1
+                return
+            delay = prof.base_delay
+            if prof.jitter:
+                delay += self._random.float(0.0, prof.jitter)
+            self._schedule(delay, msg, frm, dst, pack_cache, profile=prof)
+            return
         delay = self._random.float(self.min_latency, self.max_latency)
         self._schedule(delay, msg, frm, dst, pack_cache)
 
+    def _tx_time(self, profile: LinkProfile, frm: str, dst: str,
+                 nbytes: int) -> float:
+        """Serialization + queueing on the directed link's bandwidth cap:
+        a frame starts transmitting when the link frees up, so a burst
+        spreads out instead of all arriving one propagation delay later."""
+        if not profile.bandwidth or nbytes <= 0:
+            return 0.0
+        ser = nbytes / profile.bandwidth
+        now = self._timer.get_current_time()
+        start = max(now, self._link_busy.get((frm, dst), now))
+        self._link_busy[(frm, dst)] = start + ser
+        return (start - now) + ser
+
     def _schedule(self, delay: float, msg: Any, frm: str, dst: str,
-                  pack_cache: Optional[dict] = None) -> None:
+                  pack_cache: Optional[dict] = None,
+                  profile: Optional[LinkProfile] = None) -> None:
         if self._wire_roundtrip and isinstance(msg, MessageBase):
             # Serialize now (sender's view), deserialize at delivery — exactly
             # what a real wire does, so schema violations fail loudly in sims.
@@ -207,6 +361,8 @@ class SimNetwork:
             row = self.tx_msgs.setdefault(d.get("op", "?"), [0, 0])
             row[0] += 1
             row[1] += len(data)
+            if profile is not None:
+                delay += self._tx_time(profile, frm, dst, len(data))
             deliver = lambda: self._deliver_wire(data, frm, dst)
         else:
             deliver = lambda: self._deliver(msg, frm, dst)

@@ -52,6 +52,7 @@ try:
 except Exception:  # pragma: no cover — gated again in TcpStack.__init__
     _HAVE_CRYPTOGRAPHY = False
 
+from plenum_tpu.common.backoff import ExponentialBackoff
 from plenum_tpu.common.event_bus import ExternalBus
 from plenum_tpu.common.message_base import MessageBase, message_from_dict
 from plenum_tpu.common.serialization import pack, unpack
@@ -67,7 +68,22 @@ WRITE_HWM = 8 * 1024 * 1024          # drop a peer that stops reading (ZMQ HWM)
 # PRIMARY_DISCONNECT_TIMEOUT (config.py) or a blip at max backoff could
 # outlast the tolerance on every peer at once and force a needless view
 # change. A down peer being redialed every second by n-1 nodes is noise.
+# The doubling is JITTERED per (dialer, peer) — see _retry_backoff: the
+# bare min->max doubling is the same deterministic sequence on every
+# node, so a pool-wide restart had n-1 dialers arriving at each
+# recovering acceptor in synchronized waves (a reconnect stampede, worst
+# exactly when the pool is weakest).
 RETRY_MIN, RETRY_MAX = 0.1, 1.0
+RETRY_JITTER = 0.5
+
+
+def _retry_backoff(dialer: str, peer: str) -> ExponentialBackoff:
+    """Dial-loop retry schedule: truncated doubling with deterministic
+    seeded jitter, decorrelated per (dialer, peer) pair so simultaneous
+    losers spread their retries instead of stampeding in lockstep."""
+    return ExponentialBackoff(base=RETRY_MIN, cap=RETRY_MAX,
+                              jitter=RETRY_JITTER,
+                              salt=f"dial/{dialer}->{peer}")
 
 
 class HandshakeError(Exception):
@@ -335,7 +351,7 @@ class TcpStack:
     # --- handshake: dialer side -----------------------------------------
 
     async def _dial_loop(self, peer: str) -> None:
-        delay = RETRY_MIN
+        backoff = _retry_backoff(self.name, peer)
         while not self._stopped:
             if peer in self._sessions:
                 await asyncio.sleep(RETRY_MAX)
@@ -353,7 +369,7 @@ class TcpStack:
                     self._handshake_dialer(peer, expect_vk, reader, writer),
                     timeout=5.0)
                 self._install_session(peer, sess, reader)
-                delay = RETRY_MIN
+                backoff.reset()
             except (OSError, HandshakeError, asyncio.IncompleteReadError,
                     asyncio.TimeoutError):
                 if writer is not None:       # failed handshake: free the fd
@@ -361,8 +377,7 @@ class TcpStack:
                         writer.close()
                     except Exception:
                         pass
-                await asyncio.sleep(delay)
-                delay = min(delay * 2, RETRY_MAX)
+                await asyncio.sleep(backoff.next())
 
     async def _handshake_dialer(self, peer: str, expect_vk: bytes,
                                 reader, writer) -> _Session:

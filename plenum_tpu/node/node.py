@@ -77,6 +77,15 @@ PRIMARY_FAULT_CODES = frozenset(s.code for s in (
     Suspicions.PPR_TXN_WRONG, Suspicions.PPR_TIME_WRONG,
     Suspicions.PPR_BLS_MULTISIG_WRONG, Suspicions.PPR_AUDIT_TXN_ROOT_WRONG))
 
+# Primary-fault subset meaning "the primary's claimed roots don't match
+# what we derive locally" — ambiguous between a lying primary and OUR OWN
+# divergence. One primary implicated is a vote; f+1 distinct primaries
+# implicated without progress means we are the diverged party (see
+# Node._note_root_mismatch).
+ROOT_MISMATCH_CODES = frozenset(s.code for s in (
+    Suspicions.PPR_STATE_WRONG, Suspicions.PPR_TXN_WRONG,
+    Suspicions.PPR_BLS_MULTISIG_WRONG, Suspicions.PPR_AUDIT_TXN_ROOT_WRONG))
+
 # Unambiguous peer misbehavior that blacklists the sender. Deliberately tiny:
 # digest/BLS mismatches against OUR pre-prepare (PR_DIGEST_WRONG, CM_BLS_WRONG)
 # are NOT here — an equivocating primary makes honest peers produce exactly
@@ -199,12 +208,19 @@ class Node:
         # at bus ingress so no service ever sees traffic from a blacklisted
         # or non-member peer — otherwise a demoted/unknown sender's votes
         # would still count toward 3PC/checkpoint/propagate quorums
-        # (ref server/blacklister.py + validateNodeMsg sender checks)
+        # (ref server/blacklister.py + validateNodeMsg sender checks).
+        # EXCEPTION (membership churn): catchup QUERIES — LedgerStatus
+        # asks and CatchupReq range fetches — are admitted from any node
+        # the POOL LEDGER knows even while it is not a validator, so a
+        # joining/rejoining node can sync before promotion. Only the
+        # query side passes: replies and votes from non-validators stay
+        # filtered, so they can never feed a cons-proof or 3PC quorum.
         self.blacklister = Blacklister(
             ttl=self.config.BLACKLIST_TTL, now=timer.get_current_time)
         self.node_bus.set_incoming_filter(
             lambda frm: frm in self.validators
-            and not self.blacklister.is_blacklisted(frm))
+            and not self.blacklister.is_blacklisted(frm),
+            accept_msg=self._accept_joiner_msg)
 
         self.propagator = Propagator(
             name, self.quorums,
@@ -240,6 +256,13 @@ class Node:
         self.batch_controller = make_controller(
             self.config, timer, tracer=self.tracer, metrics=self.metrics)
 
+        # one network RTT estimate for the whole node (common/backoff.py):
+        # fed by catchup round trips, read by catchup retry pacing AND the
+        # view-change escalation timeout — both must agree on what "slow"
+        # means on this link before either declares anything dead
+        from plenum_tpu.common.backoff import RttEstimator
+        self.catchup_rtt = RttEstimator()
+
         # RBFT: f+1 protocol instances by default (ref replicas.py:19),
         # recomputed as pool membership changes f; an explicit
         # instance_count PINS the count (BASELINE config 2 runs 3)
@@ -252,11 +275,25 @@ class Node:
         self.replicas.grow_to(n_inst)
 
         # audit txns snapshot the current primaries + node reg
-        # (ref audit_batch_handler.py:83-231)
+        # (ref audit_batch_handler.py:83-231). The registry MUST come from
+        # UNCOMMITTED pool state — the registry at this batch's position in
+        # the chain — never from the committed view (`self.validators`):
+        # with a deep in-flight window, a NODE txn can sit applied-but-
+        # uncommitted under later batches, and commit-time registries
+        # differ node to node (one commits the NODE txn before applying
+        # batch B, another applies B speculatively first), forking the
+        # audit root of the SAME batch (churn soak: committed audit
+        # prefixes conflicting beyond append-repair)
         components.write_manager._primaries_provider = (
             lambda: list(self.replicas.master.data.primaries))
-        components.write_manager._node_reg_provider = (
-            lambda: list(self.validators))
+
+        def uncommitted_node_reg():
+            from plenum_tpu.execution.handlers.node import VALIDATOR
+            reg = [rec.get("alias", dest) for dest, rec
+                   in self.c.node_handler.all_nodes(committed=False).items()
+                   if VALIDATOR in rec.get("services", [VALIDATOR])]
+            return sorted(reg) or [name]
+        components.write_manager._node_reg_provider = uncommitted_node_reg
 
         # highest pp_seq_no this node has executed (via ordering OR catchup);
         # an Ordered re-emitted for a re-certified batch must not double-commit
@@ -303,7 +340,23 @@ class Node:
             peers_provider=lambda: [n for n in self.validators
                                     if n != self.name],
             on_txn_added=self._on_catchup_txn,
-            on_catchup_complete=self._on_catchup_complete)
+            on_catchup_complete=self._on_catchup_complete,
+            config=self.config, salt=name, rtt=self.catchup_rtt)
+        # catchup progress watchdog: a stalled round (frozen progress key
+        # across one interval) gets kicked — forced provider rotation +
+        # immediate re-request; repeated kicks restart the round outright.
+        # Paired with graceful degradation: rounds that keep ending in
+        # divergence park the node in READ-ONLY mode (ordering stays
+        # paused, PR 4 verified reads keep serving at the last anchored
+        # root) instead of a silent retry-forever wedge.
+        self._catchup_started_at: Optional[float] = None
+        self._catchup_progress_mark = None
+        self._catchup_kicks = 0
+        self._diverged_rounds = 0
+        self.read_only_degraded = False
+        self._catchup_watchdog_timer = RepeatingTimer(
+            timer, self.config.CATCHUP_WATCHDOG_INTERVAL,
+            self._catchup_watchdog)
         self.node_bus.subscribe(LedgerStatus, self._receive_ledger_status)
         self.node_bus.subscribe(ConsistencyProof,
                                 self.leecher.process_consistency_proof)
@@ -383,6 +436,27 @@ class Node:
         # seq-lag twin of the view-lag check: a commit quorum sitting
         # ahead of a position that made no progress across one interval
         self._behind_marker: Optional[int] = None
+        # divergence self-check: distinct primaries whose pre-prepares WE
+        # rejected for root mismatches since our last ordering progress.
+        # f+1 distinct primaries contain an honest one, so at that point
+        # the diverged party is provably us, not them — resync (found by
+        # the churn soak: a node whose uncommitted state diverged during
+        # a view-change storm rejected every subsequent batch — no
+        # commits recorded, so behind_evidence stayed None — and wedged
+        # at its last ordered position while voting endless suspicions)
+        self._divergence_primaries: set = set()
+        self._divergence_fired_at = float("-inf")
+        # view-change storm self-check (config.VC_STORM_RESYNC_STARTS):
+        # consecutive view-change starts with no completion between them.
+        # A storm no escalation can end usually means primary selection
+        # itself diverges — a membership txn (demotion, removal) committed
+        # on part of the pool while OUR pool ledger still lacks it, so
+        # every view we propose names a different primary than our peers'
+        # (flood+demotion churn fuzz: a 2v2 registry split left no view
+        # able to gather a NEW_VIEW quorum, ever). The cure is a pool-
+        # ledger resync, not another vote.
+        self._vc_starts_streak = 0
+        self._vc_resync_fired_at = float("-inf")
         self._behind_check_timer = RepeatingTimer(
             timer, self.config.STUCK_BEHIND_CHECK_FREQ,
             self._check_stuck_behind)
@@ -758,7 +832,8 @@ class Node:
             metrics=self.metrics if inst_id == 0 else None,
             ic_vote_store=ic_store,
             tracer=self.tracer if inst_id == 0 else None,
-            controller=self.batch_controller if inst_id == 0 else None)
+            controller=self.batch_controller if inst_id == 0 else None,
+            rtt=self.catchup_rtt if inst_id == 0 else None)
         if bls is not None:
             bls.report_bad_signature = lambda sender, r=replica: \
                 r.internal_bus.send(RaisedSuspicion(
@@ -809,6 +884,11 @@ class Node:
         durations of the real episode that follows. Once a later phase
         exists, earlier stamps freeze; phase metrics are emitted when the
         later endpoint of each pair is stamped."""
+        if phase == "start":
+            self._vc_starts_streak += 1
+            self._maybe_vc_storm_resync()
+        elif phase in ("new_view", "order"):
+            self._vc_starts_streak = 0
         ts = self._vc_phase_ts
         rank = self._VC_ORDER.index(phase)
         if any(p in ts for p in self._VC_ORDER[rank + 1:]):
@@ -822,6 +902,14 @@ class Node:
             for frm, to, metric in self._VC_PHASES:
                 if frm in ts and to in ts:
                     self.metrics.add_event(metric, ts[to] - ts[frm])
+            # whole-episode duration (earliest stamp -> first post-VC
+            # order), sampled so metrics_report prints churn p50/p95
+            first = min(ts[p] for p in self._VC_ORDER if p in ts)
+            self.metrics.add_event(MetricsName.VC_DURATION,
+                                   ts["order"] - first)
+            if self.tracer.enabled:
+                self.tracer.anomaly("view_change_recovered",
+                                    {"duration_s": ts["order"] - first})
             self.spylog.append(("vc_stall_phases", dict(ts)))
             ts.clear()                  # episode complete
 
@@ -940,11 +1028,47 @@ class Node:
             if msg.inst_id == 0:
                 replica.internal_bus.send(
                     VoteForViewChange(suspicion_code=msg.code))
+                self._note_root_mismatch(msg)
             return
         if (msg.code in BLACKLIST_CODES and msg.sender
                 and msg.sender != self.name):
             if self.blacklister.blacklist(msg.sender, msg.code):
                 self.spylog.append(("blacklisted", msg.sender))
+
+    def _note_root_mismatch(self, msg: RaisedSuspicion) -> None:
+        """Divergence self-check. Each root-mismatch rejection implicates
+        ONE primary — possibly byzantine. But once f+1 DISTINCT primaries'
+        batches have failed our root derivation with no ordering progress
+        in between, at least one of them was honest, so our own state is
+        the diverged one: resync instead of wedging on suspicion votes.
+        (The set resets on every master order and on catchup complete.)"""
+        if msg.code not in ROOT_MISMATCH_CODES:
+            return
+        self._divergence_primaries.add(msg.sender)
+        # only self-suspect while the pool is in a SETTLED view we share:
+        # mid-view-change both sides legitimately disagree on roots for a
+        # moment, and a resync here exits consensus exactly when our vote
+        # is needed — the churn soak showed that splitting the pool into
+        # view factions. A cooldown keeps a genuinely wedged node from
+        # re-entering catchup faster than one round can complete.
+        now = self.timer.get_current_time()
+        cooldown = 2 * self.config.STUCK_BEHIND_CHECK_FREQ
+        if (len(self._divergence_primaries) >= self.quorums.weak.value
+                and not self.master_replica.data.waiting_for_new_view
+                and now - self._divergence_fired_at > cooldown
+                and not self.leecher.is_running
+                and not self.read_only_degraded):
+            self._divergence_fired_at = now
+            suspects = sorted(self._divergence_primaries)
+            self._divergence_primaries.clear()
+            self.spylog.append(("divergence_resync", suspects))
+            if self.tracer.enabled:
+                self.tracer.anomaly("divergence_resync",
+                                    {"primaries": suspects})
+            # DEFERRED: suspicions surface inside consensus dispatch;
+            # catchup reverts uncommitted state and must not run under
+            # the 3PC processing stack (same rule as _note_peer_view)
+            self.timer.schedule(0.0, self.start_catchup)
 
     # --- catchup ----------------------------------------------------------
 
@@ -1031,10 +1155,89 @@ class Node:
             self.spylog.append(("resync_after_partition", None))
             self.start_catchup()
 
+    def _maybe_vc_storm_resync(self) -> None:
+        """Storm breaker: VC_STORM_RESYNC_STARTS consecutive view-change
+        starts without a completion → resync the pool ledger. Escalating
+        views only helps when everyone agrees WHO each view's primary is;
+        with a registry split it never can, while catchup always can.
+        Deferred (ViewChangeStarted surfaces inside consensus dispatch)
+        and cooldown-damped like the other resync triggers — a genuine
+        long outage keeps voting, paying at most one catchup round per
+        cooldown window."""
+        if self._vc_starts_streak < self.config.VC_STORM_RESYNC_STARTS:
+            return
+        now = self.timer.get_current_time()
+        cooldown = 2 * self.config.STUCK_BEHIND_CHECK_FREQ
+        if (now - self._vc_resync_fired_at <= cooldown
+                or self.leecher.is_running or self.read_only_degraded):
+            return
+        self._vc_resync_fired_at = now
+        self.spylog.append(("vc_storm_resync", self._vc_starts_streak))
+        if self.tracer.enabled:
+            self.tracer.anomaly("vc_storm_resync",
+                                {"starts": self._vc_starts_streak})
+        self.timer.schedule(0.0, self.start_catchup)
+
+    def _accept_joiner_msg(self, msg, frm: str) -> bool:
+        """Bus-filter escape hatch for membership churn: catchup QUERIES
+        from a node the pool ledger knows but the validator set does not
+        (yet). Strictly the seeder-serving subset — a LedgerStatus ask or
+        a CatchupReq range fetch — so a non-validator can sync to join
+        but can never vote into a cons-proof/3PC/propagate quorum."""
+        if not (isinstance(msg, CatchupReq)
+                or (isinstance(msg, LedgerStatus) and not msg.is_reply)):
+            return False
+        return (frm in self.pool_manager.known_node_names
+                and not self.blacklister.is_blacklisted(frm))
+
+    def _catchup_watchdog(self) -> None:
+        """Kick a stalled catchup round: if the leecher's progress key is
+        frozen across a full interval, force provider rotation + an
+        immediate re-request; after CATCHUP_WATCHDOG_RESTART_KICKS
+        consecutive fruitless kicks, restart the whole round (a target
+        agreed with since-vanished peers can be genuinely unfinishable)."""
+        if not self.leecher.is_running:
+            self._catchup_progress_mark = None
+            self._catchup_kicks = 0
+            return
+        mark = self.leecher.progress_key()
+        if mark != self._catchup_progress_mark:
+            self._catchup_progress_mark = mark
+            self._catchup_kicks = 0
+            return
+        self._catchup_kicks += 1
+        self.metrics.add_event(MetricsName.CATCHUP_WATCHDOG_KICKS)
+        self.spylog.append(("catchup_watchdog_kick", self._catchup_kicks))
+        if self.tracer.enabled:
+            self.tracer.anomaly("catchup_stall",
+                                {"kicks": self._catchup_kicks})
+        if self._catchup_kicks >= self.config.CATCHUP_WATCHDOG_RESTART_KICKS:
+            self._catchup_kicks = 0
+            self.leecher.stop()
+            self.leecher.start()        # fresh targets, fresh providers
+        else:
+            self.leecher.kick()
+
+    def _degrade_read_only(self) -> None:
+        """Catchup cannot complete soundly (divergent committed prefix,
+        repeatedly): park in READ-ONLY mode. Ordering stays paused and no
+        further catchup rounds start, but the verified read plane keeps
+        serving state-proof reads at the last BLS-anchored root — clients
+        get honest (if increasingly stale) proofs instead of a wedged
+        node, and the freshness bound tells them exactly how stale."""
+        if self.read_only_degraded:
+            return
+        self.read_only_degraded = True
+        self.metrics.add_event(MetricsName.CATCHUP_DEGRADED, 1)
+        self.spylog.append(("degraded_read_only", None))
+        if self.tracer.enabled:
+            self.tracer.anomaly("degraded_read_only",
+                                {"diverged_rounds": self._diverged_rounds})
+
     def start_catchup(self) -> None:
         """Pause ordering, revert uncommitted work, sync all ledgers
         (ref node.py:2610 start_catchup → NodeLeecherService.start)."""
-        if self.leecher.is_running:
+        if self.leecher.is_running or self.read_only_degraded:
             return
         # Quorum-ordered batches awaiting execution MUST execute before
         # catchup reverts the uncommitted stack they sit on (ref
@@ -1043,6 +1246,9 @@ class Node:
         # applied batches" and dropped ordered work (partition-heal fuzz).
         self._service_ordered()
         self.metrics.add_event(MetricsName.CATCHUPS)
+        self._catchup_started_at = self.timer.get_current_time()
+        self._catchup_progress_mark = None
+        self._catchup_kicks = 0
         self.spylog.append(("catchup_started", None))
         if self.tracer.enabled:
             self.tracer.anomaly("catchup", None)
@@ -1051,9 +1257,13 @@ class Node:
         self.leecher.start()
 
     def _receive_ledger_status(self, msg: LedgerStatus, frm: str) -> None:
-        # queries go to the seeder; acknowledgments feed our cons-proof quorum
+        # queries go to the seeder; acknowledgments feed our cons-proof
+        # quorum — but only VALIDATORS' acknowledgments: a known-but-
+        # demoted joiner's status may reach us through the joiner filter
+        # and must not count toward the "we are current" quorum
         self.seeder.process_ledger_status(msg, frm)
-        self.leecher.process_ledger_status(msg, frm)
+        if frm in self.validators:
+            self.leecher.process_ledger_status(msg, frm)
 
     def _on_catchup_txn(self, ledger_id: int, txn: dict) -> None:
         """A catchup txn was committed to the ledger: replay it into state
@@ -1076,6 +1286,41 @@ class Node:
         primaries, rejoin consensus (ref allLedgersCaughtUp node.py:1790,
         select_primaries_on_catchup_complete :1830)."""
         from plenum_tpu.execution.handlers import audit as audit_lib
+        # churn observability: duration + request rounds + provider
+        # switches, as sampled metrics AND as flight-recorder context, so
+        # a WAN-degraded catchup regression is a p95 shift in
+        # metrics_report, not an anecdote
+        rounds = self.leecher.round_stats()
+        duration = None
+        if self._catchup_started_at is not None:
+            duration = (self.timer.get_current_time()
+                        - self._catchup_started_at)
+            self._catchup_started_at = None
+            self.metrics.add_event(MetricsName.CATCHUP_DURATION, duration)
+        self.metrics.add_event(MetricsName.CATCHUP_ROUNDS,
+                               rounds["rounds"])
+        if rounds["provider_switches"]:
+            self.metrics.add_event(MetricsName.CATCHUP_PROVIDER_SWITCHES,
+                                   rounds["provider_switches"])
+        if self.tracer.enabled:
+            self.tracer.anomaly("catchup_complete",
+                                {"duration_s": duration, **rounds})
+        if self.leecher.diverged:
+            # the committed prefix conflicts with the quorum target:
+            # re-joining consensus on this ledger would fork. Retry a
+            # bounded number of rounds (the conflict may have been a
+            # transient lie), then degrade to read-only serving.
+            self._diverged_rounds += 1
+            if self._diverged_rounds >= \
+                    self.config.CATCHUP_MAX_DIVERGED_ROUNDS:
+                self._degrade_read_only()
+            else:
+                self.timer.schedule(
+                    self.config.CATCHUP_WATCHDOG_INTERVAL,
+                    self.start_catchup)
+            return                      # ordering stays paused either way
+        self._diverged_rounds = 0
+        self._divergence_primaries.clear()
         audit = self.c.db.get_ledger(AUDIT_LEDGER_ID)
         view_no, pp_seq_no, primaries = audit_lib.last_audited_view(audit)
         if last_3pc is not None and last_3pc > (view_no, pp_seq_no):
@@ -1108,6 +1353,7 @@ class Node:
     def _on_pool_changed(self) -> None:
         """Pool-ledger commit changed membership: recompute quorums, update
         validators and BLS keys (ref node.py:731 setPoolParams)."""
+        old_validators = list(self.validators)
         self.validators = self.pool_manager.node_names or [self.name]
         self.quorums = self.pool_manager.quorums
         self.propagator.set_quorums(self.quorums)
@@ -1115,8 +1361,74 @@ class Node:
         for replica in self.replicas:
             replica.set_validators(self.validators)
         self._adjust_replicas()
+        rotated: list[str] = []
         for n in self.pool_manager.node_names:
-            self.c.bls_register.set_key(n, self.pool_manager.bls_key_of(n))
+            new_key = self.pool_manager.bls_key_of(n)
+            old_key = self.c.bls_register.get_key_by_name(n)
+            if old_key is not None and new_key is not None \
+                    and old_key != new_key:
+                rotated.append(n)
+                # the rotated-OUT key must leave every crypto-plane key
+                # table: fresh commits citing it are liars now, and a
+                # warm decode/verdict row for a dead key is cache budget
+                # a Byzantine signer can lean on (PR 8 key-table contract)
+                for plane in (self.c.pipeline,
+                              getattr(self.replicas.master, "bls",
+                                      None) and
+                              self.replicas.master.bls._verifier):
+                    evict = getattr(plane, "evict_key", None)
+                    if callable(evict):
+                        evict(old_key)
+            self.c.bls_register.set_key(n, new_key)
+        # membership churn observability: every registry change counted,
+        # the validator-count gauge refreshed, rotations called out in
+        # the flight-recorder ring (a view change seconds later should
+        # read as "the primary was demoted", not as a mystery)
+        self.metrics.add_event(MetricsName.MEMBERSHIP_POOL_CHANGES)
+        self.metrics.add_event(MetricsName.MEMBERSHIP_VALIDATORS,
+                               len(self.validators))
+        if rotated:
+            self.metrics.add_event(MetricsName.MEMBERSHIP_KEY_ROTATIONS,
+                                   len(rotated))
+        if self.tracer.enabled:
+            self.tracer.anomaly("pool_changed", {
+                "validators": len(self.validators),
+                "added": sorted(set(self.validators) - set(old_validators)),
+                "removed": sorted(set(old_validators)
+                                  - set(self.validators)),
+                "rotated_keys": rotated})
+        self.spylog.append(("pool_changed",
+                            (len(old_validators), len(self.validators))))
+        # a demoted PRIMARY cannot be waited out: its 3PC messages are
+        # now filtered at every honest bus, so ordering is dead until a
+        # view change — vote immediately instead of burning the ordering-
+        # progress timeout (ref: the reference triggers VC on primary
+        # demotion through its node-reg diff the same way)
+        master = self.replicas.master
+        primary = master.data.primary_name
+        if (primary is not None and primary not in self.validators
+                and self.name in self.validators
+                and not master.data.waiting_for_new_view):
+            self.spylog.append(("primary_demoted", primary))
+            if self.tracer.enabled:
+                self.tracer.anomaly("primary_demoted", {"primary": primary})
+            master.internal_bus.send(VoteForViewChange(
+                suspicion_code=Suspicions.PRIMARY_DEMOTED.code))
+        # SELF-promotion: we just (re)entered the validator set after
+        # sitting out. Anything the pool ordered in between is a gap our
+        # stashed-commit window cannot see (commits far past the watermark
+        # never land in behind_evidence) — resync BEFORE participating, or
+        # we vote suspicions against every batch we cannot re-derive
+        # (churn soak: a re-promoted straggler wedged at its demotion-era
+        # ledger while the pool counted it toward quorums again)
+        if (self.name in self.validators
+                and self.name not in old_validators
+                and not self.leecher.is_running
+                and not self.read_only_degraded):
+            self.spylog.append(("self_promoted_resync", None))
+            if self.tracer.enabled:
+                self.tracer.anomaly("self_promoted_resync", {})
+            self.timer.schedule(0.0, self.start_catchup)
         # transport reacts too (TCP runner syncs its NodeRegistry + dials
         # new members here; ref kit_zstack connectToMissing)
         for cb in self.on_pool_changed_callbacks:
@@ -1663,6 +1975,9 @@ class Node:
         # either way the ledger's cached read results are invalidated
         self.read_plane.on_batch_committed(msg.ledger_id, msg.state_root,
                                            msg.txn_root)
+        # ordering progress: any root-mismatch rejections before this
+        # point no longer evidence OUR divergence
+        self._divergence_primaries.clear()
         self.spylog.append(("executed", (msg.view_no, msg.pp_seq_no)))
         return committed
 
@@ -1775,6 +2090,7 @@ class Node:
                            for r in self.replicas},
             "last_ordered_3pc": tuple(master.last_ordered_3pc),
             "catchup_in_progress": self.leecher.is_running,
+            "read_only_degraded": self.read_only_degraded,
             "instances": len(self.replicas),
             "ledgers": ledgers,
             "metrics": self.metrics.summary(),

@@ -27,12 +27,23 @@ class TxnPoolManager:
     def reload(self) -> bool:
         """Re-derive the registry from committed pool state; True if changed."""
         reg = {}
+        known = set()
         for dest, rec in self._nodes.all_nodes(committed=True).items():
+            known.add(rec.get("alias", dest))
             if VALIDATOR in rec.get("services", [VALIDATOR]):
                 reg[rec.get("alias", dest)] = {**rec, "dest": dest}
         changed = reg != self._cached_reg
         self._cached_reg = reg
+        # every node the pool ledger KNOWS, validator or not: a demoted/
+        # not-yet-promoted member may still be served catchup (it must be
+        # able to sync before it can be promoted into the validator set)
+        self._known_aliases = known
         return changed
+
+    @property
+    def known_node_names(self) -> set[str]:
+        """Aliases of every pool-ledger node regardless of services."""
+        return set(getattr(self, "_known_aliases", set()))
 
     def pool_changed(self) -> None:
         """Call after a pool-ledger batch commits (ref poolTxnCommitted)."""

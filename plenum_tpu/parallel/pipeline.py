@@ -407,6 +407,19 @@ class CryptoPipeline:
             warmed.append(b)
         return warmed
 
+    def evict_key(self, key) -> None:
+        """Membership/key rotation: a rotated-out verkey must leave every
+        key table this ring feeds — the ed25519 inner's staged
+        quarter-point rows (bytes keys) and the BLS inner's decoded G2
+        table (str keys). The ring's own verdict/digest caches are
+        content-keyed (the key participates in the digest), so entries
+        for the dead key can never mis-verify new-key traffic; they age
+        out of the bounded FIFO like any cold content."""
+        for inner in (self._ed_inner, self._bls_inner):
+            evict = getattr(inner, "evict_key", None)
+            if callable(evict):
+                evict(key)
+
     @property
     def compiled_shapes(self) -> int:
         return len(self._shapes)

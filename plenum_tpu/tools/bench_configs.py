@@ -329,7 +329,8 @@ def _host_calib_ms() -> float:
     return round((time.perf_counter() - t0) * 1000, 2)
 
 
-def _sim25_once(n_txns: int, timeout: float, config_overrides=None) -> dict:
+def _sim25_once(n_txns: int, timeout: float, config_overrides=None,
+                topology: str = None) -> dict:
     import plenum_tpu.tools.local_pool as lp
     from plenum_tpu.common.request import Request
     from plenum_tpu.crypto.ed25519 import Ed25519Signer
@@ -338,6 +339,9 @@ def _sim25_once(n_txns: int, timeout: float, config_overrides=None) -> dict:
     (names, nodes, timer, trustee,
      replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(
          25, "cpu", config_overrides=config_overrides)
+    if topology is not None:
+        from plenum_tpu.network import make_topology
+        net.set_topology(make_topology(topology, names))
     reqs = []
     for i in range(n_txns):
         user = Ed25519Signer(seed=(b"s25_%05d" % i).ljust(32, b"\0")[:32])
@@ -398,6 +402,25 @@ def config5_sim25(n_txns: int = 60, timeout: float = 180.0) -> dict:
         return out
     except Exception as e:                       # pragma: no cover
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+def config9_wan25(n_txns: int = 40, timeout: float = 240.0) -> dict:
+    """25-node pool over the TOPOLOGY-AWARE fabric: the same sim25 shape,
+    once per region preset (geo3 clean WAN, lossy_wan degraded). The
+    orderings-still-happen number the WAN robustness work is judged by —
+    and the honest cost of geography: the delta vs config5's flat-LAN
+    figure is propagation+loss, not code. Real time (QueueTimer), so WAN
+    delays are actually waited out; txn count kept small accordingly."""
+    out: dict = {"nodes": 25, "txns_requested": n_txns}
+    try:
+        for preset in ("geo3", "lossy_wan"):
+            run = _sim25_once(n_txns, timeout, topology=preset)
+            out[preset] = {k: run.get(k) for k in
+                           ("txns_ordered", "tps", "wire_bytes_per_txn")}
+        return out
+    except Exception as e:                       # pragma: no cover
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
 
 
 

@@ -1,0 +1,261 @@
+"""Churn soak: sustained writes under live membership churn, with
+BOUNDED-GROWTH assertions on every in-memory structure that must not leak.
+
+The plain soak (tools/soak.py) answers "does steady-state load leak?".
+This one answers the nastier question ROADMAP item 5 asks: does the pool
+leak while the WAN is degraded and the membership itself keeps changing —
+demotions, re-promotions, BLS key rotations, primary demotions — for
+minutes on end? Every churn event exercises exactly the structures that
+have historically grown without bound (stashed future-view messages,
+request state, per-view vote sets, verdict caches), so the soak samples
+them between waves and FAILS if any of them trends past its cap:
+
+* flight-recorder rings            (<= TRACE_RING_SIZE per node)
+* metrics accumulators             (bounded name set, samples <= cap)
+* stashing-router queues+discarded (<= router limit / 1000-deque)
+* propagator request state         (TTL-swept)
+* read-plane result cache          (bounded per-ledger shards)
+* view-change / instance-change vote sets (retired per view)
+* BLS sig/pending-order maps       (GC'd at stable checkpoints)
+
+Runs on SIMULATED time (MockTimer + SimNetwork under the `lossy_wan`
+topology preset), so "10 minutes" means 10 simulated minutes of timer
+fires and churn events, wall-bounded only by host speed.
+
+    python -m plenum_tpu.tools.churn_soak --seconds 600 [--json]
+
+The fast tier-1 smoke (tests/test_resilience.py) runs the same loop for
+a few sim-minutes; the full 10-minute run is the `soak`-marked test.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def _stash_sizes(node) -> int:
+    """Total stashed messages across every service router on the node."""
+    total = 0
+    for replica in node.replicas:
+        for svc in (replica.ordering, replica.checkpointer,
+                    replica.view_changer):
+            stasher = getattr(svc, "_stasher", None)
+            if stasher is not None:
+                total += sum(len(q) for q in stasher._queues.values())
+                total += len(stasher.discarded)
+    return total
+
+
+def _bounds_snapshot(pool) -> dict:
+    """One sample of every bounded-growth structure, max across nodes."""
+    out = {"flight_ring": 0, "metrics_accs": 0, "metrics_samples_max": 0,
+           "stashed": 0, "request_state": 0, "seen_propagates": 0,
+           "read_cache": 0, "vc_votes": 0, "ic_votes": 0, "bls_sigs": 0}
+    for node in pool.nodes.values():
+        snap = node.tracer.snapshot() if node.tracer.enabled else None
+        if snap is not None:
+            out["flight_ring"] = max(out["flight_ring"],
+                                     len(snap["events"]))
+        accs = node.metrics.accumulators
+        out["metrics_accs"] = max(out["metrics_accs"], len(accs))
+        out["metrics_samples_max"] = max(
+            out["metrics_samples_max"],
+            max((len(a.samples or ()) for a in accs.values()), default=0))
+        out["stashed"] = max(out["stashed"], _stash_sizes(node))
+        out["request_state"] = max(out["request_state"],
+                                   len(node.propagator.requests))
+        out["seen_propagates"] = max(out["seen_propagates"],
+                                     len(node._seen_propagates))
+        out["read_cache"] = max(
+            out["read_cache"],
+            sum(len(s) for s in node.read_plane._cache.values()))
+        vcs = node.master_replica.view_changer
+        out["vc_votes"] = max(
+            out["vc_votes"],
+            sum(len(d) for d in vcs._view_changes.values()))
+        trigger = node.master_replica.vc_trigger
+        if trigger is not None:
+            out["ic_votes"] = max(
+                out["ic_votes"],
+                sum(len(d) for d in trigger._votes.values()))
+        bls = node.master_replica.bls
+        if bls is not None:
+            out["bls_sigs"] = max(
+                out["bls_sigs"],
+                len(bls._sigs) + len(bls._pending_order))
+    return out
+
+
+def _check_bounds(sample: dict, config, n_validators: int) -> list[str]:
+    """-> list of violated-bound descriptions (empty = healthy)."""
+    caps = {
+        "flight_ring": config.TRACE_RING_SIZE,
+        "metrics_accs": 256,                 # the MetricsName namespace
+        "metrics_samples_max": 256,          # metrics.SAMPLE_CAP
+        "stashed": 8 * 1000,                 # routers' discarded deques +
+        #                                      transient stash churn
+        "request_state": 5000,               # TTL-swept under FAST sweeps
+        "seen_propagates": 5000,
+        "read_cache": 4 * 4096,
+        "vc_votes": 4 * n_validators,        # <= a few views in flight
+        "ic_votes": 130 * n_validators,      # MAX_FUTURE_VIEWS rows
+        "bls_sigs": 2 * config.CHK_FREQ * n_validators,
+    }
+    return [f"{k}={sample[k]} > cap {caps[k]}"
+            for k in caps if sample[k] > caps[k]]
+
+
+def run_churn_soak(seconds: float = 600.0, seed: int = 11,
+                   wave_s: float = 20.0) -> dict:
+    """Drive a 5-node sim pool (4 validators + 1 churning member) over the
+    lossy_wan topology for `seconds` of SIMULATED time: steady writes
+    plus one churn event per wave, bounds sampled between waves."""
+    import sys
+    sys.path.insert(0, _tests_dir())
+    from test_pool import Pool, signed_nym                  # noqa: E402
+    from test_scale import signed_node_services             # noqa: E402
+
+    from plenum_tpu.config import Config
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    from plenum_tpu.crypto.bls import BlsCryptoSigner
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.execution.txn import NODE
+    from plenum_tpu.network import make_topology
+
+    names = ["Alpha", "Beta", "Gamma", "Delta", "Eps"]
+    config = Config(Max3PCBatchWait=0.05,
+                    PRIMARY_HEALTH_CHECK_FREQ=0.5,
+                    ORDERING_PROGRESS_TIMEOUT=2.0,
+                    STATE_FRESHNESS_UPDATE_INTERVAL=3.0,
+                    VIEW_CHANGE_TIMEOUT=8.0, NEW_VIEW_TIMEOUT=4.0,
+                    OUTDATED_REQS_CHECK_INTERVAL=5.0,
+                    EXECUTED_REQ_RETENTION=10.0,
+                    PROPAGATE_BODYLESS_REQ_TIMEOUT=10.0)
+    pool = Pool(names=names, seed=seed, config=config)
+    pool.net.set_topology(make_topology("lossy_wan", names))
+
+    req_id = 0
+    rotation_no = 0
+
+    def write(n_writes: int) -> None:
+        nonlocal req_id
+        for _ in range(n_writes):
+            req_id += 1
+            user = Ed25519Signer(
+                seed=(b"churn%08d" % req_id).ljust(32, b"\0")[:32])
+            pool.submit(signed_nym(pool.trustee, user, req_id))
+            pool.run(0.5)
+
+    def churn(event_no: int) -> str:
+        nonlocal req_id, rotation_no
+        req_id += 1
+        kind = event_no % 3
+        if kind == 0:
+            # demote the 5th member ... or re-promote it if demoted
+            demoted = "Eps" not in pool.nodes["Alpha"].validators
+            pool.submit(signed_node_services(
+                pool.trustee, "Eps",
+                ["VALIDATOR"] if demoted else [], req_id))
+            return "promote" if demoted else "demote"
+        if kind == 1:
+            # rotate a non-primary validator's BLS key, then re-key the
+            # node's signer (the operator restart, simulated in place)
+            primary = pool.nodes["Alpha"].master_replica.data.primary_name
+            victim = next(n for n in ("Beta", "Gamma", "Delta")
+                          if n != primary)
+            rotation_no += 1
+            new_signer = BlsCryptoSigner(
+                seed=(b"rot%s%04d" % (victim.encode(), rotation_no))
+                .ljust(32, b"\0")[:32])
+            req = Request(pool.trustee.identifier, req_id,
+                          {"type": NODE, "dest": f"{victim}Dest",
+                           "data": {"blskey": new_signer.pk,
+                                    "blskey_pop":
+                                    new_signer.generate_pop()}})
+            req.signature = pool.trustee.sign_b58(req.signing_bytes())
+            pool.submit(req)
+            pool.run(3.0)
+            if victim in pool.nodes:
+                pool.nodes[victim].replicas.master.bls._signer = new_signer
+            return f"rotate:{victim}"
+        # demote the current primary -> forced view change; but never
+        # shrink below 4 validators (f must stay >= 1 for the soak to
+        # keep meaning BFT) — re-promote a demoted member instead
+        validators = pool.nodes["Alpha"].validators
+        demoted = [n for n in names if n not in validators]
+        if demoted:
+            pool.submit(signed_node_services(pool.trustee, demoted[0],
+                                             ["VALIDATOR"], req_id))
+            return f"repromote:{demoted[0]}"
+        primary = pool.nodes["Alpha"].master_replica.data.primary_name
+        pool.submit(signed_node_services(pool.trustee, primary, [],
+                                         req_id))
+        return f"demote_primary:{primary}"
+
+    samples = [_bounds_snapshot(pool)]
+    events: list[str] = []
+    violations: list[str] = []
+    elapsed = 0.0
+    wave_no = 0
+    while elapsed < seconds:
+        write(3)
+        events.append(churn(wave_no))
+        pool.run(wave_s - 5.0)      # writes/churn above consumed ~5 sim-s
+        elapsed += wave_s
+        wave_no += 1
+        sample = _bounds_snapshot(pool)
+        samples.append(sample)
+        bad = _check_bounds(sample, config,
+                            len(pool.nodes["Alpha"].validators))
+        if bad:
+            violations.append(f"wave {wave_no}: " + "; ".join(bad))
+
+    # final convergence: the surviving validator set must order one more
+    # write everywhere (liveness after minutes of churn)
+    req_id += 1
+    user = Ed25519Signer(seed=(b"churn-final%d" % seed)
+                         .ljust(32, b"\0")[:32])
+    pool.submit(signed_nym(pool.trustee, user, req_id))
+    pool.run(30.0)
+    validators = pool.nodes["Alpha"].validators
+    sizes = {n: pool.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in validators if n in pool.nodes}
+    converged = len(set(sizes.values())) == 1
+
+    first, last = samples[0], samples[-1]
+    return {
+        "sim_seconds": elapsed, "waves": wave_no, "events": events,
+        "txns_submitted": req_id,
+        "converged": converged, "ledger_sizes": sizes,
+        "bounds_ok": not violations, "violations": violations,
+        "bounds_first": first, "bounds_last": last,
+        "bounds_max": {k: max(s[k] for s in samples) for k in first},
+    }
+
+
+def _tests_dir() -> str:
+    """The in-process Pool/signed_nym helpers live in tests/ next to the
+    package — the soak reuses them instead of forking a third pool
+    builder."""
+    import os
+    import plenum_tpu
+    return os.path.join(
+        os.path.dirname(os.path.dirname(plenum_tpu.__file__)), "tests")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=600.0,
+                    help="SIMULATED seconds of churn load")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    out = run_churn_soak(args.seconds, seed=args.seed)
+    print(json.dumps(out if args.json else out, indent=None
+                     if args.json else 2))
+    return 0 if (out["bounds_ok"] and out["converged"]) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -50,6 +50,10 @@ def build_genesis(names, node_data_extra=None):
         if node_data_extra and name in node_data_extra:
             data.update(node_data_extra[name])
         txn = txn_lib.new_txn(NODE, {"dest": f"{name}Dest", "data": data})
+        # genesis nodes are steward-owned by the trustee so owner-only
+        # NODE edits (BLS key rotation, readdressing) are exercisable
+        # against a genesis pool (churn soak, membership fuzz)
+        txn["txn"].setdefault("metadata", {})["from"] = trustee.identifier
         txn_lib.set_seq_no(txn, i + 1)
         pool_txns.append(txn)
     nym = txn_lib.new_txn(NYM, {"dest": trustee.identifier,

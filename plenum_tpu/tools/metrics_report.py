@@ -334,6 +334,72 @@ def derive_summary(folds: dict[str, dict], span_s: float,
             "ms_rejected": int(s("observer.ms_rejected")),
             "stale_suppressed": int(s("observer.stale_suppressed")),
         }
+    # view-change robustness (docs/robustness.md "Degraded WAN and
+    # membership churn"): whole-episode durations p50/p95 + the phase
+    # decomposition — a churn regression must read as a p95 shift here,
+    # not as an anecdote in a fuzz log
+    vcd = folds.get("view_change.duration", {})
+    if vcd.get("count"):
+        section = {"episodes": int(vcd["count"])}
+        if vcd.get("samples"):
+            section["duration_s_p50"] = round(
+                percentile(vcd["samples"], 0.5), 2)
+            section["duration_s_p95"] = round(
+                percentile(vcd["samples"], 0.95), 2)
+        elif vcd.get("mean") is not None:
+            section["duration_s_mean"] = round(vcd["mean"], 2)
+        for phase, label in (
+                ("consensus.vc_detect_to_vote", "detect_to_vote_s"),
+                ("consensus.vc_vote_to_start", "vote_to_start_s"),
+                ("consensus.vc_start_to_new_view", "start_to_new_view_s"),
+                ("consensus.vc_new_view_to_order", "new_view_to_order_s")):
+            f = folds.get(phase, {})
+            if f.get("mean") is not None:
+                section[label] = round(f["mean"], 2)
+        out["view_change"] = section
+    # catchup robustness: durations/rounds p50/p95 plus the watchdog's
+    # provider switches and kicks, and the terminal degraded flag
+    cd = folds.get("catchup.duration", {})
+    if cd.get("count") or "catchup.watchdog_kicks" in folds:
+        section = {"completed": int(cd.get("count") or 0)}
+        if cd.get("samples"):
+            section["duration_s_p50"] = round(
+                percentile(cd["samples"], 0.5), 2)
+            section["duration_s_p95"] = round(
+                percentile(cd["samples"], 0.95), 2)
+        elif cd.get("mean") is not None:
+            section["duration_s_mean"] = round(cd["mean"], 2)
+        rounds = folds.get("catchup.rounds", {})
+        if rounds.get("samples"):
+            section["request_rounds_p95"] = round(
+                percentile(rounds["samples"], 0.95), 1)
+        elif rounds.get("mean") is not None:
+            section["request_rounds_mean"] = round(rounds["mean"], 1)
+        section["provider_switches"] = int(
+            s("catchup.provider_switches"))
+        section["watchdog_kicks"] = int(s("catchup.watchdog_kicks"))
+        if folds.get("catchup.degraded", {}).get("max"):
+            section["read_only_degraded"] = True
+        out["catchup"] = {k: v for k, v in section.items()
+                          if v is not None}
+    # membership churn: registry-change volume, the validator-count
+    # trajectory, and BLS key rotations (each one evicts the old key
+    # from the crypto planes' key tables)
+    mc = folds.get("membership.pool_changes", {})
+    if mc.get("count"):
+        vals = folds.get("membership.validators", {})
+        out["membership"] = {
+            "pool_changes": int(s("membership.pool_changes")),
+            "validators_last": int(vals["last"])
+            if vals.get("last") is not None else None,
+            "validators_min": int(vals["min"])
+            if vals.get("min") is not None else None,
+            "validators_max": int(vals["max"])
+            if vals.get("max") is not None else None,
+            "key_rotations": int(s("membership.key_rotations")),
+        }
+        out["membership"] = {k: v for k, v in out["membership"].items()
+                             if v is not None}
     return {k: v for k, v in out.items() if v is not None}
 
 

@@ -45,6 +45,9 @@ def tdir(tmp_path):
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running e2e tests (process pools, fuzzing)")
+    config.addinivalue_line(
+        "markers", "soak: minutes-scale bounded-growth soaks "
+                   "(tools/churn_soak.py; always also marked slow)")
 
 
 # --- flight-recorder dump on failure ----------------------------------------

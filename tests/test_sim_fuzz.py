@@ -914,3 +914,340 @@ def test_fuzz_failure_artifact_includes_all_rings(tmp_path):
                 (path, sorted(stages))
     finally:
         shutil.rmtree(art_dir, ignore_errors=True)
+
+
+# --- scenario kind `membership_churn`: the POOL ITSELF is the fault ---------
+# Live membership operations mid-load — node add (a fresh joiner catching
+# up to join), node remove (including the current primary -> forced view
+# change), BLS key rotation (stale-key commits rejected, then recovery),
+# primary demotion — over the topology-aware WAN fabric (geo3/lossy_wan
+# presets), composable with device_flap and client_flood. Runs as its own
+# seed sweep (widening run_scenario's draw would remap historical seeds).
+
+CHURN_NAMES = ["Alpha", "Beta", "Gamma", "Delta", "Eps"]
+
+
+def _order_on(pool, req, expect_size: float, nodes: list[str],
+              timeout: float = 30.0, to=None):
+    """Submit to live nodes and run until every node in `nodes` reaches
+    expect_size; -> sim seconds, or None on deadline miss."""
+    t0 = pool.timer.get_current_time()
+    live = [n for n in (to or pool.names) if n in pool.nodes]
+    pool.submit(req, to=live)
+    elapsed = 0.0
+    while elapsed < timeout:
+        pool.run(0.5)
+        elapsed += 0.5
+        if all(n in pool.nodes
+               and len(_domain_txns(pool.nodes[n])) >= expect_size
+               for n in nodes):
+            return pool.timer.get_current_time() - t0
+    return None
+
+
+def run_membership_churn_scenario(seed: int, force_rung=None,
+                                  faulted_plane=None) -> None:
+    from plenum_tpu.crypto.bls import BlsCryptoSigner
+    from plenum_tpu.network import make_topology
+    from test_scale import signed_node_services
+
+    rng = SimRandom(seed * 32452843 + 19)
+    rung = rng.integer(0, 3) if force_rung is None else force_rung
+    # the removed-primary rung ALWAYS runs under lossy_wan (the
+    # acceptance profile); other rungs draw clean-vs-degraded WAN
+    preset = "lossy_wan" if (rung == 2 or rng.integer(0, 1) == 0) \
+        else "geo3"
+    verifier = faulted_plane[0] if faulted_plane is not None else None
+    # the join rung starts Eps demoted (it must catch up to join); every
+    # OTHER rung runs all five as validators so a demotion/removal lands
+    # at n=4, f=1 — removing a node from a 4-validator pool would leave
+    # f=0, where ANY message loss is fatal and the rung stops measuring
+    # churn and starts measuring luck
+    pool = _track(Pool(names=CHURN_NAMES,
+                       validator_names=CHURN_NAMES[:4] if rung == 0
+                       else None,
+                       seed=seed, config=Config(**FAST),
+                       verifier=verifier))
+    pool.net.set_topology(make_topology(preset, CHURN_NAMES))
+    if faulted_plane is not None:
+        sup, faulty = faulted_plane
+        sup.set_clock(pool.timer.get_current_time)
+        faulty.set_clock(pool.timer.get_current_time)
+
+    users = [Ed25519Signer(seed=(b"mc%d-%d" % (seed, i))
+                           .ljust(32, b"\0")[:32]) for i in range(4)]
+    reqs = [signed_nym(pool.trustee, u, i + 1) for i, u in enumerate(users)]
+    validators = CHURN_NAMES[:4] if rung == 0 else list(CHURN_NAMES)
+    # healthy baseline write under the drawn WAN profile
+    assert _order_on(pool, reqs[0], 2, validators) is not None, \
+        f"seed {seed}: healthy churn pool failed to order ({preset})"
+
+    if faulted_plane is not None:
+        # the crypto plane faults BEFORE the churn event: every auth /
+        # commit verdict through the churn rides the supervisor's
+        # breaker + hedged CPU fallback
+        getattr(faulted_plane[1],
+                ("wedge", "drop", "corrupt")[rng.integer(0, 2)])()
+
+    req_id = 100
+    if rung == 0:
+        # NODE ADD: Eps restarts with no memory, catches up AS A
+        # NON-VALIDATOR (the joiner bus filter), is promoted, and the
+        # 5-node pool orders everywhere
+        pool.crash_node("Eps")
+        assert _order_on(pool, reqs[1], 3, validators) is not None, \
+            f"seed {seed}: pool stalled while joiner was away"
+        pool.start_node("Eps")
+        pool.net.connect_all()
+        eps = pool.nodes["Eps"]
+        assert len(_domain_txns(eps)) == 1          # fresh from genesis
+        eps.start_catchup()
+        elapsed = 0.0
+        while elapsed < 40.0 and (eps.leecher.is_running
+                                  or len(_domain_txns(eps)) < 3):
+            pool.run(0.5)
+            elapsed += 0.5
+        assert len(_domain_txns(eps)) >= 3, \
+            f"seed {seed}: joiner catchup never completed ({preset})"
+        pool.submit(signed_node_services(pool.trustee, "Eps",
+                                         ["VALIDATOR"], req_id),
+                    to=validators)
+        pool.run(8.0)
+        assert "Eps" in pool.nodes["Alpha"].validators, \
+            f"seed {seed}: promotion never committed"
+        expect = len(_domain_txns(pool.nodes["Alpha"])) + 1
+        took = _order_on(pool, reqs[2], expect, CHURN_NAMES, timeout=40.0)
+        if took is None:
+            sizes = {n: len(_domain_txns(pool.nodes[n]))
+                     for n in CHURN_NAMES}
+            raise AssertionError(
+                f"seed {seed}: post-join pool failed to order: {sizes}")
+    elif rung == 1:
+        # NODE REMOVE (non-primary): demote AND crash a non-primary
+        # validator — the surviving 4 (f=1) keep ordering
+        primary = pool.nodes["Alpha"].master_replica.data.primary_name
+        victim = [n for n in validators if n != primary][rng.integer(0, 3)]
+        pool.submit(signed_node_services(pool.trustee, victim, [],
+                                         req_id),
+                    to=[n for n in CHURN_NAMES if n in pool.nodes])
+        pool.run(8.0)
+        survivors = [n for n in CHURN_NAMES if n != victim]
+        assert victim not in pool.nodes["Alpha"].validators, \
+            f"seed {seed}: demotion never committed"
+        pool.crash_node(victim)
+        expect = len(_domain_txns(pool.nodes["Alpha"])) + 1
+        assert _order_on(pool, reqs[2], expect, survivors,
+                         timeout=40.0) is not None, \
+            f"seed {seed}: pool stalled after node removal ({preset})"
+    elif rung == 2:
+        # REMOVE THE PRIMARY (demotion mid-load) under lossy_wan: the
+        # pool must complete a FORCED view change and order new writes
+        # within the rung deadline
+        primary = pool.nodes["Alpha"].master_replica.data.primary_name
+        view0 = pool.nodes["Alpha"].master_replica.view_no
+        pool.submit(signed_node_services(pool.trustee, primary, [],
+                                         req_id),
+                    to=validators)
+        survivors = [n for n in validators if n != primary]
+        expect = len(_domain_txns(pool.nodes["Alpha"])) + 1
+        took = _order_on(pool, reqs[2], expect, survivors, timeout=50.0)
+        assert took is not None, \
+            f"seed {seed}: no ordering after primary demotion (lossy_wan)"
+        for n in survivors:
+            node = pool.nodes[n]
+            assert primary not in node.validators, \
+                f"seed {seed}: {n} kept the demoted primary"
+            assert node.master_replica.view_no > view0, \
+                f"seed {seed}: {n} never completed the forced view change"
+    else:
+        # BLS KEY ROTATION: ledger key rotates, the node's signer stays
+        # stale (its commits must be rejected WITHOUT poisoning the
+        # batch check), then the operator re-keys and the node rejoins
+        # aggregates
+        primary = pool.nodes["Alpha"].master_replica.data.primary_name
+        victim = [n for n in validators if n != primary][rng.integer(0, 3)]
+        old_pk = BlsCryptoSigner(
+            seed=victim.encode().ljust(32, b"\0")[:32]).pk
+        new_signer = BlsCryptoSigner(
+            seed=(b"mc-rot%d-%s" % (seed, victim.encode()))
+            .ljust(32, b"\0")[:32])
+        req = Request(pool.trustee.identifier, req_id,
+                      {"type": txn_lib.NODE, "dest": f"{victim}Dest",
+                       "data": {"blskey": new_signer.pk,
+                                "blskey_pop": new_signer.generate_pop()}})
+        req.signature = pool.trustee.sign_b58(req.signing_bytes())
+        pool.submit(req, to=validators)
+        elapsed = 0.0      # NODE txns land on the POOL ledger: wait on
+        while elapsed < 30.0:   # the registry, not the domain size
+            pool.run(0.5)
+            elapsed += 0.5
+            if all(pool.nodes[n].pool_manager.bls_key_of(victim)
+                   == new_signer.pk for n in validators):
+                break
+        else:
+            raise AssertionError(
+                f"seed {seed}: rotation txn never committed")
+        # stale window: the pool keeps ordering, aggregates EXCLUDE the
+        # stale signer, no view change storms
+        expect = len(_domain_txns(pool.nodes["Alpha"])) + 1
+        assert _order_on(pool, reqs[2], expect, validators,
+                         timeout=40.0) is not None, \
+            f"seed {seed}: pool stalled during stale-key window"
+        for n in validators:
+            node = pool.nodes[n]
+            assert node.pool_manager.bls_key_of(victim) == new_signer.pk
+            assert old_pk not in \
+                node.replicas.master.bls._verifier._vk_cache, \
+                f"seed {seed}: {n} kept the rotated-out key warm"
+            if n != victim:
+                recent = list(node.replicas.master.bls
+                              ._recent_multi_sigs.values())
+                assert recent and victim not in recent[-1].participants, \
+                    f"seed {seed}: stale-key sig counted at {n}"
+        # recovery: re-key, fresh aggregates include the victim again
+        pool.nodes[victim].replicas.master.bls._signer = new_signer
+        expect += 1
+        assert _order_on(pool, reqs[3], expect, validators,
+                         timeout=40.0) is not None, \
+            f"seed {seed}: pool stalled after re-key"
+        recent = list(pool.nodes["Alpha"].replicas.master.bls
+                      ._recent_multi_sigs.values())
+        assert any(victim in m.participants for m in recent[-2:]), \
+            f"seed {seed}: re-keyed node never rejoined aggregates"
+
+    if faulted_plane is not None:
+        from plenum_tpu.parallel.supervisor import CLOSED
+        sup, faulty = faulted_plane
+        st = sup.supervisor_stats()
+        assert st["fallback_batches"] >= 1, \
+            f"seed {seed}: churn under crypto fault never took CPU fallback"
+        faulty.heal()
+        waited = 0.0
+        while sup.breaker.state != CLOSED and waited < 30.0:
+            pool.run(1.0)
+            waited += 1.0
+            sup.verify_batch([(b"mc-heal-%d-%f" % (seed, waited),
+                               b"\0" * 64, b"\0" * 32)])
+        assert sup.breaker.state == CLOSED, \
+            f"seed {seed}: breaker never re-closed after churn+fault"
+        assert sup.stats["verdict_forks"] == 0
+    assert_safety(pool)
+
+
+def run_membership_churn_with_device_flap(seed: int) -> None:
+    """membership_churn composed with device_flap: the shared supervised
+    crypto plane is faulted before the churn event, so the whole churn —
+    catchup, promotion/demotion commits, the forced view change — rides
+    hedged CPU-fallback verdicts, then the plane heals and re-admits."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.faults import FaultyVerifier
+    from plenum_tpu.parallel.supervisor import (CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    rng = SimRandom(seed * 86028121 + 5)
+    faulty = FaultyVerifier(CpuEd25519Verifier())
+    sup = SupervisedVerifier(
+        faulty, fallback=CpuEd25519Verifier(),
+        breaker=CircuitBreaker(fail_threshold=2,
+                               cooldown=rng.float(0.5, 1.5)),
+        budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
+                              warm_max=1.0, cold_max=1.0))
+    run_membership_churn_scenario(seed, faulted_plane=(sup, faulty))
+
+
+def run_membership_churn_with_client_flood(seed: int) -> None:
+    """membership_churn composed with client_flood: hot clients burst
+    through per-node ingress planes while the CURRENT PRIMARY is demoted
+    — the forced view change completes, the honest steady client's write
+    still orders, and every over-cap burst write is shed EXPLICITLY."""
+    from plenum_tpu.client.sim_clients import burst_writes
+    from plenum_tpu.common.node_messages import LoadShed
+    from plenum_tpu.ingress import IngressPlane
+    from plenum_tpu.network import make_topology
+    from test_scale import signed_node_services
+
+    rng = SimRandom(seed * 49979687 + 3)
+    cap = rng.integer(2, 5)
+    config = Config(**FAST, INGRESS_CLIENT_QUEUE_CAP=cap,
+                    INGRESS_SLO_P95=0.3, INGRESS_CONTROL_INTERVAL=0.5)
+    # five validators: demoting the primary leaves n=4 (f=1) — see the
+    # base scenario's note on why removal at n=4 would measure luck
+    pool = _track(Pool(names=CHURN_NAMES, seed=seed, config=config))
+    pool.net.set_topology(make_topology("lossy_wan", pool.names))
+    ingress = {n: IngressPlane(pool.nodes[n]) for n in pool.names}
+
+    users = [Ed25519Signer(seed=(b"mcf%d-%d" % (seed, i))
+                           .ljust(32, b"\0")[:32]) for i in range(2)]
+    honest = [signed_nym(pool.trustee, u, i + 1)
+              for i, u in enumerate(users)]
+    pre = _ingress_order_and_time(pool, ingress, honest[0], 2,
+                                  timeout=30.0)
+    assert pre is not None, f"seed {seed}: healthy flood pool stalled"
+
+    # flood + primary demotion land together
+    n_hot = rng.integer(6, 16)
+    per_client = cap + rng.integer(3, 6)
+    burst = burst_writes(pool.trustee, n_hot, per_client, seed=seed)
+    for client, req in burst:
+        for n in pool.names:
+            ingress[n].submit(req.to_dict(), client)
+    primary = pool.nodes["Alpha"].master_replica.data.primary_name
+    view0 = pool.nodes["Alpha"].master_replica.view_no
+    pool.submit(signed_node_services(pool.trustee, primary, [], 400))
+    during = _ingress_order_and_time(
+        pool, ingress, honest[1],
+        len(_domain_txns(pool.nodes[pool.names[0]])) + 1, timeout=60.0)
+    assert during is not None, \
+        f"seed {seed}: honest client starved during flood+demotion"
+    survivors = [n for n in pool.names if n != primary]
+    for n in survivors:
+        assert pool.nodes[n].master_replica.view_no > view0, \
+            f"seed {seed}: {n} never view-changed under flood"
+        sheds = [m for m, _ in pool.client_msgs[n]
+                 if isinstance(m, LoadShed)]
+        assert len(sheds) >= n_hot * (per_client - cap), \
+            f"seed {seed}: sheds silent at {n}"
+    assert_safety(pool)
+
+
+MEMBERSHIP_CHURN_SEEDS = 20
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_membership_churn_fuzz(bucket):
+    for seed in range(bucket * 5, (bucket + 1) * 5):
+        _run_with_artifacts(run_membership_churn_scenario, seed)
+
+
+def test_sim_membership_churn_smoke():
+    """Two rungs always run in the default suite: the acceptance rung —
+    the CURRENT PRIMARY demoted under lossy_wan, forced view change
+    completing within deadline — and the key-rotation rung (stale-key
+    commits rejected, then recovery)."""
+    _run_with_artifacts(
+        lambda seed: run_membership_churn_scenario(seed, force_rung=2), 1)
+    _run_with_artifacts(
+        lambda seed: run_membership_churn_scenario(seed, force_rung=3), 2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(2))
+def test_sim_membership_churn_device_flap_fuzz(bucket):
+    for seed in range(bucket * 3, (bucket + 1) * 3):
+        _run_with_artifacts(run_membership_churn_with_device_flap, seed)
+
+
+def test_sim_membership_churn_device_flap_smoke():
+    _run_with_artifacts(run_membership_churn_with_device_flap, 2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(2))
+def test_sim_membership_churn_client_flood_fuzz(bucket):
+    for seed in range(bucket * 2, (bucket + 1) * 2):
+        _run_with_artifacts(run_membership_churn_with_client_flood, seed)
+
+
+def test_sim_membership_churn_client_flood_smoke():
+    _run_with_artifacts(run_membership_churn_with_client_flood, 1)

@@ -110,3 +110,103 @@ def test_multihost_api_single_process():
     garr = shard_host_batch(mesh, arr, P(("inst", "sig"), None))
     assert garr.shape == (8, 4)
     np.testing.assert_array_equal(np.asarray(garr), arr)
+
+
+# --- topology-aware fault model ---------------------------------------------
+# LinkProfile/Topology/make_topology: per-link latency+jitter+loss+bandwidth,
+# all drawn through the fabric's SimRandom so profiled runs stay replayable.
+
+from plenum_tpu.network import LinkProfile, Topology, make_topology
+
+
+def _timed_pool(n=4, seed=7, topology=None):
+    timer = MockTimer()
+    net = SimNetwork(timer, SimRandom(seed), topology=topology)
+    arrivals = {}
+    for i in range(n):
+        name = f"N{i}"
+        bus = net.create_peer(name)
+        arrivals[name] = []
+        bus.subscribe(Checkpoint,
+                      lambda m, frm, box=arrivals[name], t=timer:
+                      box.append((t.get_current_time(), m, frm)))
+    net.connect_all()
+    return timer, net, arrivals
+
+
+def test_topology_regions_shape_latency():
+    """geo3: same-region delivery is millisecond-scale, cross-region pays
+    the inter-region propagation delay."""
+    topo = make_topology("geo3", ["N0", "N1", "N2", "N3"])
+    # round-robin assignment: N0->geo0, N1->geo1, N2->geo2, N3->geo0
+    assert topo.region_of("N0") == topo.region_of("N3") == "geo0"
+    assert topo.region_of("N1") == "geo1"
+    timer, net, arrivals = _timed_pool(topology=topo)
+    net._peers["N0"].send(_chk(), dst=["N3"])       # intra-region
+    net._peers["N0"].send(_chk(), dst=["N1"])       # cross-region
+    timer.run_to_completion()
+    t_intra = arrivals["N3"][0][0]
+    t_inter = arrivals["N1"][0][0]
+    assert t_intra < 0.01, t_intra
+    assert t_inter >= 0.04, t_inter                 # >= base inter delay
+
+
+def test_lossy_wan_drops_are_counted_and_seeded():
+    """lossy_wan drops a seeded fraction cross-region and counts every
+    loss; the same seed reproduces the identical loss pattern."""
+    traces = []
+    for _ in range(2):
+        topo = make_topology("lossy_wan", ["N0", "N1"], n_regions=2)
+        timer, net, arrivals = _timed_pool(n=2, seed=99, topology=topo)
+        for k in range(200):
+            net._peers["N0"].send(_chk(end=k), dst=["N1"])
+        timer.run_to_completion()
+        got = [m.seq_no_end for (_, m, _) in arrivals["N1"]]
+        assert net.lost_count > 0
+        assert len(got) + net.lost_count == 200
+        traces.append((net.lost_count, sorted(got)))
+    assert traces[0] == traces[1]
+
+
+def test_bandwidth_cap_spreads_bursts():
+    """A burst over a thin link serializes: the last frame's arrival
+    reflects queueing behind the burst, not one flat propagation delay."""
+    thin = LinkProfile(base_delay=0.01, jitter=0.0, loss=0.0,
+                      bandwidth=10_000.0)          # 10 kB/s
+    topo = Topology(["a", "b"], links={("a", "b"): thin,
+                                       ("b", "a"): thin})
+    topo.assign("N0", "a")
+    topo.assign("N1", "b")
+    timer, net, arrivals = _timed_pool(n=2, topology=topo)
+    for k in range(20):
+        net._peers["N0"].send(_chk(end=k), dst=["N1"])
+    timer.run_to_completion()
+    times = [t for (t, _, _) in arrivals["N1"]]
+    assert len(times) == 20
+    size = net.tx_msgs["CHECKPOINT"][1] / 20        # bytes per message
+    expect_last = 0.01 + 20 * size / 10_000.0
+    assert max(times) >= expect_last * 0.9
+    # and the spread is real: first arrival well before the last
+    assert min(times) < max(times) / 2
+
+
+def test_explicit_rules_override_topology():
+    """Scenario faults compose ON TOP of the WAN profile: a Deliver rule
+    still pins its own delay, a Discard still kills the message."""
+    topo = make_topology("geo3", [f"N{i}" for i in range(4)])
+    timer, net, arrivals = _timed_pool(topology=topo)
+    net.add_rule(Deliver(5.0, 5.0), match_dst("N1"))
+    net.add_rule(Discard(), match_dst("N2"))
+    net._peers["N0"].send(_chk())
+    timer.run_to_completion()
+    assert arrivals["N1"][0][0] >= 5.0
+    assert arrivals["N2"] == []
+
+
+def test_topology_assigns_churned_peers_deterministically():
+    """A peer created after construction (membership churn: a joiner) is
+    auto-assigned round-robin — same join order, same placement."""
+    topo = make_topology("geo3", ["N0", "N1", "N2"])
+    first = topo.region_of("Joiner")
+    topo2 = make_topology("geo3", ["N0", "N1", "N2"])
+    assert topo2.region_of("Joiner") == first
