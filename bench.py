@@ -371,6 +371,33 @@ def main():
                   "read_fanout", "overload_ab"):
             if c7.get(k) is not None:
                 result[f"config7_{k}"] = c7[k]
+        # horizontal sharding acceptance (docs/sharding.md): 2- and
+        # 4-shard fabrics vs the matched-node-count single pool —
+        # aggregate/per-shard write TPS, the >=1.6x speedup gate, and
+        # the composed cross-shard verification p50/p95
+        c10 = bc.config10_shards(n_txns=120)
+        if "error" in c10:
+            result["config10_shards"] = c10["error"]
+        else:
+            result["config10_shards"] = {
+                "speedup_2x4": c10.get("speedup_2x4"),
+                "speedup_4x2": c10.get("speedup_4x2"),
+                "single_8_tps": c10["single_8"].get("aggregate_tps"),
+                "sharded_2x4_tps":
+                    c10["sharded_2x4"].get("aggregate_tps"),
+                "sharded_2x4_per_shard":
+                    c10["sharded_2x4"].get("per_shard_tps"),
+                "sharded_4x2_tps":
+                    c10["sharded_4x2"].get("aggregate_tps"),
+                "cross_verify_ms_p50":
+                    c10["sharded_2x4"].get("cross_verify_ms_p50"),
+                "cross_verify_ms_p95":
+                    c10["sharded_2x4"].get("cross_verify_ms_p95"),
+                "cross_shard_reads_served":
+                    c10["sharded_2x4"].get("cross_shard_served"),
+                "map_proof_failures":
+                    c10["sharded_2x4"].get("map_proof_failures"),
+            }
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
     # fused-pipeline A/B on JAX-ON-CPU — published UNCONDITIONALLY: its

@@ -113,22 +113,27 @@ class PoolClient:
                     meta.get("digest"))
         core = {k: v for k, v in result.items()
                 if k not in ("identifier", "reqId", "read_proof",
-                             "state_proof", "merkle_proof")}
+                             "shard_proof", "state_proof", "merkle_proof")}
         return ("REPLY", hashlib.sha256(pack(core)).hexdigest())
 
-    async def submit(self, request: Request, timeout: float = 30.0) -> dict:
+    async def submit(self, request: Request, timeout: float = 30.0,
+                     to: Optional[list] = None) -> dict:
         """Send to all nodes; resolve when f+1 nodes agree on the outcome.
 
         Returns the agreed REPLY (or NACK/REJECT) dict. Raises TimeoutError
         if no f+1 agreement arrives in time.
+
+        to: restrict the broadcast to a node subset (a sharded pool's
+        quorum lives INSIDE the owning shard — broadcasting to foreign
+        shards could only add votes about state they don't hold).
         """
+        targets = [n for n in (to or self.node_addrs) if n in self.node_addrs]
         data = pack(request.to_dict())
         req_key = (request.identifier, request.req_id)
-        await asyncio.gather(*(self._send_one(n, data)
-                               for n in self.node_addrs))
+        await asyncio.gather(*(self._send_one(n, data) for n in targets))
         results = await asyncio.gather(*(
             self._read_until_reply(n, req_key, timeout)
-            for n in self.node_addrs))
+            for n in targets))
         votes: dict[Any, tuple[int, dict]] = {}
         for msg in results:
             if msg is None:

@@ -98,6 +98,18 @@ class MetricsName:
     INGRESS_CTL_ADMIT = "ingress_ctl.admit_max"
     INGRESS_CTL_WATERMARK = "ingress_ctl.watermark"
     INGRESS_CTL_DECISIONS = "ingress_ctl.decisions"
+    # sharding plane (shards/): router decisions + per-shard ordering
+    # volume (value = shard's newly ordered since the last snapshot, so
+    # fold sum = total ordered), the cross-shard read counters,
+    # mapping-proof failure verdicts, and the client-side cross-shard
+    # verify timer (sampled -> p50/p95 in the report)
+    SHARD_ROUTED = "shards.routed"
+    SHARD_UNROUTABLE = "shards.unroutable"
+    SHARD_ORDERED_BATCHES = "shards.ordered_batches"
+    SHARD_CROSS_READS = "shards.cross_reads"
+    SHARD_CROSS_READS_OK = "shards.cross_reads_ok"
+    SHARD_MAP_PROOF_FAILURES = "shards.map_proof_failures"
+    SHARD_CROSS_VERIFY_TIME = "shards.cross_verify_time"
     # observer read fan-out (ingress/observer_reads.py)
     OBSERVER_PUSHES = "observer.pushes"
     OBSERVER_MS_ADOPTED = "observer.ms_adopted"
@@ -285,6 +297,7 @@ SAMPLED_NAMES = frozenset({
     MetricsName.BLS_PAIRINGS_PER_BATCH,
     MetricsName.CRYPTO_DISPATCH_BUDGET,
     MetricsName.READ_PROOF_GEN_TIME,
+    MetricsName.SHARD_CROSS_VERIFY_TIME,
     MetricsName.INGRESS_QUEUE_WAIT, MetricsName.INGRESS_QUEUE_DEPTH,
     MetricsName.INGRESS_AUTH_BATCH,
     MetricsName.VC_DURATION, MetricsName.CATCHUP_DURATION,

@@ -80,6 +80,13 @@ READ_BATCH = "read_batch"            # read plane served a tick's queries
 # trace_report renders these as the `device` waterfall stage
 DEVICE = "device"
 DEVICE_CONTROLLER = "device_controller"  # pipeline-controller decision
+# sharding plane (shards/): every shard-attributed span carries a
+# `shard` tag in its data dict, and shard-hosted node dumps carry a
+# top-level `shard` tag (Tracer(tags=...)) so trace_report can group a
+# fabric's waterfalls per shard and attribute cross-shard hops
+SHARD_ROUTE = "shard_route"          # router decision (data: shard, kind)
+CROSS_SHARD = "cross_shard_read"     # verified cross-shard read resolved
+#                                      (data: shard, ok, dur, reason)
 
 ANOMALY_PREFIX = "anomaly."
 
@@ -128,8 +135,12 @@ class Tracer(NullTracer):
                  clock_domain: str = "shared",
                  wall: Optional[Callable[[], float]] = None,
                  min_dump_interval: float = 5.0,
-                 wall_durations: bool = True):
+                 wall_durations: bool = True,
+                 tags: Optional[dict] = None):
         self.node = node
+        # free-form dump tags (e.g. {"shard": 0}); assembly-side grouping
+        # only — individual events stay tag-free so hot-path cost is flat
+        self.tags = dict(tags) if tags else None
         self._now = now
         self.ring: deque = deque(maxlen=ring_size)
         self.dump_dir = dump_dir
@@ -165,6 +176,7 @@ class Tracer(NullTracer):
         needs. Events are JSON-ready lists; the ring itself is untouched."""
         return {
             "node": self.node,
+            **({"tags": self.tags} if self.tags else {}),
             "clock_domain": self.clock_domain,
             "mono_anchor": self.mono_anchor,
             "wall_anchor": self.wall_anchor,

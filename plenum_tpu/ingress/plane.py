@@ -53,13 +53,21 @@ class IngressPlane:
     MAX_AUTH_POLLS = 50
 
     def __init__(self, node, config=None, tracer=None, metrics=None,
-                 send=None, tick: bool = True):
+                 send=None, tick: bool = True, sink=None):
         self.node = node
         self.config = config or node.config
         self.timer = node.timer
         self.tracer = tracer if tracer is not None else node.tracer
         self.metrics = metrics if metrics is not None else node.metrics
         self._send = send or node._client_send
+        # where verified writes go. Default: this node's own pipeline
+        # (submit_preverified, resolved late so the node attribute stays
+        # swappable). A sharded deployment hands a ShardRouter route
+        # here instead — admission + the batched auth dispatch happen
+        # ONCE at this front door, then the write is fanned to whichever
+        # sub-pool owns its key (shards/router.py)
+        self._sink = sink if sink is not None else (
+            lambda req, frm: self.node.submit_preverified(req, frm))
 
         # client -> deque[(Request, frm, enqueue_ts)]; rotation holds each
         # ACTIVE client once, weights grant >1 dequeues per rotation pass
@@ -300,7 +308,7 @@ class IngressPlane:
             for req, frm in group:
                 if ok:
                     ok_n += 1
-                    self.node.submit_preverified(req, frm)
+                    self._sink(req, frm)
                 else:
                     fail_n += 1
                     self.stats["auth_fail"] += 1

@@ -129,12 +129,26 @@ class VerifyingReadClient(PoolClient):
                  bls_keys: Mapping[str, str],
                  freshness_s: float = proofs.DEFAULT_FRESHNESS_S,
                  now: Optional[Callable[[], float]] = None,
-                 observer_addrs: Optional[dict] = None):
+                 observer_addrs: Optional[dict] = None,
+                 checker=None,
+                 shard_resolver: Optional[Callable[[Request],
+                                                   Optional[Sequence[str]]]]
+                 = None):
         super().__init__(node_addrs, f)
         self.observer_addrs = dict(observer_addrs or {})
         self._all_addrs = {**self.observer_addrs, **self.node_addrs}
-        self.checker = ReadCheck(bls_keys, freshness_s=freshness_s,
-                                 now=now, n_nodes=len(node_addrs))
+        # checker: injectable verification core — the sharded plane's
+        # CrossShardReadCheck (mapping-ownership proof + the OWNING
+        # shard's BLS keys) rides the same ladder as the flat ReadCheck
+        self.checker = checker if checker is not None else ReadCheck(
+            bls_keys, freshness_s=freshness_s, now=now,
+            n_nodes=len(node_addrs))
+        # shard_resolver(request) -> the owning shard's node names (or
+        # None: flat pool). The failover ladder AND the escalation
+        # broadcast stay inside the owning shard: a foreign shard's
+        # nodes don't hold the key and a "verified" answer from one
+        # (absence against ITS root) would be a wrong-shard lie
+        self.shard_resolver = shard_resolver
         self.stats = self.checker.stats
 
     def _addr_of(self, name: str) -> tuple:
@@ -149,8 +163,16 @@ class VerifyingReadClient(PoolClient):
         self.stats.reads += 1
         data = pack(request.to_dict())
         req_key = (request.identifier, request.req_id)
-        ladder = (ladder_order(list(self.observer_addrs), request)
-                  + ladder_order(list(self.node_addrs), request))
+        shard_nodes = self.shard_resolver(request) \
+            if self.shard_resolver is not None else None
+        if shard_nodes is not None:
+            # owning-shard ladder: fail over WITHIN the shard first; the
+            # observer tier is skipped (observers anchor one flat pool)
+            shard_nodes = [n for n in shard_nodes if n in self.node_addrs]
+            ladder = ladder_order(shard_nodes, request)
+        else:
+            ladder = (ladder_order(list(self.observer_addrs), request)
+                      + ladder_order(list(self.node_addrs), request))
         for rung, name in enumerate(ladder):
             if rung:
                 self.stats.failovers += 1
@@ -180,11 +202,18 @@ class VerifyingReadClient(PoolClient):
         # escalation: the legacy f+1 matching-reply broadcast — reached
         # when the pool cannot anchor proofs yet or every proof-bearing
         # rung lied/timed out; either way the quorum path stays sound
-        # (f+1 CONTENT-matching replies)
+        # (f+1 CONTENT-matching replies). A sharded read broadcasts to
+        # the OWNING shard only — its quorum lives there
         self.stats.fallbacks += 1
-        msg = await self.submit(request, timeout)
-        self.stats.msgs_sent += len(self.node_addrs)
-        self.stats.replies_seen += len(self.node_addrs)
+        if shard_nodes is not None and not shard_nodes:
+            # the owning shard is known but none of its nodes are
+            # dialable: broadcasting to FOREIGN nodes could only "agree"
+            # on absence against the wrong root — fail closed instead
+            raise TimeoutError("no reachable node of the owning shard")
+        targets = list(shard_nodes) if shard_nodes else list(self.node_addrs)
+        msg = await self.submit(request, timeout, to=targets)
+        self.stats.msgs_sent += len(targets)
+        self.stats.replies_seen += len(targets)
         return msg
 
 
@@ -204,7 +233,11 @@ class SimReadDriver:
                  bls_keys: Mapping[str, str],
                  freshness_s: float = proofs.DEFAULT_FRESHNESS_S,
                  now: Optional[Callable[[], float]] = None,
-                 observer_names: Optional[Sequence[str]] = None):
+                 observer_names: Optional[Sequence[str]] = None,
+                 checker=None,
+                 shard_resolver: Optional[Callable[[Request],
+                                                   Optional[Sequence[str]]]]
+                 = None):
         self._submit = submit
         self._collect = collect
         self._pump = pump
@@ -212,8 +245,12 @@ class SimReadDriver:
         # observer tier, tried BEFORE validators (same escalation rules
         # as VerifyingReadClient: observer proofless -> next rung)
         self.observer_names = list(observer_names or [])
-        self.checker = ReadCheck(bls_keys, freshness_s=freshness_s,
-                                 now=now, n_nodes=len(node_names))
+        # injectable verification core + owning-shard ladder, exactly as
+        # on VerifyingReadClient (the TCP twin documents the contract)
+        self.checker = checker if checker is not None else ReadCheck(
+            bls_keys, freshness_s=freshness_s, now=now,
+            n_nodes=len(node_names))
+        self.shard_resolver = shard_resolver
         self.stats = self.checker.stats
 
     def read(self, request: Request, per_node_s: float = 1.0,
@@ -223,8 +260,19 @@ class SimReadDriver:
         (caller escalates to its own broadcast path)."""
         self.stats.reads += 1
         if order is None:
-            order = (ladder_order(self.observer_names, request)
-                     + ladder_order(self.node_names, request))
+            shard_nodes = self.shard_resolver(request) \
+                if self.shard_resolver is not None else None
+            if shard_nodes is not None:
+                # fail over within the owning shard before anything
+                # else; an owning shard with NO reachable node leaves
+                # the ladder empty -> the read fails closed (None),
+                # never consults a foreign shard
+                order = ladder_order(
+                    [n for n in shard_nodes if n in self.node_names],
+                    request)
+            else:
+                order = (ladder_order(self.observer_names, request)
+                         + ladder_order(self.node_names, request))
         observers = set(self.observer_names)
         for rung, name in enumerate(order):
             if rung:

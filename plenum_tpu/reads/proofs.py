@@ -56,8 +56,11 @@ KIND_MERKLE = "merkle"
 DEFAULT_FRESHNESS_S = 900.0
 
 # fields of a result that are per-request, not per-content: excluded from
-# result_digest so one cached core result serves every asker
-_PER_REQUEST_FIELDS = ("identifier", "reqId", READ_PROOF)
+# result_digest so one cached core result serves every asker.
+# "shard_proof" (shards/mapping.py) is attached AFTER the node computed
+# the digest — a mapping-ownership attachment inside the digest would
+# unbind every envelope the moment a shard gate decorates the reply
+_PER_REQUEST_FIELDS = ("identifier", "reqId", READ_PROOF, "shard_proof")
 
 
 def result_core(result: Mapping) -> dict:

@@ -1,0 +1,368 @@
+"""N sharded sub-pools in one process, on one shared seeded timer.
+
+Each shard is a full RBFT ordering instance — its own node set, its own
+SimNetwork fabric (so partitions/WAN faults can be confined to one
+shard), its own genesis and domain ledger/state trie — all driven by the
+ONE timer, so fuzz scenarios compose per-shard and across shards and a
+whole multi-shard run replays from its seed. The fabric owns:
+
+- the **mapping ledger** (mapping.py) and the directory committee that
+  signs it;
+- the **ShardRouter** behind the ingress seam (router.py): writes pay
+  admission + ONE batched auth at an entry front door, then fan to the
+  owning shard's `submit_preverified`; raw bench submission routes to
+  the owning shard's client inboxes instead (every shard node pays its
+  own auth — the load shape the single-pool baseline pays too);
+- per-shard **read gates**: a read reply leaving a shard is decorated
+  with the mapping-ownership proof (`shard_proof`) exactly as the
+  shard's nodes would attach it — the seam the cross-shard fuzz rungs
+  wrap to serve forged/stale maps;
+- an optional SHARED CryptoPipeline (parallel/pipeline.py): co-hosted
+  shards feed one submission ring, so auth/commit/Merkle batching
+  amortizes across shard boundaries exactly as it does across co-hosted
+  nodes of one pool.
+
+Timer model: pass a MockTimer for deterministic sim-time runs
+(`run(seconds)` advances it) or a QueueTimer over perf_counter for
+real-time benches (`run` then spins the wall clock).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from plenum_tpu.common.metrics import MetricsCollector, MetricsName
+from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID, Reply
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.timer import MockTimer
+from plenum_tpu.common.tracing import Tracer
+
+from . import mapping as mapping_lib
+from .mapping import MappingLedger, ShardDescriptor, equal_ranges
+from .read_client import CrossShardReadCheck, ShardMapView
+from .router import ShardRouter
+
+DIRECTORY_NAMES = ("Dir1", "Dir2", "Dir3", "Dir4")
+
+
+def shard_node_names(shard_id: int, n_nodes: int) -> list[str]:
+    return [f"S{shard_id}N{i + 1}" for i in range(n_nodes)]
+
+
+class SimShard:
+    """One sub-pool: nodes over an own SimNetwork on the shared timer."""
+
+    def __init__(self, shard_id: int, names: Sequence[str], timer, seed: int,
+                 config, pipeline=None, tracing: bool = False,
+                 verifier=None):
+        from plenum_tpu.network import SimNetwork, SimRandom
+        from plenum_tpu.node import Node, NodeBootstrap
+        from plenum_tpu.tools.local_pool import build_genesis
+
+        self.shard_id = shard_id
+        self.names = list(names)
+        self.timer = timer
+        self.net = SimNetwork(timer, SimRandom(seed))
+        self.genesis, self.trustee = build_genesis(self.names)
+        self.client_msgs: dict[str, list] = {n: [] for n in self.names}
+        self.nodes: dict = {}
+        for name in self.names:
+            bus = self.net.create_peer(name)
+            components = NodeBootstrap(
+                name, genesis_txns=self.genesis,
+                crypto_backend=config.crypto_backend,
+                verifier=verifier,
+                pipeline=pipeline).build()
+            tracer = Tracer(name, timer.get_current_time,
+                            clock_domain="shared",
+                            tags={"shard": shard_id}) if tracing else None
+            self.nodes[name] = Node(
+                name, timer, bus, components,
+                client_send=lambda msg, client, n=name:
+                    self.client_msgs[n].append((msg, client)),
+                config=config, tracer=tracer)
+        self.net.connect_all()
+
+    def prod(self) -> None:
+        for node in self.nodes.values():
+            node.prod()
+
+    def submit(self, request: Request, client: str = "cli",
+               to: Optional[Sequence[str]] = None) -> None:
+        for name in (to or self.names):
+            self.nodes[name].handle_client_message(request.to_dict(), client)
+
+    def replies(self, name: str, msg_type=Reply) -> list:
+        return [m for m, _ in self.client_msgs[name]
+                if isinstance(m, msg_type)]
+
+    def domain_sizes(self) -> set[int]:
+        return {node.c.db.get_ledger(DOMAIN_LEDGER_ID).size
+                for node in self.nodes.values()}
+
+    def ordered_count(self) -> int:
+        """Txns ordered beyond genesis, by the shard's first node."""
+        node = self.nodes[self.names[0]]
+        return node.c.db.get_ledger(DOMAIN_LEDGER_ID).size - 1
+
+
+class ShardReadGate:
+    """Server-side decoration seam: attach the shard's mapping-ownership
+    proof to every read reply leaving this shard — the in-process twin
+    of a shard node consulting its local mapping-ledger copy. Fuzz rungs
+    subclass/wrap `decorate` to serve forged or stale maps."""
+
+    def __init__(self, mapping: MappingLedger):
+        self.mapping = mapping
+
+    def decorate(self, result: dict, key: bytes) -> dict:
+        try:
+            result[mapping_lib.SHARD_PROOF] = \
+                self.mapping.ownership_proof(key)
+        except Exception:
+            pass            # unroutable key: ship undominated, client
+            #                 fails closed on the missing proof
+        return result
+
+
+class ShardedSimFabric:
+    def __init__(self, n_shards: int = 2, nodes_per_shard: int = 4,
+                 seed: int = 1, config=None, timer=None,
+                 share_pipeline: bool = False, tracing: bool = False,
+                 latency: Optional[tuple[float, float]] = None,
+                 shard_verifiers: Optional[dict] = None):
+        from plenum_tpu.config import Config
+
+        self.timer = timer if timer is not None else MockTimer()
+        self.config = config or Config(Max3PCBatchWait=0.05)
+        self.metrics = MetricsCollector()
+        self.pipeline = None
+        if share_pipeline:
+            # ONE submission ring for every co-hosted shard: client-auth
+            # Ed25519, BLS batch checks, and Merkle hashing coalesce and
+            # dedup ACROSS shard boundaries (PR 8's pipeline, wider)
+            from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+            from plenum_tpu.parallel.pipeline import CryptoPipeline
+            self.pipeline = CryptoPipeline(ed_inner=CpuEd25519Verifier(),
+                                           config=self.config)
+        self.shards: dict[int, SimShard] = {}
+        for sid in range(n_shards):
+            # shard_verifiers: {sid: shared crypto plane} — the seam the
+            # shard-confined device_flap fuzz faults ONE shard through
+            shard = SimShard(sid, shard_node_names(sid, nodes_per_shard),
+                             self.timer, seed * 1009 + sid * 7919 + 3,
+                             self.config, pipeline=self.pipeline,
+                             tracing=tracing,
+                             verifier=(shard_verifiers or {}).get(sid))
+            if latency is not None:
+                shard.net.set_latency(*latency)
+            self.shards[sid] = shard
+        self.trustee = self.shards[0].trustee    # one trustee, all shards
+        self.node_shard = {n: sid for sid, s in self.shards.items()
+                           for n in s.names}
+
+        # the provable map: equal static key ranges, directory-signed
+        from plenum_tpu.tools.local_pool import pool_bls_keys
+        self.directory = mapping_lib.directory_bls_signers(DIRECTORY_NAMES)
+        descriptors = []
+        for sid, (lo, hi) in enumerate(equal_ranges(n_shards)):
+            names = self.shards[sid].names
+            descriptors.append(ShardDescriptor(
+                sid, lo, hi, names, pool_bls_keys(names), epoch=0))
+        self.mapping = MappingLedger(descriptors, self.directory,
+                                     now=self.timer.get_current_time)
+        self.gates: dict[int, ShardReadGate] = {
+            sid: ShardReadGate(self.mapping) for sid in self.shards}
+
+        self.fabric_tracer = Tracer(
+            "fabric", self.timer.get_current_time,
+            clock_domain="shared") if tracing else None
+        # raw router (bench/sim writes -> owning shard's client inboxes;
+        # every shard node pays its own auth, like the flat baseline) and
+        # the behind-ingress router (one front-door auth -> fan to the
+        # owning shard's submit_preverified seam)
+        self.router = ShardRouter(
+            self.mapping,
+            {sid: self._raw_sink(sid) for sid in self.shards},
+            metrics=self.metrics, tracer=self.fabric_tracer)
+        self.ingress_router = ShardRouter(
+            self.mapping,
+            {sid: self._preverified_sink(sid) for sid in self.shards},
+            metrics=self.metrics, tracer=self.fabric_tracer)
+        # reply key -> routing key, so read gates know what to prove
+        # (re-registered per ladder rung, popped as each reply drains)
+        self._pending_keys: dict[tuple, bytes] = {}
+        self._ordered_emitted: dict[int, int] = {}
+
+    @property
+    def nodes(self) -> dict:
+        """Flat {name: node} over every shard — the shape the fuzz
+        harness's flight-artifact dumper walks."""
+        return {n: s.nodes[n] for s in self.shards.values()
+                for n in s.nodes}
+
+    # --- sinks ------------------------------------------------------------
+
+    def _raw_sink(self, sid: int):
+        def sink(request: Request, frm: str) -> None:
+            self.shards[sid].submit(request, client=frm)
+        return sink
+
+    def _preverified_sink(self, sid: int):
+        def sink(request: Request, frm: str) -> None:
+            for name in self.shards[sid].names:
+                self.shards[sid].nodes[name].submit_preverified(request, frm)
+        return sink
+
+    def ingress_plane(self, entry_node: str, **kw):
+        """An entry front door whose verified writes route ACROSS shards
+        instead of into the entry node's own pipeline."""
+        from plenum_tpu.common.node_messages import RequestNack
+        from plenum_tpu.ingress import IngressPlane
+        node = self.shards[self.node_shard[entry_node]].nodes[entry_node]
+
+        def sink(request: Request, frm: str) -> None:
+            # an admitted, auth-verified write the map cannot place
+            # NACKs through the front door, never black-holes — the
+            # client must not wait out its reply timeout (router.py)
+            if self.ingress_router.route(request, frm) is None:
+                node._client_send(RequestNack(
+                    identifier=request.identifier, req_id=request.req_id,
+                    reason="no shard owns this key"), frm)
+
+        return IngressPlane(node, sink=sink, **kw)
+
+    # --- driving ----------------------------------------------------------
+
+    def prod_all(self) -> None:
+        self.timer.service()
+        for shard in self.shards.values():
+            shard.prod()
+
+    def run(self, seconds: float = 5.0, step: float = 0.1) -> None:
+        """Sim-time drive (MockTimer). Real-time timers should loop
+        `prod_all` against the wall clock instead (bench_configs)."""
+        elapsed = 0.0
+        while elapsed < seconds:
+            for shard in self.shards.values():
+                shard.prod()
+            self.timer.advance(step)
+            elapsed += step
+
+    def submit_write(self, request: Request, frm: str = "bench"
+                     ) -> Optional[int]:
+        return self.router.route(request, frm)
+
+    def ordered_counts(self) -> dict[int, int]:
+        """-> cumulative ordered txns per shard; emits the DELTA since
+        the previous call per shard, so the metric folds stay honest
+        under repeated polling (sum = total ordered, mean = mean
+        per-shard increment per snapshot)."""
+        counts = {sid: s.ordered_count() for sid, s in self.shards.items()}
+        for sid, n in counts.items():
+            delta = n - self._ordered_emitted.get(sid, 0)
+            if delta > 0:
+                self.metrics.add_event(MetricsName.SHARD_ORDERED_BATCHES,
+                                       delta)
+            self._ordered_emitted[sid] = n
+        return counts
+
+    # --- cross-shard reads ------------------------------------------------
+
+    def map_view(self) -> ShardMapView:
+        return ShardMapView.from_ledger(self.mapping)
+
+    def read_driver(self, client: str = "xs",
+                    freshness_s: float = 1e12,
+                    map_freshness_s: float =
+                    mapping_lib.DEFAULT_MAP_FRESHNESS_S,
+                    view: Optional[ShardMapView] = None,
+                    pump=None):
+        """A shard-aware SimReadDriver: routing by the client's map view,
+        failover INSIDE the owning shard, verification by the composed
+        cross-shard check (ownership proof + shard-anchored read proof)."""
+        from plenum_tpu.reads import SimReadDriver
+
+        view = view or self.map_view()
+        checker = CrossShardReadCheck(
+            self.mapping.directory_keys, n_directory=len(self.directory),
+            freshness_s=freshness_s, map_freshness_s=map_freshness_s,
+            now=self.timer.get_current_time, min_epoch=view.min_epoch,
+            metrics=self.metrics)
+
+        def submit(name, request):
+            try:
+                key = mapping_lib.routing_key(request.operation,
+                                              request.identifier)
+                self._pending_keys[(request.identifier,
+                                    request.req_id)] = key
+            except ValueError:
+                pass
+            sid = self.node_shard[name]
+            self.shards[sid].nodes[name].handle_client_message(
+                request.to_dict(), client)
+
+        def collect(name):
+            sid = self.node_shard[name]
+            shard = self.shards[sid]
+            msgs = shard.client_msgs[name]
+            out = []
+            keep = []
+            for m, c in msgs:
+                if isinstance(m, Reply) and c == client:
+                    result = dict(m.result)
+                    key = self._pending_keys.pop(
+                        (result.get("identifier"), result.get("reqId")),
+                        None)
+                    if key is not None:
+                        result = self.gates[sid].decorate(result, key)
+                    out.append(result)
+                else:
+                    keep.append((m, c))
+            shard.client_msgs[name] = keep
+            return out
+
+        all_names = [n for s in self.shards.values() for n in s.names]
+        driver = SimReadDriver(
+            submit, collect, pump or self.run, all_names, bls_keys={},
+            now=self.timer.get_current_time, checker=checker,
+            shard_resolver=view.nodes_for)
+        tracer = self.fabric_tracer
+        if tracer is not None and tracer.enabled:
+            from plenum_tpu.common import tracing
+            inner_read = driver.read
+
+            def traced_read(request, **kw):
+                desc = view.descriptor_for(request)
+                t0 = self.timer.get_current_time()
+                res = inner_read(request, **kw)
+                tracer.emit(tracing.CROSS_SHARD, request.digest, {
+                    "shard": desc.shard_id if desc is not None else None,
+                    "ok": res is not None,
+                    "dur": self.timer.get_current_time() - t0})
+                return res
+
+            driver.read = traced_read
+        return driver
+
+    # --- reporting --------------------------------------------------------
+
+    def tracer_snapshots(self) -> list:
+        out = []
+        for shard in self.shards.values():
+            for node in shard.nodes.values():
+                if node.tracer is not None and node.tracer.enabled:
+                    out.append(node.tracer.snapshot())
+        if self.fabric_tracer is not None:
+            out.append(self.fabric_tracer.snapshot())
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "shards": len(self.shards),
+            "router": self.router.summary(),
+            "ingress_router": self.ingress_router.summary(),
+            "ordered_per_shard": {sid: s.ordered_count()
+                                  for sid, s in self.shards.items()},
+            **({"pipeline": self.pipeline.summary()}
+               if self.pipeline is not None else {}),
+        }

@@ -1,0 +1,82 @@
+"""ShardRouter: the thin layer that sends each admitted write to the
+sub-pool that owns its key.
+
+The router sits BEHIND the ingress seam (ingress/plane.py `sink=`): the
+entry node's IngressPlane does admission control, static validation, and
+ONE batched signature dispatch, and hands the verified request here
+instead of to its own node — the router resolves the owning shard from
+the mapping ledger and fans the request into that shard's ordering
+instance through the same `submit_preverified` seam the plane would
+have used locally. Auth cost is paid once at the front door regardless
+of which shard orders the write.
+
+Raw (un-ingressed) submission is also supported for benches and sims
+that drive `handle_client_message` directly; both paths share the one
+routing decision and its accounting.
+"""
+from __future__ import annotations
+
+from typing import Callable, Mapping, Optional
+
+from plenum_tpu.common import tracing
+from plenum_tpu.common.metrics import MetricsCollector, MetricsName
+from plenum_tpu.common.request import Request
+
+from .mapping import MappingLedger, routing_key
+
+
+class ShardRouter:
+    """mapping + per-shard sinks -> one routing decision per write.
+
+    sinks: {shard_id: fn(request: Request, frm: str)} — the owning
+    shard's intake (fan to every shard node's `submit_preverified` for
+    the behind-ingress path, or `handle_client_message` for raw sims).
+    """
+
+    def __init__(self, mapping: MappingLedger,
+                 sinks: Mapping[int, Callable[[Request, str], None]],
+                 metrics: Optional[MetricsCollector] = None,
+                 tracer=None,
+                 on_unroutable: Optional[Callable[[Request, str, str],
+                                                  None]] = None):
+        from plenum_tpu.common.tracing import NULL_TRACER
+        self.mapping = mapping
+        self.sinks = dict(sinks)
+        self.metrics = metrics or MetricsCollector()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.on_unroutable = on_unroutable
+        self.stats = {"routed": 0, "unroutable": 0,
+                      "per_shard": {sid: 0 for sid in self.sinks}}
+
+    def shard_of(self, request: Request) -> Optional[int]:
+        try:
+            key = routing_key(request.operation, request.identifier)
+            return self.mapping.shard_of(key).shard_id
+        except Exception:
+            return None
+
+    def route(self, request: Request, frm: str) -> Optional[int]:
+        """-> the shard id the write went to, or None (unroutable: no
+        owning shard in the map, or no sink for it — surfaced through
+        on_unroutable so the front door can NACK instead of black-hole)."""
+        sid = self.shard_of(request)
+        sink = self.sinks.get(sid) if sid is not None else None
+        if sink is None:
+            self.stats["unroutable"] += 1
+            self.metrics.add_event(MetricsName.SHARD_UNROUTABLE)
+            if self.on_unroutable is not None:
+                self.on_unroutable(request, frm, "no shard owns this key")
+            return None
+        self.stats["routed"] += 1
+        self.stats["per_shard"][sid] += 1
+        self.metrics.add_event(MetricsName.SHARD_ROUTED)
+        if self.tracer.enabled:
+            self.tracer.emit(tracing.SHARD_ROUTE, request.digest,
+                             {"shard": sid, "frm": frm})
+        sink(request, frm)
+        return sid
+
+    def summary(self) -> dict:
+        return {"routed": self.stats["routed"],
+                "unroutable": self.stats["unroutable"],
+                "per_shard": dict(self.stats["per_shard"])}

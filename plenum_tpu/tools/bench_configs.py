@@ -757,6 +757,146 @@ def config7_ingress_10k(n_clients: int = 10_000, n_ops: int = 3000,
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _sharded_arm_once(n_shards: int, nodes_per_shard: int, n_txns: int,
+                      timeout: float, n_reads: int = 60,
+                      cross_fraction: float = 0.5) -> dict:
+    """One real-time pass over a ShardedSimFabric: route `n_txns` writes
+    across the shards, then run a read mix where `cross_fraction` of the
+    reads target keys owned by a NON-home shard (home = shard 0, the
+    reader's local one) — every read composes mapping-ownership +
+    shard-anchor verification either way; the fraction only steers which
+    shard answers. n_shards=1 IS the matched-node-count baseline: the
+    identical code path (router, gates, composed verification) over one
+    ordering instance, so the A/B isolates the sharding, not the plumbing."""
+    import time as _time
+
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.common.timer import QueueTimer
+    from plenum_tpu.config import Config
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import GET_NYM, NYM
+    from plenum_tpu.shards import ShardedSimFabric
+
+    fab = ShardedSimFabric(
+        n_shards=n_shards, nodes_per_shard=nodes_per_shard,
+        timer=QueueTimer(_time.perf_counter), seed=11,
+        config=Config(Max3PCBatchWait=0.05,
+                      STATE_FRESHNESS_UPDATE_INTERVAL=600.0),
+        latency=(0.00005, 0.0002))
+
+    users = []
+    reqs = []
+    for i in range(n_txns):
+        user = Ed25519Signer(seed=(b"sh%08d" % i).ljust(32, b"\0")[:32])
+        req = Request(fab.trustee.identifier, i + 1,
+                      {"type": NYM, "dest": user.identifier,
+                       "verkey": user.verkey_b58})
+        req.signature = fab.trustee.sign_b58(req.signing_bytes())
+        users.append(user)
+        reqs.append(req)
+
+    def ordered_total():
+        return sum(s.ordered_count() for s in fab.shards.values())
+
+    base = ordered_total()
+    t0 = _time.perf_counter()
+    i = 0
+    while ordered_total() - base < n_txns and \
+            _time.perf_counter() < t0 + timeout:
+        while i < n_txns and i - (ordered_total() - base) < 256:
+            fab.submit_write(reqs[i])
+            i += 1
+        fab.prod_all()
+        if fab.pipeline is not None:
+            fab.pipeline.flush()
+    dt = _time.perf_counter() - t0
+    done = ordered_total() - base
+    per_shard = {sid: s.ordered_count() for sid, s in fab.shards.items()}
+
+    # read phase: home-vs-cross mix through the composed verifier
+    def pump(seconds):
+        t_end = _time.perf_counter() + seconds
+        while _time.perf_counter() < t_end:
+            fab.prod_all()
+
+    driver = fab.read_driver(pump=pump)
+    home, away = [], []
+    for u in users:
+        req = Request("r", 1, {"type": GET_NYM, "dest": u.identifier})
+        (home if fab.router.shard_of(req) == 0 else away).append(u)
+    served = cross_served = 0
+    t1 = _time.perf_counter()
+    for j in range(n_reads):
+        cross = (j % 10) < cross_fraction * 10 and away
+        pool_u = away if cross else (home or away)
+        if not pool_u:
+            break
+        u = pool_u[j % len(pool_u)]
+        q = Request("reader", j + 1, {"type": GET_NYM, "dest": u.identifier})
+        if driver.read(q, per_node_s=2.0, step_s=0.001) is not None:
+            served += 1
+            if cross:
+                cross_served += 1
+    read_dt = _time.perf_counter() - t1
+    s = driver.stats.summary()
+    return {
+        "shards": n_shards, "nodes": n_shards * nodes_per_shard,
+        "txns_ordered": done, "txns_requested": n_txns,
+        "seconds": round(dt, 2),
+        "aggregate_tps": round(done / dt, 1) if dt else 0.0,
+        "per_shard_tps": {str(sid): round(n / dt, 1) if dt else 0.0
+                          for sid, n in per_shard.items()},
+        "router": fab.router.summary(),
+        "reads_served": served, "cross_shard_served": cross_served,
+        "reads_per_s": round(served / read_dt, 1) if read_dt else 0.0,
+        "cross_verify_ms_p50": s.get("verify_ms_p50"),
+        "cross_verify_ms_p95": s.get("verify_ms_p95"),
+        "map_proof_failures": s.get("map_proof_failures"),
+    }
+
+
+def config10_shards(n_txns: int = 120, timeout: float = 240.0) -> dict:
+    """Horizontal sharding A/B on the bench line (docs/sharding.md): 2-
+    and 4-shard fabrics vs the SINGLE-shard pool at MATCHED total node
+    count, under a 95:5-shaped load (the write drive + a cross-shard
+    read mix at 50% cross fraction). Interleaved medians of 3 after one
+    discarded warm-up pass, per the config5/config8 methodology (the
+    first pool per process runs cold; host noise rides a ±20% band).
+
+    The acceptance figure is speedup_2x4 = 2-shard aggregate write TPS /
+    matched 8-node single-pool TPS (target >= 1.6): the per-txn ordering
+    work in a 4-node shard is a fraction of an 8-node pool's (quadratic
+    3PC messaging, half the commit sigs), so splitting the SAME total
+    node count two ways buys super-linear aggregate throughput."""
+    try:
+        arms = {
+            "single_8": (1, 8),
+            "sharded_2x4": (2, 4),
+            "sharded_4x2": (4, 2),
+        }
+        _sharded_arm_once(2, 4, max(20, n_txns // 4), timeout)   # warm-up
+        runs: dict[str, list] = {k: [] for k in arms}
+        for _ in range(3):
+            for k, (ns, npn) in arms.items():        # interleaved
+                runs[k].append(_sharded_arm_once(ns, npn, n_txns, timeout))
+
+        def med(rs):
+            good = sorted((r for r in rs if r.get("txns_ordered")),
+                          key=lambda r: r["aggregate_tps"])
+            return good[len(good) // 2] if good else {"error": "no runs"}
+
+        out = {k: med(v) for k, v in runs.items()}
+        base = out["single_8"].get("aggregate_tps") or 0.0
+        two = out["sharded_2x4"].get("aggregate_tps") or 0.0
+        four = out["sharded_4x2"].get("aggregate_tps") or 0.0
+        if base:
+            out["speedup_2x4"] = round(two / base, 2)
+            out["speedup_4x2"] = round(four / base, 2)
+        return out
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _pipeline_ab_inproc(n_txns: int = 150, repeat: int = 3) -> dict:
     """The fused-pipeline A/B, run INSIDE a JAX_PLATFORMS=cpu subprocess
     (config8_pipeline_ab spawns it): the SAME 4-node write load through
@@ -911,7 +1051,8 @@ def main():
                      ("config5", config5_sim25),
                      ("config6", config6_read_plane),
                      ("config7", config7_ingress_10k),
-                     ("config8", config8_pipeline_ab)):
+                     ("config8", config8_pipeline_ab),
+                     ("config10", config10_shards)):
         print(name, json.dumps(fn()), flush=True)
 
 

@@ -325,6 +325,39 @@ def derive_summary(folds: dict[str, dict], span_s: float,
             }
         out["ingress"] = {k: v for k, v in section.items()
                           if v is not None}
+    # sharding plane (docs/sharding.md): routing volume + per-shard
+    # ordering, the cross-shard read ledger (attempts, verified OKs,
+    # mapping-proof failures — a rising failure count is the operator's
+    # forged/stale-map alarm), and the client-side composed-verification
+    # p50/p95 (mapping inclusion + directory pairing + shard anchor)
+    sr = folds.get("shards.routed", {})
+    if sr.get("count") or folds.get("shards.cross_reads", {}).get("count"):
+        section = {
+            "routed": int(s("shards.routed")),
+            "unroutable": int(s("shards.unroutable")),
+            "cross_shard_reads": int(s("shards.cross_reads")),
+            "cross_shard_reads_ok": int(s("shards.cross_reads_ok")),
+            "map_proof_failures": int(s("shards.map_proof_failures")),
+        }
+        ob = folds.get("shards.ordered_batches", {})
+        if ob.get("count"):
+            # one event per shard per snapshot, value = that shard's
+            # newly ordered txns since the previous snapshot: sum is
+            # the exact total ordered, mean the mean per-shard
+            # increment, max the busiest shard's single-poll burst
+            section["ordered_total"] = int(ob.get("sum") or 0)
+            section["ordered_per_shard_mean"] = round(ob["mean"], 1)
+            section["ordered_per_shard_max"] = ob.get("max")
+        cv = folds.get("shards.cross_verify_time", {})
+        if cv.get("samples"):
+            section["cross_verify_ms_p50"] = _ms(
+                percentile(cv["samples"], 0.5))
+            section["cross_verify_ms_p95"] = _ms(
+                percentile(cv["samples"], 0.95))
+        elif cv.get("mean") is not None:
+            section["cross_verify_ms_mean"] = _ms(cv["mean"])
+        out["shards"] = {k: v for k, v in section.items()
+                         if v is not None}
     # observer read fan-out: push intake + anchor verification verdicts
     # and the stale-suppression count (proofless escalations to the pool)
     if folds.get("observer.pushes", {}).get("count"):

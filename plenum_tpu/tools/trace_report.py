@@ -120,6 +120,13 @@ class _NodeIndex:
 
     def __init__(self, dump: dict, offset: float):
         self.node = dump["node"]
+        # sharded fabrics tag each node's dump with its shard id so the
+        # assembled report can attribute waterfalls and hops PER SHARD
+        self.shard = (dump.get("tags") or {}).get("shard")
+        # router decisions / resolved cross-shard reads seen by this
+        # dump's tracer (the fabric tracer, usually)
+        self.shard_routes: list[dict] = []
+        self.cross_reads: list[dict] = []
         self.first: dict[tuple[str, str], float] = {}
         self.batch_of_req: dict[str, tuple[str, int]] = {}
         self.durable_by_seq: dict[int, float] = {}
@@ -153,6 +160,16 @@ class _NodeIndex:
                 if isinstance((data or {}).get("proof_dur"), (int, float)):
                     self.stage_durs.setdefault("read_proof_wall",
                                                []).append(data["proof_dur"])
+            elif stage == tracing.SHARD_ROUTE:
+                self.shard_routes.append(data or {})
+            elif stage == tracing.CROSS_SHARD:
+                d = data or {}
+                self.cross_reads.append(d)
+                if isinstance(d.get("dur"), (int, float)):
+                    # client-side composed verification + ladder time:
+                    # the cross-shard hop as a first-class stage
+                    self.stage_durs.setdefault("cross_shard",
+                                               []).append(d["dur"])
             elif stage == tracing.DEVICE:
                 # fused-pipeline wave: submit->pack->dispatch->collect
                 # sub-spans become device_* attribution stages, and the
@@ -258,10 +275,32 @@ def assemble(dumps: list[dict]) -> dict:
     # fused-pipeline device waves: the ring is host-shared, so the
     # last-attached node's tracer holds the full story — merge all
     device = [w for idx in indexes for w in idx.device_waves]
+    # sharding plane: group nodes by their dump's shard tag and fold the
+    # fabric tracer's routing/cross-read events into one story
+    shards: Optional[dict] = None
+    by_shard: dict = {}
+    for idx in indexes:
+        if idx.shard is not None:
+            by_shard.setdefault(idx.shard, []).append(idx.node)
+    routes = [r for idx in indexes for r in idx.shard_routes]
+    cross = [c for idx in indexes for c in idx.cross_reads]
+    if by_shard or routes or cross:
+        per_shard_routes: dict = {}
+        for r in routes:
+            sid = r.get("shard")
+            per_shard_routes[sid] = per_shard_routes.get(sid, 0) + 1
+        shards = {"nodes_by_shard": {str(k): sorted(v)
+                                     for k, v in sorted(by_shard.items())},
+                  "route_decisions": len(routes),
+                  "routes_per_shard": {str(k): v for k, v in
+                                       sorted(per_shard_routes.items())},
+                  "cross_shard_reads": len(cross),
+                  "cross_shard_ok": sum(1 for c in cross if c.get("ok"))}
     return {"nodes": sorted(offsets), "offsets": offsets,
             "requests": requests, "attribution": attribution,
             "anomalies": anomalies, "controller": controller,
-            "device": device}
+            "device": device,
+            **({"shards": shards} if shards else {})}
 
 
 def attribution_summary(report: dict) -> dict:
@@ -326,6 +365,7 @@ def summarize(report: dict, sample: int = 3) -> dict:
         "anomalies": len(report["anomalies"]),
         **({"controller": control} if control else {}),
         **({"device": device} if device else {}),
+        **({"shards": report["shards"]} if report.get("shards") else {}),
     }
 
 
@@ -352,6 +392,15 @@ def _print_report(report: dict, last_n: int) -> None:
                   f"pad={w.get('pad')} queue={1000 * w.get('queue', 0):.2f}ms "
                   f"pack={1000 * w.get('pack', 0):.2f}ms "
                   f"dispatch={1000 * w.get('dispatch', 0):.2f}ms")
+    sh = report.get("shards")
+    if sh:
+        groups = ", ".join(f"shard {k}: {', '.join(v)}"
+                           for k, v in sh["nodes_by_shard"].items())
+        print(f"\nsharding: {groups or 'no shard-tagged nodes'}")
+        print(f"  routes {sh['route_decisions']} "
+              f"(per shard {sh['routes_per_shard']}), "
+              f"cross-shard reads {sh['cross_shard_reads']} "
+              f"({sh['cross_shard_ok']} verified ok)")
     for node, decisions in sorted(report.get("controller", {}).items()):
         print(f"\ncontrol trajectory @{node} ({len(decisions)} decisions):")
         for t, d in decisions[-last_n * 2:]:
@@ -380,9 +429,14 @@ def _synthetic_dumps() -> list[dict]:
     (so --check exercises the alignment path too)."""
     req, batch = "d" * 8, "b" * 8
     primary = {
-        "node": "P", "clock_domain": "wall",
+        "node": "P", "clock_domain": "wall", "tags": {"shard": 0},
         "mono_anchor": 0.0, "wall_anchor": 100.0, "dumped_at": 1.0,
         "anomalies": 0, "events": [
+            # sharding plane: a router decision and a resolved verified
+            # cross-shard read (dur becomes the cross_shard stage)
+            [0.005, tracing.SHARD_ROUTE, req, {"shard": 0, "frm": "cli"}],
+            [0.007, tracing.CROSS_SHARD, req,
+             {"shard": 1, "ok": True, "dur": 0.002}],
             [0.008, tracing.ING_ADMIT, req, {"frm": "cli"}],
             [0.010, tracing.INGRESS, req, {"frm": "cli"}],
             [0.012, tracing.AUTH, req, {"ok": True}],
@@ -454,9 +508,14 @@ def self_check() -> int:
     att = attribution_summary(report)
     for need in ("network", "crypto", "ordering", "durable", "reply",
                  "apply_wall", "device_queue", "device_pack",
-                 "device_dispatch"):
+                 "device_dispatch", "cross_shard"):
         if need not in att:
             problems.append(f"attribution missing {need}")
+    sh = report.get("shards")
+    if not sh or sh.get("route_decisions") != 1 \
+            or sh.get("cross_shard_ok") != 1 \
+            or sh.get("nodes_by_shard", {}).get("0") != ["P"]:
+        problems.append(f"shard attribution wrong: {sh}")
     dev = summarize(report).get("device")
     if not dev or dev.get("waves") != 1 or "64" not in dev.get("buckets", {}):
         problems.append(f"device wave summary wrong: {dev}")

@@ -1251,3 +1251,255 @@ def test_sim_membership_churn_client_flood_fuzz(bucket):
 
 def test_sim_membership_churn_client_flood_smoke():
     _run_with_artifacts(run_membership_churn_with_client_flood, 1)
+
+
+# --- scenario kind `cross_shard`: the SHARD BOUNDARY is under attack --------
+# Over the 2-shard ShardedSimFabric (plenum_tpu/shards/): tamper rungs —
+# a forged mapping proof, a wrong-shard answer, a stale map served after
+# a resharding — must every one fail CLOSED at the composed cross-shard
+# check; confinement rungs — a partition or a device_flap landing on ONE
+# shard — must never stall the other shard's ordering or its verified
+# cross-shard reads. Runs as its own seed sweep (the existing kinds keep
+# their historical seeds).
+
+
+def _shard_sizes(shard, names=None) -> set[int]:
+    return {shard.nodes[n].c.db.get_ledger(DOMAIN_LEDGER_ID).size
+            for n in (names or shard.names)}
+
+
+def _fab_order_and_time(fab, shard, req, expect: int, names=None,
+                        timeout: float = 25.0):
+    """Route through the fabric and run until every node in `names` of
+    `shard` reaches ledger size `expect`; -> sim seconds, or None."""
+    t0 = fab.timer.get_current_time()
+    assert fab.submit_write(req) == shard.shard_id
+    elapsed = 0.0
+    while elapsed < timeout:
+        fab.run(0.5)
+        elapsed += 0.5
+        if _shard_sizes(shard, names) == {expect}:
+            return fab.timer.get_current_time() - t0
+    return None
+
+
+def run_cross_shard_fuzz_scenario(seed: int, force_rung=None) -> None:
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.shards import (MappingLedger, ShardDescriptor,
+                                   ShardReadGate, ShardedSimFabric)
+    from plenum_tpu.shards.mapping import directory_bls_signers
+    from test_shards import LyingGate, signed_write, user_on_shard
+
+    rng = SimRandom(seed * 15485863 + 29)
+    rung = rng.integer(0, 4) if force_rung is None else force_rung
+
+    sup = faulty = None
+    shard_verifiers = None
+    flap_sid = rng.integer(0, 1)
+    if rung == 4:
+        # the crypto plane of ONE shard is the fault: that shard's four
+        # nodes share a supervised faulty device, the other shard's
+        # plane is untouched
+        from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+        from plenum_tpu.parallel.faults import FaultyVerifier
+        from plenum_tpu.parallel.supervisor import (CircuitBreaker,
+                                                    DeadlineBudget,
+                                                    SupervisedVerifier)
+        faulty = FaultyVerifier(CpuEd25519Verifier())
+        sup = SupervisedVerifier(
+            faulty, fallback=CpuEd25519Verifier(),
+            breaker=CircuitBreaker(fail_threshold=2,
+                                   cooldown=rng.float(0.5, 1.5)),
+            budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
+                                  warm_max=1.0, cold_max=1.0))
+        shard_verifiers = {flap_sid: sup}
+
+    fab = _track(ShardedSimFabric(n_shards=2, nodes_per_shard=4, seed=seed,
+                                  config=Config(**FAST),
+                                  shard_verifiers=shard_verifiers))
+    if sup is not None:
+        sup.set_clock(fab.timer.get_current_time)
+        faulty.set_clock(fab.timer.get_current_time)
+
+    # seed one owned write per shard; both shards order independently
+    users = {sid: user_on_shard(fab, sid, b"xsf%d-" % seed)
+             for sid in fab.shards}
+    for req_id, (sid, u) in enumerate(sorted(users.items()), start=1):
+        assert fab.submit_write(signed_write(fab, u, req_id)) == sid
+    elapsed = 0.0
+    while elapsed < 25.0 and any(_shard_sizes(s) != {2}
+                                 for s in fab.shards.values()):
+        fab.run(0.5)
+        elapsed += 0.5
+    for sid, shard in fab.shards.items():
+        assert _shard_sizes(shard) == {2}, \
+            f"seed {seed}: shard {sid} never ordered its seed write"
+
+    victim_sid = rng.integer(0, 1)       # the shard the tamper targets
+    victim = users[victim_sid]
+    q = Request("xsf", 50, {"type": GET_NYM, "dest": victim.identifier})
+
+    if rung == 0:
+        # FORGED MAPPING PROOF: every node of the owning shard cites a
+        # map signed by a non-directory committee — each ladder rung must
+        # reject fail-closed; after the gate heals, the SAME driver
+        # verifies again
+        evil = MappingLedger(
+            [ShardDescriptor.from_dict(d.to_dict())
+             for d in fab.mapping.descriptors],
+            directory_bls_signers([f"Ev{i}-{seed}" for i in range(4)]),
+            now=fab.timer.get_current_time)
+
+        def forge(result, key):
+            result["shard_proof"] = evil.ownership_proof(key)
+            return result
+
+        fab.gates[victim_sid] = LyingGate(fab.gates[victim_sid], forge)
+        driver = fab.read_driver()
+        res = driver.read(q, per_node_s=1.0, step_s=0.1)
+        s = driver.stats.summary()
+        assert res is None and s["fallbacks"] == 1, \
+            f"seed {seed}: forged map accepted ({s})"
+        assert s["map_proof_failures"] >= 1 and \
+            s["map_failure_reasons"].get("bad_map_multi_sig", 0) >= 1, \
+            f"seed {seed}: wrong rejection reason ({s})"
+        fab.gates[victim_sid] = fab.gates[victim_sid].inner
+        res = driver.read(Request("xsf", 51, dict(q.operation)),
+                          per_node_s=2.0, step_s=0.1)
+        assert res is not None and \
+            res["data"]["verkey"] == victim.verkey_b58, \
+            f"seed {seed}: healed gate still rejected"
+    elif rung == 1:
+        # WRONG-SHARD ANSWER: a foreign-shard node serves a valid-looking
+        # absence envelope against ITS root — the composed check rejects
+        # it and the ladder fails over INTO the owning shard
+        other_sid = 1 - victim_sid
+        wrong = fab.shards[other_sid].names[rng.integer(0, 3)]
+        driver = fab.read_driver()
+        res = driver.read(q, per_node_s=2.0, step_s=0.1,
+                          order=[wrong] + list(fab.shards[victim_sid].names))
+        s = driver.stats.summary()
+        assert res is not None and \
+            res["data"]["verkey"] == victim.verkey_b58, \
+            f"seed {seed}: wrong-shard ladder never recovered ({s})"
+        assert s["verify_failures"] >= 1 and s["failovers"] >= 1 and \
+            s["fallbacks"] == 0, f"seed {seed}: wrong-shard accepted ({s})"
+    elif rung == 2:
+        # STALE MAP AFTER RESHARDING: the owning shard's gate keeps
+        # serving the epoch-0 map after the directory publishes epoch 1 —
+        # a client whose view saw epoch 1 must fail closed, then verify
+        # once the gate refreshes
+        stale_ml = MappingLedger(
+            [ShardDescriptor.from_dict(d.to_dict())
+             for d in fab.mapping.descriptors],
+            fab.directory, now=fab.timer.get_current_time)
+        fab.gates[victim_sid] = ShardReadGate(stale_ml)
+        fab.mapping.reshard([ShardDescriptor.from_dict(d.to_dict())
+                             for d in fab.mapping.descriptors])
+        driver = fab.read_driver()           # view is at epoch 1
+        res = driver.read(q, per_node_s=1.0, step_s=0.1)
+        s = driver.stats.summary()
+        assert res is None and s["fallbacks"] == 1, \
+            f"seed {seed}: stale map accepted ({s})"
+        assert s["map_failure_reasons"].get("stale_map", 0) >= 1, \
+            f"seed {seed}: wrong stale rejection ({s})"
+        fab.gates[victim_sid] = ShardReadGate(fab.mapping)
+        res = driver.read(Request("xsf", 52, dict(q.operation)),
+                          per_node_s=2.0, step_s=0.1)
+        assert res is not None, f"seed {seed}: refreshed gate rejected"
+    elif rung == 3:
+        # PARTITION CONFINED TO ONE SHARD: blackout the victim shard's
+        # primary on ITS OWN SimNetwork; the other shard must keep
+        # ordering within its healthy latency AND keep answering verified
+        # cross-shard reads while the victim is mid-view-change; the
+        # victim's survivors then view-change and recover on their own
+        other_sid = 1 - victim_sid
+        vshard, oshard = fab.shards[victim_sid], fab.shards[other_sid]
+        primary = vshard.nodes[vshard.names[0]] \
+            .master_replica.data.primary_name
+        vshard.net.add_rule(Discard(), match_dst(primary))
+        vshard.net.add_rule(Discard(), match_frm(primary))
+        survivors = [n for n in vshard.names if n != primary]
+        # a write pending on the victim shard across its view change
+        pend = user_on_shard(fab, victim_sid, b"pend%d-" % seed)
+        fab.router.route(signed_write(fab, pend, 60), "xsf")
+        # ...must not slow the OTHER shard below healthy ordering
+        u2 = user_on_shard(fab, other_sid, b"live%d-" % seed, start=50)
+        took = _fab_order_and_time(fab, oshard, signed_write(fab, u2, 61),
+                                   3, timeout=10.0)
+        assert took is not None, \
+            f"seed {seed}: healthy shard stalled by foreign partition"
+        driver = fab.read_driver()
+        q2 = Request("xsf", 62, {"type": GET_NYM,
+                                 "dest": users[other_sid].identifier})
+        res = driver.read(q2, per_node_s=2.0, step_s=0.1)
+        assert res is not None and driver.stats.summary()["fallbacks"] == 0, \
+            f"seed {seed}: cross-shard read starved by foreign partition"
+        fab.run(25.0)                        # victim view-changes and heals
+        for n in survivors:
+            assert vshard.nodes[n].master_replica.view_no >= 1, \
+                f"seed {seed}: {n} stuck in view 0 behind the partition"
+        assert _shard_sizes(vshard, survivors) == {3}, \
+            f"seed {seed}: victim survivors lost the pending write"
+    else:
+        # DEVICE_FLAP CONFINED TO ONE SHARD: wedge/drop/corrupt the
+        # faulted shard's shared device MID-TRAFFIC; that shard degrades
+        # to hedged CPU fallback and keeps ordering, the OTHER shard's
+        # plane never even notices; heal re-closes the breaker
+        kind = ("wedge", "drop", "corrupt")[rng.integer(0, 2)]
+        assert sup.stats["device_batches"] >= 1, \
+            f"seed {seed}: seed traffic never hit the faulted shard device"
+        getattr(faulty, kind)()
+        fshard = fab.shards[flap_sid]
+        oshard = fab.shards[1 - flap_sid]
+        uf = user_on_shard(fab, flap_sid, b"flap%d-" % seed, start=100)
+        uo = user_on_shard(fab, 1 - flap_sid, b"calm%d-" % seed, start=100)
+        t_other = _fab_order_and_time(fab, oshard,
+                                      signed_write(fab, uo, 70), 3,
+                                      timeout=10.0)
+        assert t_other is not None, \
+            f"seed {seed}: un-faulted shard stalled by foreign {kind}"
+        t_fault = _fab_order_and_time(fab, fshard,
+                                      signed_write(fab, uf, 71), 3)
+        assert t_fault is not None, \
+            f"seed {seed}: faulted shard stopped ordering under {kind}"
+        st = sup.supervisor_stats()
+        assert st["fallback_batches"] >= 1, \
+            f"seed {seed}: no CPU fallback under {kind}"
+        assert st["max_stall_s"] <= st["max_budget_s"] + 0.3, \
+            f"seed {seed}: stall past deadline budget"
+        from plenum_tpu.parallel.supervisor import CLOSED
+        faulty.heal()
+        waited = 0.0
+        while sup.breaker.state != CLOSED and waited < 30.0:
+            fab.run(1.0)
+            waited += 1.0
+            sup.verify_batch([(b"xsf-heal-%d-%f" % (seed, waited),
+                               b"\0" * 64, b"\0" * 32)])
+        assert sup.breaker.state == CLOSED, \
+            f"seed {seed}: shard breaker never re-closed after {kind}"
+        assert sup.stats["verdict_forks"] == 0
+
+    for shard in fab.shards.values():        # no fork inside any shard
+        assert_safety(shard)
+
+
+CROSS_SHARD_SEEDS = 20
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_cross_shard_fuzz(bucket):
+    for seed in range(bucket * 5, (bucket + 1) * 5):
+        _run_with_artifacts(run_cross_shard_fuzz_scenario, seed)
+
+
+def test_sim_cross_shard_smoke():
+    """Two rungs always run in the default suite: one tamper rung (the
+    forged mapping proof, failing closed end to end) and one confinement
+    rung (a partition landing on one shard leaving the other's ordering
+    and cross-shard reads untouched)."""
+    _run_with_artifacts(
+        lambda s: run_cross_shard_fuzz_scenario(s, force_rung=0), 1)
+    _run_with_artifacts(
+        lambda s: run_cross_shard_fuzz_scenario(s, force_rung=3), 2)
