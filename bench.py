@@ -398,6 +398,20 @@ def main():
                 "map_proof_failures":
                     c10["sharded_2x4"].get("map_proof_failures"),
             }
+        # live fleet telemetry acceptance (docs/observability.md):
+        # enabled-vs-disabled interleaved A/B (<=2% budget, twin of
+        # trace_overhead_pct) + the burn-rate/imbalance columns from the
+        # zipfian hot-shard arm — the hot shard must be flagged
+        c11 = bc.config11_telemetry(n_txns=150)
+        if "error" in c11:
+            result["config11_telemetry"] = c11["error"]
+        else:
+            result["config11_telemetry"] = {
+                k: c11[k] for k in
+                ("telemetry_on_tps", "telemetry_off_tps",
+                 "telemetry_overhead_pct", "imbalance_index",
+                 "hot_shard", "ordered_rates", "shard_health",
+                 "burn", "alerts") if c11.get(k) is not None}
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
     # fused-pipeline A/B on JAX-ON-CPU — published UNCONDITIONALLY: its

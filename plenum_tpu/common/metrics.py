@@ -110,6 +110,14 @@ class MetricsName:
     SHARD_CROSS_READS_OK = "shards.cross_reads_ok"
     SHARD_MAP_PROOF_FAILURES = "shards.map_proof_failures"
     SHARD_CROSS_VERIFY_TIME = "shards.cross_verify_time"
+    # live fleet telemetry (observability/): per-shard health score and
+    # load-imbalance index gauges emitted at each fabric poll (read back
+    # via last/min), plus the plane's own volume counters
+    SHARD_HEALTH = "shards.health"
+    SHARD_IMBALANCE = "shards.imbalance"
+    TELEMETRY_SNAPSHOTS = "telemetry.snapshots"
+    TELEMETRY_ALERTS = "telemetry.alerts"
+    TELEMETRY_SOURCE_ERRORS = "telemetry.source_errors"
     # observer read fan-out (ingress/observer_reads.py)
     OBSERVER_PUSHES = "observer.pushes"
     OBSERVER_MS_ADOPTED = "observer.ms_adopted"

@@ -356,5 +356,18 @@ class ObservedData(MessageBase):
     msg: dict
 
 
+@wire_message
+class Telemetry(MessageBase):
+    """Best-effort fleet-telemetry snapshot (observability/snapshot.py):
+    one node's periodic health/counters payload shipped to whichever
+    peer hosts a FleetAggregator. It carries no protocol state, is never
+    re-requested, and a receiver without an aggregator attached simply
+    drops it. (It rides the SAME bus/outbox as consensus traffic — there
+    is no transport-level prioritization; the volume budget is one
+    compact snapshot per TELEMETRY_INTERVAL.)"""
+    typename = "TELEMETRY"
+    snapshot: dict
+
+
 def three_pc_key(msg) -> tuple[int, int]:
     return (msg.view_no, msg.pp_seq_no)

@@ -177,6 +177,49 @@ class Config:
     # anomaly auto-dumps are debounced to at most one per this interval
     FLIGHT_DUMP_MIN_INTERVAL: float = 5.0
 
+    # --- live fleet telemetry plane (observability/) ---
+    # False drops the node to the NULL_TELEMETRY fast path (one attribute
+    # check per call site, no snapshot timer registered — the <=2% budget
+    # twin of FLIGHT_RECORDER=False, pinned by the same microbench style)
+    TELEMETRY: bool = True
+    # snapshot cadence on the node's injectable timer (seconds); every
+    # stamp in a snapshot rides this clock, so a recorded run replays a
+    # byte-identical snapshot stream
+    TELEMETRY_INTERVAL: float = 1.0
+    # bounded local history of recent snapshots held in memory (the
+    # aggregator and console read these; the ring is the memory bound)
+    TELEMETRY_RING: int = 256
+    # on-disk spool: snapshots rotate over this many numbered files
+    # (atomic tmp+rename, same discipline as flight dumps); 0 disables
+    TELEMETRY_SPOOL_MAX: int = 64
+    # name of the peer hosting the pool's FleetAggregator: when set,
+    # every OTHER node ships its snapshots there as the best-effort
+    # TELEMETRY wire message (Node.ship_telemetry_to); empty = spool/
+    # in-process sinks only
+    TELEMETRY_SHIP_TO: str = ""
+    # a node silent for longer than this (vs the newest snapshot the
+    # aggregator has seen from anyone) scores health 0.0: crashed or
+    # partitioned must read as DOWN, never frozen-at-last-healthy
+    TELEMETRY_STALE_AFTER: float = 10.0
+    # multi-window SLO burn-rate alerting (observability/aggregator.py):
+    # burn = (violating fraction) / SLO_BURN_BUDGET per window; the alert
+    # fires only when BOTH windows burn past SLO_BURN_THRESHOLD — the
+    # fast window for recency, the slow one so a blip cannot page
+    SLO_BURN_FAST_WINDOW: float = 10.0
+    SLO_BURN_SLOW_WINDOW: float = 60.0
+    SLO_BURN_BUDGET: float = 0.05       # tolerated SLO-violation fraction
+    SLO_BURN_THRESHOLD: float = 2.0     # burn multiple that raises the alert
+    # per-client-cap sheds burn the ingress SLO budget only when at
+    # least this many DISTINCT clients were capped in one snapshot
+    # interval (breadth = pool overload; below it, fairness limiting a
+    # few abusers must not page)
+    INGRESS_SLO_CAP_BREADTH: int = 3
+    # per-node health score alert floor + the shard load-imbalance index
+    # (max shard rate / mean shard rate) past which the hot shard is
+    # flagged — the exact signal live split/merge will consume
+    HEALTH_ALERT_FLOOR: float = 0.5
+    SHARD_IMBALANCE_THRESHOLD: float = 1.5
+
     # --- blacklisting (TTL: self-isolation must heal; see blacklister.py) ---
     BLACKLIST_TTL: float = 120.0
     CatchupTransactionsTimeout: float = 6.0

@@ -91,6 +91,12 @@ class BatchController:
 
         self.decisions = 0
         self.last_decision: dict = {}
+        # batch-SLO ledger for the telemetry plane's burn-rate tracking:
+        # one check per decision, a violation when the attributed e2e p95
+        # exceeded BATCH_SLO_P95 at that decision (cumulative; snapshot
+        # sources take deltas)
+        self.slo_checks = 0
+        self.slo_violations = 0
         # Decisions are driven by SAMPLE ARRIVALS past the interval
         # deadline, NOT by a free-running RepeatingTimer: a repeating
         # timer fires at clock-STEPPING-dependent instants (a live pool
@@ -176,7 +182,9 @@ class BatchController:
         slo = self._config.BATCH_SLO_P95
         fill = (sum(self._fills) / len(self._fills) / max(1, self.batch_size)
                 if self._fills else 0.0)
+        self.slo_checks += 1
         if e2e > slo:
+            self.slo_violations += 1
             if q >= max(o, d):
                 # requests spend their latency WAITING to be batched
                 verdict = "shrink:queueing"

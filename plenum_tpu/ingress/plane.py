@@ -86,7 +86,25 @@ class IngressPlane:
         self.stats = {"submitted": 0, "admitted": 0, "shed": 0,
                       "shed_overload": 0, "shed_client_cap": 0,
                       "auth_batches": 0, "auth_items": 0, "auth_fail": 0,
-                      "nacked": 0, "passthrough": 0, "queue_depth_max": 0}
+                      "nacked": 0, "passthrough": 0, "queue_depth_max": 0,
+                      # ingress-SLO ledger for the telemetry plane's
+                      # burn-rate tracking: one check per dequeued write,
+                      # a violation when its queue wait exceeded
+                      # INGRESS_SLO_P95 (cumulative; the snapshot source
+                      # takes deltas)
+                      "slo_checks": 0, "slo_violations": 0}
+        # register as a telemetry source: the front door's queue depth,
+        # shed state, and SLO ledger are fleet-health signals
+        # (observability/snapshot.py); one guarded attribute check when
+        # telemetry is disabled
+        telemetry = getattr(node, "telemetry", None)
+        if telemetry is not None and telemetry.enabled:
+            from plenum_tpu.observability import CumulativeDelta
+            self._telemetry_deltas = CumulativeDelta()
+            # distinct clients hitting their per-client cap this
+            # snapshot interval — the breadth rule's input
+            self._capped_clients: set = set()
+            telemetry.add_source("ingress", self._telemetry_state)
 
         self._tick_timer = None
         if tick:
@@ -199,6 +217,18 @@ class IngressPlane:
               stat: str) -> None:
         self.stats["shed"] += 1
         self.stats[stat] += 1
+        # an OVERLOAD shed spends ingress error budget: the pool refused
+        # work it should have absorbed. A per-client-cap shed goes into
+        # the ledger only via the BREADTH rule at snapshot time (many
+        # distinct clients capped in one interval = overload; one
+        # abusive client being fairness-limited must not page the pool
+        # SLO alert while every well-behaved client is served in bounds)
+        if stat == "shed_overload":
+            self.stats["slo_checks"] += 1
+            self.stats["slo_violations"] += 1
+        elif stat == "shed_client_cap" and hasattr(self,
+                                                   "_capped_clients"):
+            self._capped_clients.add(frm)
         self.metrics.add_event(MetricsName.INGRESS_SHED)
         self._send(LoadShed(identifier=request.identifier,
                             req_id=request.req_id, reason=reason,
@@ -282,6 +312,9 @@ class IngressPlane:
                 self._total -= 1
                 wait = now - t_enq
                 self.metrics.add_event(MetricsName.INGRESS_QUEUE_WAIT, wait)
+                self.stats["slo_checks"] += 1
+                if wait > self.config.INGRESS_SLO_P95:
+                    self.stats["slo_violations"] += 1
                 if self.controller is not None:
                     self.controller.note_admitted(wait)
                 out.append((req, frm, t_enq))
@@ -321,6 +354,38 @@ class IngressPlane:
                              {"ok": ok_n, "fail": fail_n})
 
     # --- reporting --------------------------------------------------------
+
+    def _telemetry_state(self) -> dict:
+        """Front-door section of the node's telemetry snapshot: live
+        queue depth, the shed latch, per-interval shed volume, and the
+        ingress-SLO ledger deltas the burn-rate tracker consumes."""
+        out = {
+            "queue_depth": self._total,
+            "active_clients": len(self._queues),
+            "shedding": self._shedding,
+            "watermark": self.shed_watermark,
+        }
+        take = self._telemetry_deltas.take
+        d_shed = take("shed", self.stats["shed"])
+        if d_shed:
+            out["shed"] = d_shed
+        d_v = take("slo_v", self.stats["slo_violations"])
+        d_n = take("slo_n", self.stats["slo_checks"])
+        # the BREADTH rule: per-client-cap sheds count against the pool
+        # SLO only when MANY distinct clients were capped this interval
+        # (aggregate demand outran the pool = overload); below the
+        # breadth floor it is the fairness mechanism doing its job on a
+        # few abusers and must not burn the pool's error budget
+        d_cap = take("cap_shed", self.stats["shed_client_cap"])
+        breadth = len(self._capped_clients)
+        self._capped_clients.clear()
+        if d_cap and breadth >= getattr(self.config,
+                                        "INGRESS_SLO_CAP_BREADTH", 3):
+            d_v += d_cap
+            d_n += d_cap
+        if d_n > 0:
+            out["slo"] = [d_v, d_n]
+        return out
 
     def summary(self) -> dict:
         out = dict(self.stats)

@@ -41,7 +41,7 @@ from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
                                              Propagate, PropagateBatch,
                                              Reject, Reply,
                                              RequestAck, RequestNack,
-                                             ViewChange)
+                                             Telemetry, ViewChange)
 from plenum_tpu.common.serialization import pack, unpack
 from plenum_tpu.execution.database_manager import (NODE_STATUS_DB_LABEL,
                                                    SEQ_NO_DB_LABEL)
@@ -473,6 +473,31 @@ class Node:
         self._restore_3pc_from_audit()
         self._restore_backup_last_sent_pp()
 
+        # live fleet telemetry (observability/snapshot.py): a periodic
+        # replay-deterministic snapshot of this node's counters + health
+        # state on the injectable timer. Disabled (TELEMETRY=False) this
+        # is the shared NULL_TELEMETRY — one attribute check per call
+        # site, no timer registered. Other subsystems (IngressPlane, the
+        # sharded fabric) add their own sources/sinks after construction.
+        from plenum_tpu.observability import CumulativeDelta, make_telemetry
+        self.telemetry = make_telemetry(
+            name, self.metrics, timer.get_current_time, config=self.config,
+            timer=timer)
+        if self.telemetry.enabled:
+            self._telemetry_deltas = CumulativeDelta()
+            self.telemetry.add_source("node", self._telemetry_node_state)
+            self.telemetry.add_source("crypto", self._telemetry_crypto_state)
+            if self.c.pipeline is not None:
+                self.telemetry.add_source(
+                    "pipeline", self._telemetry_pipeline_state)
+            ship_to = getattr(self.config, "TELEMETRY_SHIP_TO", "")
+            if ship_to and ship_to != name:
+                self.ship_telemetry_to(ship_to)
+        # inbound TELEMETRY snapshots (best-effort) feed an
+        # attached FleetAggregator; without one they drop on the floor
+        self.fleet_aggregator = None
+        self.node_bus.subscribe(Telemetry, self._receive_telemetry)
+
         # built-in actions need the finished node (ref validator_info_tool)
         from plenum_tpu.execution.action_manager import ValidatorInfoAction
         self.action_manager = components.action_manager
@@ -627,6 +652,91 @@ class Node:
         # shared, so like PAIRING_STATS these are host-wide figures)
         if self.c.pipeline is not None:
             self.c.pipeline.sample_metrics(self.metrics)
+
+    # --- live fleet telemetry (observability/) ---------------------------
+
+    def _telemetry_node_state(self) -> dict:
+        """The node's live health gauges for the telemetry snapshot's
+        state section. Everything here derives from counters or the
+        injectable timer — no wall reads — so a replayed node emits a
+        byte-identical snapshot stream."""
+        master = self.master_replica.data
+        domain = self.c.db.get_ledger(DOMAIN_LEDGER_ID)
+        out = {
+            "ordered_total": (domain.size - 1) if domain is not None else 0,
+            "view_no": master.view_no,
+            "vc_in_progress": bool(master.waiting_for_new_view),
+            "catchup_running": bool(self.leecher.is_running),
+            "read_only_degraded": bool(self.read_only_degraded),
+            "validators": len(self.validators),
+        }
+        anchor = self.read_plane.anchor_for(DOMAIN_LEDGER_ID)
+        if anchor is not None:
+            out["anchor_age"] = round(
+                max(0.0, self.timer.get_current_time()
+                    - anchor.ms.value.timestamp), 6)
+        # batch-SLO ledger deltas (controller decisions vs BATCH_SLO_P95)
+        ctl = self.batch_controller
+        if ctl is not None:
+            d_v = self._telemetry_deltas.take("slo_v", ctl.slo_violations)
+            d_n = self._telemetry_deltas.take("slo_n", ctl.slo_checks)
+            if d_n > 0:
+                out["slo"] = [d_v, d_n]
+        return out
+
+    def _telemetry_crypto_state(self) -> dict:
+        """Crypto-plane breaker state in its own section so the
+        aggregator's health fold reads one canonical key."""
+        from plenum_tpu.parallel.supervisor import find_supervisor
+        verifier = getattr(self.c.authenticator.core_authenticator,
+                           "verifier", None)
+        sup = find_supervisor(verifier)
+        if sup is None:
+            return {}
+        return {"breaker_state": sup.breaker.state,
+                "fallback_batches": sup.stats.get("fallback_batches", 0)}
+
+    def _telemetry_pipeline_state(self) -> dict:
+        pipe = self.c.pipeline
+        if pipe is None:
+            return {}
+        st = pipe.stats
+        dispatches = st.get("dispatches", 0)
+        return {
+            "occupancy": pipe.occupancy(),
+            "dispatches": dispatches,
+            "bucket_hit_rate": round(
+                st.get("bucket_hits", 0) / dispatches, 3)
+            if dispatches else None,
+        }
+
+    def attach_fleet_aggregator(self, aggregator) -> None:
+        """Route inbound TELEMETRY snapshots (and this node's own) into
+        `aggregator` — the seam fleet_console/tests/fabrics use to host
+        the pool-wide view on one designated node."""
+        self.fleet_aggregator = aggregator
+        if self.telemetry.enabled:
+            self.telemetry.add_sink(aggregator.ingest)
+
+    def ship_telemetry_to(self, peer: str) -> None:
+        """Ship this node's snapshots to `peer` as the best-effort
+        TELEMETRY wire message — the production counterpart of
+        attach_fleet_aggregator: every other node ships to the node
+        hosting the aggregator (TELEMETRY_SHIP_TO wires this from
+        config at construction)."""
+        if self.telemetry.enabled:
+            self.telemetry.ship = lambda snap: self.node_bus.send(
+                Telemetry(snapshot=snap), peer)
+
+    def _receive_telemetry(self, msg: Telemetry, frm: str) -> None:
+        if self.fleet_aggregator is None:
+            return
+        # bind the snapshot to the AUTHENTICATED sender: one byzantine
+        # peer must not overwrite another node's health story (a forged
+        # healthy "Alpha" stream would mask Alpha's real outage)
+        if msg.snapshot.get("node") != frm:
+            return
+        self.fleet_aggregator.ingest(msg.snapshot)
 
     def _flush_metrics(self) -> None:
         """Sample process RSS/GC gauges + one last queue sample, then flush

@@ -1,0 +1,425 @@
+"""FleetAggregator: snapshots in, pool/shard-wide signals out.
+
+Composes per-node :mod:`snapshot` streams into the live fleet view:
+
+* **health scores** per node and per shard in [0, 1] — a documented
+  penalty fold over the snapshot's state section (breaker open, read-only
+  degradation, catchup, view change, shedding, anchor staleness), NOT a
+  learned figure: an operator must be able to read a 0.4 and say why;
+* the **shard load-imbalance index** — max per-shard ordered rate over
+  the mean, measured across the trailing window.  This is the exact
+  input live shard split/merge (ROADMAP item 1) will consume, and past
+  ``SHARD_IMBALANCE_THRESHOLD`` the hot shard is flagged;
+* **per-node anchor staleness** — how far behind the BLS-anchored root
+  each node's read plane serves from (the WAN-staleness signal);
+* **multi-window SLO burn rates** against the already-configured
+  ``INGRESS_SLO_P95`` / ``BATCH_SLO_P95`` budgets: burn = violating
+  fraction / budget per window, and an alert fires only when BOTH the
+  fast and slow windows burn past the threshold — fast for recency,
+  slow so a blip cannot page (the classic multi-window burn-rate rule).
+
+Alerts are edge-triggered with a latch: one structured alert when a
+condition turns true, one ``*_clear`` when it recovers — an idle pool
+raises ZERO alerts and a sustained overload raises ONE, not a storm.
+Every alert also lands in an attached flight-recorder ring
+(``tracer.anomaly``), so the incident timeline and the burn-rate story
+meet in the same artifact.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from plenum_tpu.common.metrics import MetricsName
+
+
+@dataclass
+class Alert:
+    t: float
+    kind: str                       # e.g. "slo_burn.ingress", "health.node"
+    subject: str                    # node name, shard id, or "" (pool)
+    severity: str                   # "page" | "warn" | "clear"
+    detail: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"t": self.t, "kind": self.kind, "subject": self.subject,
+                "severity": self.severity, "detail": self.detail}
+
+
+class BurnRateTracker:
+    """Multi-window burn-rate over (violations, total) deltas.
+
+    Each ``note(t, viol, n)`` records one snapshot interval's SLO ledger;
+    ``burn(t, window)`` folds the intervals inside [t-window, t] into
+    violating-fraction / budget. ``alerting(t)`` is the multi-window
+    rule: both windows past the threshold, with a minimum sample count
+    AND a minimum number of distinct intervals — one burst-heavy first
+    interval can satisfy any check count, so the interval floor is what
+    actually makes 'a blip cannot page' true."""
+
+    MIN_SAMPLES = 8
+    MIN_INTERVALS = 4
+
+    def __init__(self, budget: float, threshold: float,
+                 fast_window: float, slow_window: float):
+        self.budget = max(1e-9, budget)
+        self.threshold = threshold
+        self.fast_window = fast_window
+        self.slow_window = slow_window
+        self._points: deque = deque(maxlen=4096)    # (t, viol, n)
+
+    def note(self, t: float, violations: int, total: int) -> None:
+        if total > 0:
+            self._points.append((t, int(violations), int(total)))
+
+    def _fold(self, t: float, window: float) -> tuple[int, int, int]:
+        viol = n = pts = 0
+        for (ts, v, c) in reversed(self._points):
+            if ts < t - window:
+                break
+            viol += v
+            n += c
+            pts += 1
+        return viol, n, pts
+
+    def burn(self, t: float, window: float) -> float:
+        viol, n, _pts = self._fold(t, window)
+        if n == 0:
+            return 0.0
+        return (viol / n) / self.budget
+
+    def alerting(self, t: float) -> bool:
+        viol, n, pts = self._fold(t, self.slow_window)
+        if n < self.MIN_SAMPLES or pts < self.MIN_INTERVALS:
+            return False
+        return (self.burn(t, self.fast_window) >= self.threshold
+                and self.burn(t, self.slow_window) >= self.threshold)
+
+    def summary(self, t: float) -> dict:
+        return {"fast": round(self.burn(t, self.fast_window), 2),
+                "slow": round(self.burn(t, self.slow_window), 2),
+                "budget": self.budget}
+
+
+# --- health score -----------------------------------------------------------
+# The documented penalty table (docs/observability.md "Health score"):
+# each (condition, penalty) subtracts from 1.0; the score clamps to
+# [0, 1]. Ordered by how much of the node's service the condition costs.
+HEALTH_PENALTIES = (
+    ("read_only_degraded", 0.8),    # ordering parked; reads only
+    ("breaker_open", 0.5),          # crypto plane on CPU fallback
+    ("catchup_running", 0.3),       # resyncing, not ordering
+    ("breaker_half_open", 0.2),     # probing its way back
+    ("vc_in_progress", 0.2),        # ordering paused for the view change
+    ("shedding", 0.2),              # front door refusing new work
+    ("anchor_stale", 0.3),          # serving reads at a stale root
+)
+
+
+def node_health(state: dict, anchor_stale: bool = False) -> float:
+    """state = the snapshot's flattened condition dict -> score in [0,1]."""
+    score = 1.0
+    for key, penalty in HEALTH_PENALTIES:
+        if key == "anchor_stale":
+            if anchor_stale:
+                score -= penalty
+        elif state.get(key):
+            score -= penalty
+    return max(0.0, min(1.0, score))
+
+
+class FleetAggregator:
+    """Snapshots in (``ingest``), fleet view out (``fleet_summary``).
+
+    `now` defaults to the latest ingested snapshot's stamp, so a replayed
+    stream aggregates identically to the live run that produced it.
+    `tracer`: alerts are mirrored into its ring as anomalies.
+    `freshness_s`: anchor-staleness bound (defaults to the read plane's
+    client-side freshness bound).
+    """
+
+    def __init__(self, config=None, tracer=None, metrics=None,
+                 freshness_s: float = 900.0,
+                 region_of: Optional[Callable[[str], str]] = None):
+        self.config = config
+        self.tracer = tracer
+        self.metrics = metrics
+        self.freshness_s = freshness_s
+        self.region_of = region_of
+        budget = getattr(config, "SLO_BURN_BUDGET", 0.05)
+        threshold = getattr(config, "SLO_BURN_THRESHOLD", 2.0)
+        fast = getattr(config, "SLO_BURN_FAST_WINDOW", 10.0)
+        slow = getattr(config, "SLO_BURN_SLOW_WINDOW", 60.0)
+        self.window = slow
+        # a node whose last snapshot is older than this (vs the fleet
+        # clock self.now) scores 0.0: a crashed/partitioned node must
+        # read as DOWN, not frozen-at-healthy
+        self.stale_after = getattr(config, "TELEMETRY_STALE_AFTER", 10.0)
+        # pool-scoped judgments (imbalance, staleness sweep) run once
+        # per snapshot interval, not once per ingest — per-ingest cost
+        # must not grow with fleet size
+        self._pool_eval_interval = getattr(config, "TELEMETRY_INTERVAL",
+                                           1.0)
+        self._pool_eval_next = 0.0
+        self._mk_burn = lambda: BurnRateTracker(budget, threshold, fast, slow)
+        # per (slo kind, node) burn tracker; alert latches per kind+subject
+        # hold the ACTIVE Alert object (None when clear), so active_alerts
+        # survives history trimming and costs O(latches), not O(history)
+        self.burn: dict[tuple[str, str], BurnRateTracker] = {}
+        self._latched: dict[tuple[str, str], Optional[Alert]] = {}
+        # bounded raise/clear history: a flapping condition on a
+        # long-lived aggregator must not grow memory without limit
+        self.alerts: list[Alert] = []
+        self.snapshots = 0
+        # the fleet clock: MEDIAN of the nodes' latest stamps, not the
+        # max — a single node stamping far-future times must not drag
+        # the clock forward and stale the whole honest pool (staleness
+        # tolerates the median's one-interval lag; TELEMETRY_STALE_AFTER
+        # is many intervals)
+        self.now = 0.0
+        self._node_t: dict[str, float] = {}
+        # node -> latest snapshot; node -> deque[(t, ordered_total)]
+        self.latest: dict[str, dict] = {}
+        self._ordered: dict[str, deque] = {}
+        self._node_shard: dict[str, Optional[int]] = {}
+
+    # --- intake -----------------------------------------------------------
+
+    def ingest(self, snap: dict) -> None:
+        node = snap.get("node", "?")
+        t = float(snap.get("t", 0.0))
+        self.snapshots += 1
+        self._node_t[node] = max(self._node_t.get(node, 0.0), t)
+        stamps = sorted(self._node_t.values())
+        mid = len(stamps) // 2
+        median = stamps[mid] if len(stamps) % 2 \
+            else (stamps[mid - 1] + stamps[mid]) / 2
+        self.now = max(self.now, median)    # monotone fleet clock
+        self.latest[node] = snap
+        self._node_shard[node] = (snap.get("tags") or {}).get("shard")
+        state = snap.get("state", {})
+        node_state = state.get("node", {})
+        ordered = node_state.get("ordered_total")
+        if ordered is not None:
+            hist = self._ordered.setdefault(node, deque(maxlen=1024))
+            hist.append((t, int(ordered)))
+        # SLO ledgers: every source section may carry {"slo": [viol, n]}
+        # deltas — ingress queue-wait vs INGRESS_SLO_P95, batch path vs
+        # BATCH_SLO_P95 — each feeds its own multi-window tracker
+        for section, kind in (("ingress", "ingress"), ("node", "batch")):
+            slo = state.get(section, {}).get("slo")
+            if slo:
+                tracker = self.burn.setdefault(
+                    (kind, node), self._mk_burn())
+                tracker.note(t, slo[0], slo[1])
+        self._evaluate(node, t)
+
+    # --- judgments ---------------------------------------------------------
+
+    def _flags(self, snap: dict) -> dict:
+        """Flatten the condition booleans health + alerts read."""
+        state = snap.get("state", {})
+        node_state = state.get("node", {})
+        crypto = state.get("crypto", {})
+        ingress = state.get("ingress", {})
+        breaker = crypto.get("breaker_state")
+        return {
+            "read_only_degraded": node_state.get("read_only_degraded"),
+            "catchup_running": node_state.get("catchup_running"),
+            "vc_in_progress": node_state.get("vc_in_progress"),
+            "breaker_open": breaker == "open",
+            "breaker_half_open": breaker == "half_open",
+            "shedding": ingress.get("shedding"),
+        }
+
+    def anchor_age(self, node: str) -> Optional[float]:
+        snap = self.latest.get(node)
+        if snap is None:
+            return None
+        age = snap.get("state", {}).get("node", {}).get("anchor_age")
+        return float(age) if age is not None else None
+
+    def node_stale(self, node: str) -> bool:
+        """True when the node has gone silent: no snapshot within
+        `stale_after` of the fleet clock (the newest ingested stamp)."""
+        snap = self.latest.get(node)
+        return (snap is not None
+                and self.now - float(snap.get("t", 0.0)) > self.stale_after)
+
+    def node_health(self, node: str) -> Optional[float]:
+        snap = self.latest.get(node)
+        if snap is None:
+            return None
+        if self.node_stale(node):
+            return 0.0              # down ≠ frozen-at-last-known-healthy
+        age = self.anchor_age(node)
+        stale = age is not None and age > self.freshness_s
+        return node_health(self._flags(snap), anchor_stale=stale)
+
+    def shard_health(self, healths: Optional[dict[str, Optional[float]]]
+                     = None) -> dict[int, float]:
+        """shard id -> min member health (a shard is as healthy as its
+        sickest member: quorum math, not averages, decides liveness).
+        Pass precomputed `healths` to avoid re-scoring every node."""
+        out: dict[int, float] = {}
+        for node, sid in self._node_shard.items():
+            if sid is None:
+                continue
+            h = healths.get(node) if healths is not None \
+                else self.node_health(node)
+            if h is None:
+                continue
+            out[sid] = min(out.get(sid, 1.0), h)
+        return out
+
+    def ordered_rates(self) -> dict[int, float]:
+        """shard id -> ordered txns/s over the trailing window ENDING AT
+        the fleet clock (so a silent node's rate decays toward zero
+        instead of freezing at its last-known figure); per-shard rate =
+        max over member nodes, since all members order the same stream
+        and a lagging member must not under-report the shard."""
+        rates: dict[int, float] = {}
+        t_end = self.now
+        for node, hist in self._ordered.items():
+            sid = self._node_shard.get(node)
+            if sid is None or not hist:
+                continue
+            first = last = None
+            for (ts, n) in reversed(hist):
+                if ts < t_end - self.window:
+                    break
+                first = (ts, n)
+                if last is None:
+                    last = (ts, n)
+            rate = 0.0
+            if first is not None and t_end > first[0]:
+                rate = (last[1] - first[1]) / (t_end - first[0])
+            rates[sid] = max(rates.get(sid, 0.0), rate)
+        return rates
+
+    def load_imbalance(self, rates: Optional[dict[int, float]] = None
+                       ) -> tuple[Optional[float], Optional[int]]:
+        """-> (index, hot shard id). index = max rate / mean rate; None
+        until at least two shards report. The hot shard is only named
+        when the index crosses the config threshold."""
+        if rates is None:
+            rates = self.ordered_rates()
+        if len(rates) < 2:
+            return None, None
+        mean = sum(rates.values()) / len(rates)
+        if mean <= 0:
+            return 1.0, None
+        hot_sid, hot_rate = max(rates.items(), key=lambda kv: kv[1])
+        index = hot_rate / mean
+        threshold = getattr(self.config, "SHARD_IMBALANCE_THRESHOLD", 1.5)
+        return round(index, 3), (hot_sid if index >= threshold else None)
+
+    def staleness(self) -> dict[str, float]:
+        """node (or region, with a region_of map) -> newest anchor age."""
+        out: dict[str, float] = {}
+        for node in self.latest:
+            age = self.anchor_age(node)
+            if age is None:
+                continue
+            key = self.region_of(node) if self.region_of else node
+            prev = out.get(key)
+            out[key] = age if prev is None else min(prev, age)
+        return out
+
+    # --- alerting -----------------------------------------------------------
+
+    ALERTS_MAX = 1024
+
+    def _raise(self, key: tuple[str, str], active: bool, t: float,
+               detail: dict, severity: str = "page") -> None:
+        was = self._latched.get(key) is not None
+        if active == was:
+            return
+        kind, subject = key
+        alert = Alert(t, kind, subject,
+                      severity if active else "clear", detail)
+        self._latched[key] = alert if active else None
+        self.alerts.append(alert)
+        if len(self.alerts) > self.ALERTS_MAX:
+            del self.alerts[: -self.ALERTS_MAX]
+        if self.metrics is not None and active:
+            self.metrics.add_event(MetricsName.TELEMETRY_ALERTS)
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.anomaly(f"alert.{kind}", alert.to_dict())
+
+    def _evaluate(self, node: str, t: float) -> None:
+        # burn-rate alerts for the trackers this node feeds (direct
+        # lookup — never a scan over every node's trackers)
+        for kind in ("ingress", "batch"):
+            tracker = self.burn.get((kind, node))
+            if tracker is not None:
+                self._raise((f"slo_burn.{kind}", node),
+                            tracker.alerting(t), t, tracker.summary(t))
+        # health-floor alert per node
+        floor = getattr(self.config, "HEALTH_ALERT_FLOOR", 0.5)
+        h = self.node_health(node)
+        if h is not None:
+            self._raise(("health.node", node), h < floor, t,
+                        {"health": round(h, 3),
+                         "flags": {k: True for k, v in
+                                   self._flags(self.latest[node]).items()
+                                   if v}},
+                        severity="warn")
+        # pool-scoped judgments, once per snapshot interval (per-ingest
+        # cost must not scale with fleet size)
+        if t < self._pool_eval_next:
+            return
+        self._pool_eval_next = t + self._pool_eval_interval
+        # a silent node can never evaluate itself — sweep for peers that
+        # went dark so a crashed node reads 0.0, not frozen-at-healthy
+        for other in self.latest:
+            if other != node and self.node_stale(other):
+                self._raise(("health.node", other), True, t,
+                            {"health": 0.0,
+                             "stale_s": round(
+                                 self.now
+                                 - float(self.latest[other].get("t", 0.0)),
+                                 2)},
+                            severity="warn")
+        # shard imbalance: the flag clears as the rates re-balance
+        index, hot = self.load_imbalance()
+        if index is not None:
+            self._raise(("shard.imbalance", "pool"), hot is not None, t,
+                        {"index": index, "hot_shard": hot},
+                        severity="warn")
+
+    def active_alerts(self) -> list[Alert]:
+        return [a for a in self._latched.values() if a is not None]
+
+    # --- reporting -----------------------------------------------------------
+
+    def fleet_summary(self) -> dict:
+        rates = self.ordered_rates()
+        index, hot = self.load_imbalance(rates)
+        healths = {n: self.node_health(n) for n in self.latest}
+        shard_h = self.shard_health(healths)
+        burn = {}
+        for (kind, node), tracker in sorted(self.burn.items()):
+            burn.setdefault(kind, {})[node] = tracker.summary(self.now)
+        return {
+            "t": self.now,
+            "snapshots": self.snapshots,
+            "nodes": {n: {
+                "health": healths[n],
+                "seq": self.latest[n].get("seq"),
+                "shard": self._node_shard.get(n),
+                "anchor_age": self.anchor_age(n),
+            } for n in sorted(self.latest)},
+            "shard_health": {str(k): round(v, 3)
+                             for k, v in sorted(shard_h.items())},
+            "ordered_rates": {str(k): round(v, 2) for k, v in
+                              sorted(rates.items())},
+            "load_imbalance": index,
+            "hot_shard": hot,
+            "staleness": {k: round(v, 2)
+                          for k, v in sorted(self.staleness().items())},
+            "burn": burn,
+            "alerts": [a.to_dict() for a in self.alerts[-50:]],
+            "active_alerts": [a.to_dict() for a in self.active_alerts()],
+        }

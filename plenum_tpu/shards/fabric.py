@@ -176,18 +176,38 @@ class ShardedSimFabric:
         self.fabric_tracer = Tracer(
             "fabric", self.timer.get_current_time,
             clock_domain="shared") if tracing else None
+        # live fleet telemetry: ONE aggregator composes every shard
+        # node's snapshot stream into the pool-wide view — per-shard
+        # health, the load-imbalance index (the input live split/merge
+        # will consume), burn rates. Shard tags ride each node's emitter
+        # so the aggregator can group by shard; alerts land in the
+        # fabric tracer's ring when tracing is on.
+        from plenum_tpu.observability import FleetAggregator
+        self.aggregator = FleetAggregator(
+            config=self.config, tracer=self.fabric_tracer,
+            metrics=self.metrics)
+        for sid, shard in self.shards.items():
+            for node in shard.nodes.values():
+                if node.telemetry.enabled:
+                    node.telemetry.tags = {"shard": sid}
+                    node.telemetry.add_sink(self.aggregator.ingest)
         # raw router (bench/sim writes -> owning shard's client inboxes;
         # every shard node pays its own auth, like the flat baseline) and
         # the behind-ingress router (one front-door auth -> fan to the
         # owning shard's submit_preverified seam)
+        floor = getattr(self.config, "HEALTH_ALERT_FLOOR", 0.5)
         self.router = ShardRouter(
             self.mapping,
             {sid: self._raw_sink(sid) for sid in self.shards},
-            metrics=self.metrics, tracer=self.fabric_tracer)
+            metrics=self.metrics, tracer=self.fabric_tracer,
+            health_provider=self.aggregator.shard_health,
+            degraded_floor=floor)
         self.ingress_router = ShardRouter(
             self.mapping,
             {sid: self._preverified_sink(sid) for sid in self.shards},
-            metrics=self.metrics, tracer=self.fabric_tracer)
+            metrics=self.metrics, tracer=self.fabric_tracer,
+            health_provider=self.aggregator.shard_health,
+            degraded_floor=floor)
         # reply key -> routing key, so read gates know what to prove
         # (re-registered per ladder rung, popped as each reply drains)
         self._pending_keys: dict[tuple, bytes] = {}
@@ -264,6 +284,14 @@ class ShardedSimFabric:
                 self.metrics.add_event(MetricsName.SHARD_ORDERED_BATCHES,
                                        delta)
             self._ordered_emitted[sid] = n
+        # per-shard health + imbalance gauges ride the same poll, so the
+        # `shards` metrics section visibly flags a degraded/hot shard
+        # (signal only — routing policy is unchanged)
+        for health in self.aggregator.shard_health().values():
+            self.metrics.add_event(MetricsName.SHARD_HEALTH, health)
+        index, _hot = self.aggregator.load_imbalance()
+        if index is not None:
+            self.metrics.add_event(MetricsName.SHARD_IMBALANCE, index)
         return counts
 
     # --- cross-shard reads ------------------------------------------------
@@ -326,6 +354,10 @@ class ShardedSimFabric:
             submit, collect, pump or self.run, all_names, bls_keys={},
             now=self.timer.get_current_time, checker=checker,
             shard_resolver=view.nodes_for)
+        # expose the aggregator's live per-shard health on the read
+        # ladder (signal only — the ladder's failover policy is
+        # unchanged): callers can flag reads served from degraded shards
+        driver.shard_health = self.aggregator.shard_health
         tracer = self.fabric_tracer
         if tracer is not None and tracer.enabled:
             from plenum_tpu.common import tracing
@@ -357,12 +389,18 @@ class ShardedSimFabric:
         return out
 
     def summary(self) -> dict:
+        index, hot = self.aggregator.load_imbalance()
         return {
             "shards": len(self.shards),
             "router": self.router.summary(),
             "ingress_router": self.ingress_router.summary(),
             "ordered_per_shard": {sid: s.ordered_count()
                                   for sid, s in self.shards.items()},
+            "shard_health": {sid: round(h, 3) for sid, h in
+                             sorted(self.aggregator.shard_health().items())},
+            "load_imbalance": index,
+            "hot_shard": hot,
+            "alerts": [a.to_dict() for a in self.aggregator.alerts[-20:]],
             **({"pipeline": self.pipeline.summary()}
                if self.pipeline is not None else {}),
         }

@@ -38,13 +38,25 @@ class ShardRouter:
                  metrics: Optional[MetricsCollector] = None,
                  tracer=None,
                  on_unroutable: Optional[Callable[[Request, str, str],
-                                                  None]] = None):
+                                                  None]] = None,
+                 health_provider: Optional[Callable[[], Mapping[int, float]]]
+                 = None,
+                 degraded_floor: float = 0.5):
         from plenum_tpu.common.tracing import NULL_TRACER
         self.mapping = mapping
         self.sinks = dict(sinks)
         self.metrics = metrics or MetricsCollector()
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.on_unroutable = on_unroutable
+        # live per-shard health from the fleet aggregator
+        # (observability/aggregator.py), surfaced through summary() so a
+        # degraded shard is visible at the routing layer — SIGNAL ONLY:
+        # routing decisions ignore it (live re-routing is PR 12's job).
+        # `degraded_floor` matches the aggregator's HEALTH_ALERT_FLOOR:
+        # transient expected churn (a view change at 0.8) must not read
+        # as "degraded" in summaries when it would not alert either
+        self.health_provider = health_provider
+        self.degraded_floor = degraded_floor
         self.stats = {"routed": 0, "unroutable": 0,
                       "per_shard": {sid: 0 for sid in self.sinks}}
 
@@ -77,6 +89,15 @@ class ShardRouter:
         return sid
 
     def summary(self) -> dict:
-        return {"routed": self.stats["routed"],
-                "unroutable": self.stats["unroutable"],
-                "per_shard": dict(self.stats["per_shard"])}
+        out = {"routed": self.stats["routed"],
+               "unroutable": self.stats["unroutable"],
+               "per_shard": dict(self.stats["per_shard"])}
+        if self.health_provider is not None:
+            health = self.health_provider()
+            if health:
+                out["shard_health"] = {sid: round(h, 3)
+                                       for sid, h in sorted(health.items())}
+                out["degraded_shards"] = sorted(
+                    sid for sid, h in health.items()
+                    if h < self.degraded_floor)
+        return out

@@ -1043,6 +1043,105 @@ def config1b_distinct_signers(n_txns: int = 200,
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def config11_telemetry(n_txns: int = 150, timeout: float = 120.0) -> dict:
+    """Telemetry-plane acceptance on the bench line (docs/observability.md
+    "Live fleet telemetry"):
+
+    1. **Overhead A/B** — the SAME 4-node cpu write load with the
+       telemetry plane enabled vs disabled (NULL_TELEMETRY fast path),
+       WARMED and INTERLEAVED medians of 3 per the config5/config8
+       methodology. The budget is the tracing plane's: <=2% (the
+       disabled path is one attribute check, microbench-pinned in
+       tests/test_telemetry.py; this publishes the measured end-to-end
+       figure, which rides the host's single-run noise band).
+    2. **Burn-rate / imbalance columns** — a sim-time 2-shard fabric
+       under a zipfian-hot write mix (90% of writes key into one
+       shard): the aggregator's load-imbalance index must flag the hot
+       shard, and the burn/health summaries ride along.
+    """
+    from plenum_tpu.tools.local_pool import run_load
+
+    try:
+        arms = {"on": {"TELEMETRY": True}, "off": {"TELEMETRY": False}}
+        for ov in arms.values():                 # cold pass: warmup
+            run_load(n_nodes=4, n_txns=40, backend="cpu", timeout=timeout,
+                     config_overrides=ov)
+        # 5 interleaved repeats (vs the usual 3): the expected delta is
+        # ~0 (the emitter works once per TELEMETRY_INTERVAL, not per
+        # txn), so the A/B is measuring inside the host-noise band and
+        # needs the tighter median
+        runs: dict[str, list] = {k: [] for k in arms}
+        for _ in range(5):
+            for k, ov in arms.items():           # interleaved
+                runs[k].append(run_load(n_nodes=4, n_txns=n_txns,
+                                        backend="cpu", timeout=timeout,
+                                        config_overrides=ov))
+
+        def med(rs):
+            good = sorted((r for r in rs if r.get("txns_ordered")),
+                          key=lambda r: r["tps"])
+            return good[len(good) // 2] if good else None
+
+        on, off = med(runs["on"]), med(runs["off"])
+        out: dict = {"n_txns": n_txns}
+        if on is not None and off is not None and off.get("tps"):
+            out["telemetry_on_tps"] = on["tps"]
+            out["telemetry_off_tps"] = off["tps"]
+            out["telemetry_overhead_pct"] = round(
+                100 * (1 - on["tps"] / off["tps"]), 1)
+
+        # hot-shard arm: sim-time fabric, zipfian-hot key mix
+        out.update(_telemetry_hot_shard_arm())
+        return out
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _telemetry_hot_shard_arm(n_txns: int = 120) -> dict:
+    """Deterministic sim-time 2-shard fabric under a 90:10 hot-key skew;
+    -> the aggregator's imbalance/burn/health columns."""
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.config import Config
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import NYM
+    from plenum_tpu.shards import ShardedSimFabric
+
+    fab = ShardedSimFabric(
+        n_shards=2, nodes_per_shard=3, seed=17,
+        config=Config(Max3PCBatchWait=0.05, TELEMETRY_INTERVAL=0.5,
+                      STATE_FRESHNESS_UPDATE_INTERVAL=600.0))
+    by_shard: dict[int, list] = {0: [], 1: []}
+    i = 0
+    while min(len(v) for v in by_shard.values()) < n_txns and i < 8 * n_txns:
+        i += 1
+        user = Ed25519Signer(seed=(b"tz%08d" % i).ljust(32, b"\0")[:32])
+        req = Request(fab.trustee.identifier, i,
+                      {"type": NYM, "dest": user.identifier,
+                       "verkey": user.verkey_b58})
+        req.signature = fab.trustee.sign_b58(req.signing_bytes())
+        sid = fab.router.shard_of(req)
+        if sid in by_shard:
+            by_shard[sid].append(req)
+    hot, cold = by_shard[0], by_shard[1]
+    # 90:10 zipfian-shaped skew onto shard 0
+    for j in range(n_txns):
+        fab.submit_write(hot[j] if j % 10 else cold[j // 10])
+        if j % 16 == 15:
+            fab.run(1.0)
+    fab.run(10.0)
+    fab.ordered_counts()
+    index, hot_sid = fab.aggregator.load_imbalance()
+    s = fab.aggregator.fleet_summary()
+    return {
+        "imbalance_index": index,
+        "hot_shard": hot_sid,
+        "ordered_rates": s["ordered_rates"],
+        "shard_health": s["shard_health"],
+        "burn": {k: v for k, v in s["burn"].items()},
+        "alerts": len(s["alerts"]),
+    }
+
+
 def main():
     for name, fn in (("config1b", config1b_distinct_signers),
                      ("config2", config2_three_instances_mixed),
@@ -1052,7 +1151,8 @@ def main():
                      ("config6", config6_read_plane),
                      ("config7", config7_ingress_10k),
                      ("config8", config8_pipeline_ab),
-                     ("config10", config10_shards)):
+                     ("config10", config10_shards),
+                     ("config11", config11_telemetry)):
         print(name, json.dumps(fn()), flush=True)
 
 

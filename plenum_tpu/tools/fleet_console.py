@@ -1,0 +1,313 @@
+"""Live fleet console: the telemetry plane's operator surface.
+
+Reads node telemetry spools (the rotating ``<node>-telemetry-N.json``
+windows ``observability/snapshot.py`` writes next to each node's data,
+atomic so a live tail never sees a torn file) plus any flight-recorder
+dumps, feeds a :class:`FleetAggregator`, and renders the pool-wide view:
+per-node/per-shard health, ordered rates, the shard load-imbalance
+index, SLO burn rates, active alerts, and cross-node incident timelines.
+
+    python -m plenum_tpu.tools.fleet_console BASE_DIR...
+        [--json] [--watch SECONDS] [--last-n 5]
+    python -m plenum_tpu.tools.fleet_console --check   # tier-1 self-test
+
+``--watch`` re-reads and re-renders every N seconds — the "live text
+dashboard"; a one-shot run renders the spool's current window once.
+``--check`` drives the aggregator through synthetic healthy / overload /
+crypto-fault / hot-shard streams and asserts the judgments the tier-1
+smoke rides on (zero idle alerts, the ingress burn alert, health
+degrade + recovery, the imbalance flag, incident clustering).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+
+def load_spools(paths) -> list[dict]:
+    """Spool files / directories -> snapshots sorted by (t, node, seq).
+    Directories are searched recursively for *-telemetry-*.json."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(glob.glob(
+                os.path.join(p, "**", "*-telemetry-*.json"),
+                recursive=True))
+        elif p.endswith(".json"):
+            files.append(p)
+    snaps = []
+    for f in sorted(files):
+        try:
+            with open(f) as fh:
+                d = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue                 # a rotating slot mid-replace: skip
+        if isinstance(d, dict) and "counters" in d and "node" in d:
+            snaps.append(d)
+    snaps.sort(key=lambda s: (s.get("t", 0.0), s.get("node", ""),
+                              s.get("seq", 0)))
+    return snaps
+
+
+def load_flight_dumps(paths) -> list[dict]:
+    from plenum_tpu.tools.trace_report import load_dumps
+    return load_dumps([p for p in paths if os.path.isdir(p)])
+
+
+def build_view(paths, config=None):
+    """-> (aggregator, incidents) from on-disk artifacts."""
+    from plenum_tpu.observability import (FleetAggregator,
+                                          incident_timelines)
+    agg = FleetAggregator(config=config)
+    for snap in load_spools(paths):
+        agg.ingest(snap)
+    dumps = load_flight_dumps(paths)
+    incidents = incident_timelines(dumps, alerts=agg.alerts) \
+        if (dumps or agg.alerts) else []
+    return agg, incidents
+
+
+def render(agg, incidents, last_n: int = 5) -> str:
+    from plenum_tpu.observability.correlate import format_incidents
+    s = agg.fleet_summary()
+    lines = [f"fleet @ t={s['t']:.2f}  snapshots={s['snapshots']}  "
+             f"nodes={len(s['nodes'])}"]
+    hdr = (f"  {'node':12} {'shard':>5} {'health':>7} {'seq':>6} "
+           f"{'anchor_age':>10}")
+    lines.append(hdr)
+    lines.append("  " + "-" * (len(hdr) - 2))
+    for name, row in s["nodes"].items():
+        h = row["health"]
+        age = row["anchor_age"]
+        lines.append(
+            f"  {name:12} {str(row['shard'] if row['shard'] is not None else '-'):>5} "
+            f"{'-' if h is None else format(h, '.2f'):>7} "
+            f"{str(row['seq'] if row['seq'] is not None else '-'):>6} "
+            f"{'-' if age is None else format(age, '.1f'):>10}")
+    if s["shard_health"]:
+        lines.append(f"  shard health: {s['shard_health']}  "
+                     f"ordered/s: {s['ordered_rates']}")
+    if s["load_imbalance"] is not None:
+        hot = s["hot_shard"]
+        lines.append(f"  load imbalance index: {s['load_imbalance']}"
+                     + (f"  HOT SHARD: {hot}" if hot is not None else ""))
+    if s.get("staleness"):
+        worst = max(s["staleness"].items(), key=lambda kv: kv[1])
+        lines.append(f"  anchor staleness (worst): {worst[0]}="
+                     f"{worst[1]:.1f}s")
+    for kind, per_node in s["burn"].items():
+        burning = {n: b for n, b in per_node.items()
+                   if b["fast"] > 0 or b["slow"] > 0}
+        if burning:
+            lines.append(f"  burn[{kind}]: " + ", ".join(
+                f"{n} fast={b['fast']} slow={b['slow']}"
+                for n, b in sorted(burning.items())))
+    active = s["active_alerts"]
+    lines.append(f"  alerts: {len(active)} active / "
+                 f"{len(s['alerts'])} recent")
+    for a in active[-last_n:]:
+        lines.append(f"    [{a['severity']}] {a['kind']} "
+                     f"{a['subject']}: {json.dumps(a['detail'])}")
+    if incidents:
+        lines.append("  incidents:")
+        for line in format_incidents(incidents, last_n):
+            lines.append(f"    {line}")
+    return "\n".join(lines)
+
+
+# --- the --check self-test ---------------------------------------------------
+
+def _snap(node, seq, t, state, tags=None):
+    return {"v": 1, "node": node, "seq": seq, "t": t,
+            **({"tags": tags} if tags else {}),
+            "counters": {}, "sampled": {}, "state": state}
+
+
+def self_check() -> int:
+    """Synthetic streams through the real aggregator; asserts the
+    judgments the acceptance criteria name. -> process exit code."""
+    from plenum_tpu.config import Config
+    from plenum_tpu.observability import FleetAggregator, incident_timelines
+
+    problems = []
+    config = Config(SLO_BURN_FAST_WINDOW=5.0, SLO_BURN_SLOW_WINDOW=20.0)
+    nodes = ["N1", "N2", "N3", "N4"]
+
+    def healthy(node, seq, t, ordered=0, shard=None, slo=None):
+        state = {"node": {"ordered_total": ordered, "view_no": 0,
+                          "vc_in_progress": False, "catchup_running": False,
+                          "read_only_degraded": False, "validators": 4,
+                          "anchor_age": 1.0}}
+        if slo is not None:
+            state["ingress"] = {"queue_depth": 0, "shedding": False,
+                                "slo": slo}
+        return _snap(node, seq, t, state,
+                     tags={"shard": shard} if shard is not None else None)
+
+    # 1) idle healthy pool: ZERO alerts, health 1.0 everywhere
+    agg = FleetAggregator(config=config)
+    for i in range(30):
+        for n in nodes:
+            agg.ingest(healthy(n, i, i * 1.0, ordered=i,
+                               slo=[0, 5]))
+    if agg.alerts:
+        problems.append(f"idle pool raised alerts: "
+                        f"{[a.to_dict() for a in agg.alerts]}")
+    if any(agg.node_health(n) != 1.0 for n in nodes):
+        problems.append(f"idle pool unhealthy: "
+                        f"{ {n: agg.node_health(n) for n in nodes} }")
+
+    # 2) sustained ingress overload: the burn-rate alert fires on both
+    # windows, then CLEARS after recovery
+    agg2 = FleetAggregator(config=config)
+    t = 0.0
+    for i in range(25):
+        t = i * 1.0
+        agg2.ingest(healthy("N1", i, t, slo=[4, 5] if i >= 5 else [0, 5]))
+    fired = [a for a in agg2.alerts if a.kind == "slo_burn.ingress"
+             and a.severity == "page"]
+    if not fired:
+        problems.append("sustained overload never fired the ingress "
+                        "burn alert")
+    for i in range(25, 60):
+        t = i * 1.0
+        agg2.ingest(healthy("N1", i, t, slo=[0, 5]))
+    cleared = [a for a in agg2.alerts if a.kind == "slo_burn.ingress"
+               and a.severity == "clear"]
+    if fired and not cleared:
+        problems.append("ingress burn alert never cleared after recovery")
+
+    # 3) crypto-plane fault: breaker open + front door shedding degrade
+    # the health score below the floor (warn alert), then recovery clears
+    agg3 = FleetAggregator(config=config)
+    sick = healthy("N1", 0, 0.0)
+    sick["state"]["crypto"] = {"breaker_state": "open"}
+    sick["state"]["ingress"] = {"shedding": True}
+    agg3.ingest(sick)
+    h_sick = agg3.node_health("N1")
+    if h_sick is None or h_sick >= 0.5:
+        problems.append(f"breaker-open health {h_sick} not degraded")
+    if not any(a.kind == "health.node" for a in agg3.alerts):
+        problems.append("degraded health raised no alert")
+    agg3.ingest(healthy("N1", 1, 1.0))
+    if agg3.node_health("N1") != 1.0:
+        problems.append("health did not recover after the fault healed")
+    if not any(a.severity == "clear" and a.kind == "health.node"
+               for a in agg3.alerts):
+        problems.append("health alert never cleared")
+
+    # 4) hot shard: skewed ordered rates flag shard 0
+    agg4 = FleetAggregator(config=config)
+    for i in range(30):
+        t = i * 1.0
+        agg4.ingest(healthy("S0N1", i, t, ordered=i * 50, shard=0))
+        agg4.ingest(healthy("S1N1", i, t, ordered=i * 2, shard=1))
+    index, hot = agg4.load_imbalance()
+    if hot != 0 or index is None or index < config.SHARD_IMBALANCE_THRESHOLD:
+        problems.append(f"hot shard not flagged: index={index} hot={hot}")
+    if not any(a.kind == "shard.imbalance" for a in agg4.alerts):
+        problems.append("imbalance raised no alert")
+
+    # 5) incident clustering: anomalies on two nodes within the gap fold
+    # into ONE incident; a distant one stands alone
+    dumps = [
+        {"node": "A", "clock_domain": "shared", "mono_anchor": 0.0,
+         "wall_anchor": None, "dumped_at": 50.0, "anomalies": 2,
+         "events": [[10.0, "anomaly.suspicion", "", {"code": 1}],
+                    [10.5, "anomaly.view_change_start", "", {}]]},
+        {"node": "B", "clock_domain": "shared", "mono_anchor": 0.0,
+         "wall_anchor": None, "dumped_at": 50.0, "anomalies": 2,
+         "events": [[11.0, "anomaly.view_change_start", "", {}],
+                    [40.0, "anomaly.breaker", "", {"to": "open"}]]},
+    ]
+    incidents = incident_timelines(dumps, gap_s=2.0)
+    if len(incidents) != 2 or incidents[0]["nodes"] != ["A", "B"] \
+            or len(incidents[0]["events"]) != 3:
+        problems.append(f"incident clustering wrong: {incidents}")
+
+    # 6) the renderer survives every view above (smoke, not goldens)
+    try:
+        for a in (agg, agg2, agg3, agg4):
+            render(a, incidents)
+    except Exception as e:
+        problems.append(f"render failed: {type(e).__name__}: {e}")
+
+    print(json.dumps({"check": "ok" if not problems else "FAIL",
+                      "problems": problems}))
+    return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="dirs holding *-telemetry-*.json spools "
+                         "(+ optional flight dumps)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECONDS")
+    ap.add_argument("--last-n", type=int, default=5)
+    ap.add_argument("--config", action="append", default=[],
+                    metavar="NAME=VALUE",
+                    help="Config override (repeatable), e.g. "
+                         "--config SLO_BURN_THRESHOLD=1.2 — the console "
+                         "must judge with the POOL's thresholds, not the "
+                         "defaults, or dashboard and pool disagree")
+    ap.add_argument("--check", action="store_true",
+                    help="run the built-in self-test and exit")
+    args = ap.parse_args(argv)
+    if args.check:
+        return self_check()
+    if not args.paths:
+        ap.error("paths required (or --check)")
+    from plenum_tpu.config import Config
+    overrides = {}
+    for item in args.config:
+        name, _, raw = item.partition("=")
+        if not _:
+            ap.error(f"--config wants NAME=VALUE, got {item!r}")
+        try:
+            overrides[name] = json.loads(raw)
+        except json.JSONDecodeError:
+            overrides[name] = raw
+    config = Config(**overrides)
+    prev_mark = None
+    while True:
+        agg, incidents = build_view(args.paths, config=config)
+        if not agg.latest:
+            print(json.dumps(
+                {"error": f"no telemetry spools under {args.paths}"}))
+            return 1
+        # staleness is judged on the FLEET clock (newest snapshot anyone
+        # sent), which needs at least one live reporter — a whole-pool
+        # outage freezes it, so the console itself watches for a spool
+        # that stopped advancing between refreshes
+        mark = (agg.snapshots, agg.now)
+        spool_idle = args.watch is not None and prev_mark == mark
+        prev_mark = mark
+        if args.json:
+            print(json.dumps({"fleet": agg.fleet_summary(),
+                              "spool_idle": spool_idle,
+                              "incidents": [
+                                  {k: v for k, v in inc.items()
+                                   if k != "events"}
+                                  for inc in incidents[-args.last_n:]]},
+                             default=repr))
+        else:
+            if args.watch:
+                print("\033[2J\033[H", end="")    # clear for the live view
+            print(render(agg, incidents, args.last_n))
+            if spool_idle:
+                print("  WARNING: no new telemetry since the last "
+                      "refresh — the whole fleet may be down (health "
+                      "scores above are last-known, not live)")
+        if not args.watch:
+            return 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

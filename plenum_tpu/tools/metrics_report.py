@@ -61,6 +61,121 @@ def fold_rows(rows: list[tuple[float, str, dict]]) -> dict[str, dict]:
     return out
 
 
+def merge_node_folds(per_node: dict[str, dict[str, dict]]
+                     ) -> dict[str, dict]:
+    """{node: folds} -> ONE pool-wide folds dict.
+
+    Counts/sums add, min/max fold, and — the part that matters for
+    percentiles — the nodes' sampled reservoirs are MERGED (concatenated)
+    so pool p50/p95 is computed over the union of samples. Averaging
+    per-node percentiles is wrong whenever node distributions differ
+    (mean(p95_a, p95_b) is not p95(a ∪ b): two nodes at 1 ms and 100 ms
+    "average" to a 50 ms pool p50 that no request ever saw); each node's
+    reservoir is an unbiased sample of its own stream, so their union is
+    an unbiased sample of the pool stream when streams are comparable in
+    size — and honest about modality either way. Pinned by
+    tests/test_telemetry.py with deliberately diverging nodes."""
+    out: dict[str, dict] = {}
+    for _node, folds in sorted(per_node.items()):
+        for name, agg in folds.items():
+            tgt = out.setdefault(name, {
+                "count": 0, "sum": 0.0, "min": None, "max": None,
+                "first_ts": agg.get("first_ts"),
+                "last_ts": agg.get("last_ts"),
+                "last": None, "flushes": 0})
+            tgt["count"] += agg.get("count", 0)
+            tgt["sum"] += agg.get("sum", 0.0)
+            tgt["flushes"] += agg.get("flushes", 0)
+            for k, pick in (("min", min), ("max", max),
+                            ("first_ts", min), ("last_ts", max)):
+                v = agg.get(k)
+                if v is not None:
+                    tgt[k] = v if tgt[k] is None else pick(tgt[k], v)
+            # "last" keeps the newest node's flush-gauge reading
+            if agg.get("last") is not None and (
+                    tgt["last"] is None
+                    or (agg.get("last_ts") or 0) >= (tgt.get("_last_at")
+                                                     or float("-inf"))):
+                tgt["last"] = agg["last"]
+                tgt["_last_at"] = agg.get("last_ts") or 0
+            if agg.get("samples"):
+                tgt.setdefault("samples", []).extend(agg["samples"])
+    for tgt in out.values():
+        tgt.pop("_last_at", None)
+        tgt["mean"] = tgt["sum"] / tgt["count"] if tgt["count"] else None
+    return out
+
+
+def pool_summary(per_node: dict[str, dict[str, dict]]) -> dict:
+    """Pool-wide derived summary over MERGED folds (see merge_node_folds
+    — pool percentiles come from the union of the nodes' reservoirs,
+    never from averaging per-node percentiles).
+
+    Two classes of figures need more than the merge:
+
+    * the ordered stream is REPLICATED — every node orders the same
+      txns, so merged ordered counts are n_nodes x the pool's real
+      stream; txns_ordered/tps are de-replicated here;
+    * cumulative host gauges (transport bytes, dropped frames) total
+      per NODE — the fleet figure is the SUM of per-node run totals,
+      and per-host gauges (RSS, GC pause) are reported as the WORST
+      node, never as a pool single."""
+    merged = merge_node_folds(per_node)
+    firsts = [f.get("first_ts") for fs in per_node.values()
+              for f in fs.values() if f.get("first_ts") is not None]
+    lasts = [f.get("last_ts") for fs in per_node.values()
+             for f in fs.values() if f.get("last_ts") is not None]
+    span = (max(lasts) - min(firsts)) if firsts and lasts else 0.0
+    out = derive_summary(merged, span)
+    n = len(per_node)
+    out["nodes"] = n
+
+    if n > 1:
+        out["txns_ordered"] = int(out["txns_ordered"] / n)
+        if out.get("tps"):
+            out["tps"] = round(out["tps"] / n, 1)
+        # the division assumes ONE replicated stream across all node
+        # dirs; a base dir spanning shards (different streams per
+        # sub-pool) needs per-shard runs — flag the assumption so the
+        # figure can't be read as shard-aware
+        out["ordered_dedup"] = "assumes one replicated stream " \
+                               "(run per shard for sharded base dirs)"
+
+    def node_cums(name):            # per-node run totals (max = total)
+        vals = [fs.get(name, {}).get("max") for fs in per_node.values()]
+        return [v for v in vals if v is not None]
+
+    for direction in ("tx", "rx"):
+        totals = node_cums(f"transport.{direction}_bytes")
+        if totals:
+            out[f"transport_{direction}_bytes"] = int(sum(totals))
+            if out["txns_ordered"]:
+                out[f"transport_{direction}_bytes_per_txn"] = round(
+                    sum(totals) / out["txns_ordered"])
+    for key, name in (("transport_dropped_frames",
+                       "transport.dropped_frames"),
+                      ("transport_dropped_sessions",
+                       "transport.dropped_sessions")):
+        if key in out:
+            out[key] = int(sum(node_cums(name)))
+    if "propagate_tx_bytes_per_txn" in out and out["txns_ordered"]:
+        prop = sum(node_cums("transport.tx.PROPAGATE")) \
+            + sum(node_cums("transport.tx.PROPAGATE_BATCH"))
+        out["propagate_tx_bytes_per_txn"] = round(
+            prop / out["txns_ordered"])
+    # per-host gauges: one pool figure is meaningless — name the worst
+    for drop, worst_key, vals in (
+            ("rss_mb_last", "rss_mb_max_node",
+             [v / 1e6 for v in node_cums("process.rss_bytes")]),
+            ("gc_pause_s", "gc_pause_s_max_node",
+             node_cums("process.gc_pause_time"))):
+        out.pop(drop, None)
+        if vals:
+            out[worst_key] = round(max(vals), 2)
+    out.pop("gc_pause_pct", None)
+    return out
+
+
 def derive_summary(folds: dict[str, dict], span_s: float,
                    windowed: bool = False) -> dict:
     """Pool-health figures an operator actually asks for."""
@@ -481,9 +596,11 @@ def main(argv=None):
         return 1
 
     all_out = {}
+    per_node_folds: dict[str, dict] = {}
     for p in paths:
         name = os.path.basename(os.path.dirname(p))
         folds, summary = report_node(p, args.last)
+        per_node_folds[name] = folds
         all_out[name] = {"summary": summary,
                          "metrics": {k: {kk: vv for kk, vv in v.items()
                                          if kk in ("count", "mean", "min",
@@ -493,6 +610,16 @@ def main(argv=None):
             print(f"\n=== {name} ===")
             _print_table(folds)
             print("\nderived:", json.dumps(summary, indent=2))
+    if len(per_node_folds) > 1:
+        # pool-wide summary over MERGED folds: counts are fleet totals
+        # (sums across nodes) and percentiles come from the union of the
+        # nodes' sampled reservoirs — never from averaging per-node
+        # percentiles (merge_node_folds)
+        pool = pool_summary(per_node_folds)
+        all_out["_pool"] = {"summary": pool}
+        if not args.json:
+            print(f"\n=== pool ({pool['nodes']} nodes, merged) ===")
+            print(json.dumps(pool, indent=2))
     if args.json:
         print(json.dumps(all_out))
     return 0

@@ -142,6 +142,12 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
     node = Node(name, timer, node_stack.bus, components,
                 client_send=client_stack.send, config=config,
                 metrics=metrics, tracer=tracer)
+    # live fleet telemetry: snapshots spool next to the keys as a
+    # rotating atomic window (<node>/telemetry/<node>-telemetry-N.json)
+    # so tools.fleet_console can follow a live TCP pool from disk
+    # without touching the process
+    if node.telemetry.enabled:
+        node.telemetry.spool_dir = os.path.join(base_dir, name, "telemetry")
     # durable structured event log: every spylog entry (view changes,
     # catchups, suspicions, VC stall phases) appends a JSONL row that
     # tools.log_analyzer turns into per-view timelines. Seeded with the

@@ -640,6 +640,17 @@ def run_client_flood_scenario(seed: int, faulted_plane=None) -> None:
         faulty.set_clock(pool.timer.get_current_time)
     ingress = {n: IngressPlane(pool.nodes[n]) for n in pool.names}
     inbox_peaks: list[int] = []
+    # live telemetry rides the fuzz: every node's snapshots feed ONE
+    # aggregator; the flood below MUST fire the ingress burn-rate alert
+    # (and the healthy pre-flood phase must fire none)
+    from plenum_tpu.observability import FleetAggregator
+    agg = FleetAggregator(config=config)
+    for n in pool.names:
+        pool.nodes[n].telemetry.add_sink(agg.ingest)
+
+    def ingress_burn_pages():
+        return [a for a in agg.alerts
+                if a.kind == "slo_burn.ingress" and a.severity == "page"]
 
     users = [Ed25519Signer(seed=(b"cf%d-%d" % (seed, i)).ljust(32, b"\0")[:32])
              for i in range(2)]
@@ -650,6 +661,8 @@ def run_client_flood_scenario(seed: int, faulted_plane=None) -> None:
     pre = _ingress_order_and_time(pool, ingress, honest[0], 2,
                                   inbox_peaks=inbox_peaks)
     assert pre is not None, f"seed {seed}: healthy plane failed to order"
+    assert not ingress_burn_pages(), \
+        f"seed {seed}: burn alert fired on a healthy plane (false positive)"
 
     if faulted_plane is not None:
         # crypto-plane fault lands BEFORE the flood: the shed storm rides
@@ -700,6 +713,22 @@ def run_client_flood_scenario(seed: int, faulted_plane=None) -> None:
             f"seed {seed}: bad-sig flood never hit the batched verifier"
         assert len(_domain_txns(pool.nodes[pool.names[0]])) == 3, \
             f"seed {seed}: a bad-signature write ordered"
+    # sustain the flood (same hot clients, fresh writes) across several
+    # snapshot intervals: the multi-window rule pages on a shed storm
+    # that PERSISTS on both burn windows (a lone burst is a blip — that
+    # it cannot page is pinned deterministically in test_telemetry), and
+    # the breadth rule counts the capped-client storm against the budget
+    # because MANY distinct clients are being refused, not one abuser
+    for wave in range(6):
+        for client, req in burst_writes(pool.trustee, n_hot, per_client,
+                                        seed=seed * 131 + wave + 1,
+                                        bad_sigs=bad):
+            for n in pool.names:
+                ingress[n].submit(req.to_dict(), client)
+        pool.run(1.0)
+    assert ingress_burn_pages(), \
+        f"seed {seed}: sustained flood never fired the ingress burn " \
+        f"alert (alerts: {[a.to_dict() for a in agg.alerts]})"
     # the pool never wedged: the raw client inbox stayed near-empty the
     # whole run (writes ride ingress, never the inbox)
     assert max(inbox_peaks) <= 10, \
