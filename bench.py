@@ -412,6 +412,23 @@ def main():
                  "telemetry_overhead_pct", "imbalance_index",
                  "hot_shard", "ordered_rates", "shard_health",
                  "burn", "alerts") if c11.get(k) is not None}
+        # elastic resharding acceptance (docs/sharding.md "Elastic
+        # resharding"): a zipfian hot-range load, the imbalance-driven
+        # live split under traffic, and the recovery gate — post-TPS
+        # >= 0.8x pre, imbalance below SHARD_IMBALANCE_THRESHOLD
+        c12 = bc.config12_reshard()
+        if "error" in c12:
+            result["config12_reshard"] = c12["error"]
+        else:
+            result["config12_reshard"] = {
+                k: c12[k] for k in
+                ("pre_tps", "during_tps", "post_tps", "recovery_ratio",
+                 "imbalance_before", "hot_shard_flagged",
+                 "imbalance_after", "imbalance_threshold", "epoch",
+                 "shards_after", "stale_nacks")
+                if c12.get(k) is not None}
+            result["config12_reshard"]["migration_copied"] = \
+                c12["migration"]["copied"]
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
     # fused-pipeline A/B on JAX-ON-CPU — published UNCONDITIONALLY: its

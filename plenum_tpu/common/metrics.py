@@ -115,6 +115,24 @@ class MetricsName:
     # via last/min), plus the plane's own volume counters
     SHARD_HEALTH = "shards.health"
     SHARD_IMBALANCE = "shards.imbalance"
+    # elastic resharding (shards/reshard.py): live split/merge volume,
+    # the copy cursor's replayed txns, handoff-window forwards by the
+    # old owner, and stale-epoch writes NACKed after the window closed
+    RESHARD_MIGRATIONS = "shards.reshard_migrations"
+    RESHARD_COPIED = "shards.reshard_copied"
+    RESHARD_FORWARDED = "shards.reshard_forwarded"
+    RESHARD_STALE_NACKS = "shards.reshard_stale_nacks"
+    # replays abandoned at the handoff hard cap (MUST stay zero in a
+    # healthy migration; nonzero = the target refused moved-range
+    # writes — loud operator alarm, pinned zero by the reshard fuzz)
+    RESHARD_UNSETTLED = "shards.reshard_unsettled"
+    # front door fast-NACKs for writes whose owning shard scores 0.0
+    # health (down) — refused retryable instead of timing out
+    SHARD_FAST_NACKS = "shards.fast_nacks"
+    # proof-carrying cross-shard writes (shards/cross_write.py)
+    XSW_BEGUN = "shards.xsw_begun"
+    XSW_COMMITS = "shards.xsw_commits"
+    XSW_ABORTS = "shards.xsw_aborts"
     TELEMETRY_SNAPSHOTS = "telemetry.snapshots"
     TELEMETRY_ALERTS = "telemetry.alerts"
     TELEMETRY_SOURCE_ERRORS = "telemetry.source_errors"

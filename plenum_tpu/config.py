@@ -220,6 +220,33 @@ class Config:
     HEALTH_ALERT_FLOOR: float = 0.5
     SHARD_IMBALANCE_THRESHOLD: float = 1.5
 
+    # --- elastic resharding (shards/reshard.py) ---
+    # After the mapping epoch ratchets, the OLD owner keeps forwarding
+    # stale-routed writes for the moved range to the new owner for this
+    # long (the bounded dual-ownership handoff window); past it a
+    # stale-epoch write is NACKed fail-closed (retryable after a map
+    # refresh) instead of silently double-owned forever
+    RESHARD_HANDOFF_WINDOW: float = 10.0
+    # migrated txns replayed into the target sub-pool per service tick —
+    # bounds how much of a prod cycle the copy cursor may consume
+    RESHARD_COPY_BATCH: int = 64
+    # the copy phase must reach the source tip and the target must order
+    # the whole moved prefix within this budget or the migration ABORTS
+    # (descriptors unchanged, source keeps ownership — fail closed)
+    RESHARD_COPY_TIMEOUT: float = 120.0
+
+    # --- proof-carrying cross-shard writes (shards/cross_write.py) ---
+    # participant lock TTL: a remote shard holding a lock with no
+    # anchored decision from the coordinator resolves (verified read of
+    # the decision record) after this long, and aborts fail-closed on a
+    # proven absence. MUST comfortably exceed XSW_PREPARE_TTL: the
+    # coordinator refuses to order a commit past its prepare deadline,
+    # which is what makes the participant's absence-abort safe
+    XSW_LOCK_TTL: float = 20.0
+    # coordinator prepare TTL: past it the coordinator (or its shard's
+    # recovery sweep) orders an ABORT decision and never a commit
+    XSW_PREPARE_TTL: float = 8.0
+
     # --- blacklisting (TTL: self-isolation must heal; see blacklister.py) ---
     BLACKLIST_TTL: float = 120.0
     CatchupTransactionsTimeout: float = 6.0

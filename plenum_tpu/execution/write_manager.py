@@ -309,6 +309,20 @@ class WriteRequestManager:
             handler.update_state(txn, is_committed=committed)
             if committed:
                 state.commit(state.head_hash)
+        if committed:
+            # the ordinary commit path records every txn in the seq-no
+            # DB (request dedup / executed-Reply lookup); a txn arriving
+            # via catchup must land there too, or the caught-up node
+            # NEVER serves dedup replies for it — a client (or a reshard
+            # copy cursor) probing that node re-propagates a write the
+            # pool already ordered
+            seq_no_db = self.db.get_store(SEQ_NO_DB_LABEL)
+            pd = txn_lib.txn_payload_digest(txn)
+            if seq_no_db is not None and pd and \
+                    txn_lib.txn_seq_no(txn) is not None:
+                seq_no_db.put(pd.encode(),
+                              pack((ledger_id, txn_lib.txn_seq_no(txn),
+                                    txn_lib.txn_time(txn))))
 
     def _last_uncommitted_audit(self, audit_ledger) -> Optional[dict]:
         staged = audit_ledger.uncommitted_txns

@@ -215,6 +215,19 @@ class FleetAggregator:
                 tracker.note(t, slo[0], slo[1])
         self._evaluate(node, t)
 
+    def forget_node(self, node: str) -> None:
+        """Remove a DECOMMISSIONED node from the fleet view (a retired
+        shard after a merge): it must read as gone, not as a 0.0-health
+        page — the staleness sweep only judges nodes still enrolled."""
+        self.latest.pop(node, None)
+        self._node_t.pop(node, None)
+        self._ordered.pop(node, None)
+        self._node_shard.pop(node, None)
+        for key in [k for k in self.burn if k[1] == node]:
+            del self.burn[key]
+        for key in [k for k in self._latched if k[1] == node]:
+            self._latched[key] = None
+
     # --- judgments ---------------------------------------------------------
 
     def _flags(self, snap: dict) -> dict:
@@ -315,6 +328,43 @@ class FleetAggregator:
         threshold = getattr(self.config, "SHARD_IMBALANCE_THRESHOLD", 1.5)
         return round(index, 3), (hot_sid if index >= threshold else None)
 
+    def mapping_epochs(self) -> dict[int, int]:
+        """shard id -> the MIN mapping epoch its members report (the
+        `shard_map` telemetry state section) — the laggard is what an
+        operator watching a reshard converge needs to see."""
+        out: dict[int, int] = {}
+        for node, snap in self.latest.items():
+            sid = self._node_shard.get(node)
+            epoch = snap.get("state", {}).get("shard_map", {}).get("epoch")
+            if sid is None or epoch is None:
+                continue
+            out[sid] = min(out.get(sid, 1 << 30), int(epoch))
+        return out
+
+    def migrations(self) -> dict[int, dict]:
+        """shard id -> live migration {role, phase, progress} from the
+        shard's FRESHEST shard_map report (empty when nothing moves).
+        A fresher member snapshot WITHOUT a migration clears the
+        shard's entry — a node that crashed mid-migration must not pin
+        a phantom 'copying@40%' on the console forever."""
+        out: dict[int, dict] = {}
+        best_t: dict[int, float] = {}
+        for node, snap in self.latest.items():
+            sid = self._node_shard.get(node)
+            shard_map = snap.get("state", {}).get("shard_map")
+            if sid is None or shard_map is None:
+                continue
+            t = float(snap.get("t", 0.0))
+            if t < best_t.get(sid, -1.0):
+                continue
+            best_t[sid] = t
+            mig = shard_map.get("migration")
+            if mig:
+                out[sid] = dict(mig)
+            else:
+                out.pop(sid, None)
+        return out
+
     def staleness(self) -> dict[str, float]:
         """node (or region, with a region_of map) -> newest anchor age."""
         out: dict[str, float] = {}
@@ -413,6 +463,10 @@ class FleetAggregator:
             } for n in sorted(self.latest)},
             "shard_health": {str(k): round(v, 3)
                              for k, v in sorted(shard_h.items())},
+            "mapping_epochs": {str(k): v for k, v in
+                               sorted(self.mapping_epochs().items())},
+            "migrations": {str(k): v for k, v in
+                           sorted(self.migrations().items())},
             "ordered_rates": {str(k): round(v, 2) for k, v in
                               sorted(rates.items())},
             "load_imbalance": index,

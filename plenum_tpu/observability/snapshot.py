@@ -124,6 +124,12 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
         MetricsName.SHARD_MAP_PROOF_FAILURES,
         MetricsName.SHARD_CROSS_VERIFY_TIME,
         MetricsName.SHARD_HEALTH, MetricsName.SHARD_IMBALANCE,
+        MetricsName.RESHARD_MIGRATIONS, MetricsName.RESHARD_COPIED,
+        MetricsName.RESHARD_FORWARDED, MetricsName.RESHARD_STALE_NACKS,
+        MetricsName.RESHARD_UNSETTLED,
+        MetricsName.SHARD_FAST_NACKS,
+        MetricsName.XSW_BEGUN, MetricsName.XSW_COMMITS,
+        MetricsName.XSW_ABORTS,
     }),
     "robustness": frozenset({
         MetricsName.VC_DURATION, MetricsName.CATCHUP_DURATION,

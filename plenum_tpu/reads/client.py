@@ -45,6 +45,10 @@ class ReadClientStats:
         # the ladder to a validator (anchor lag / unanchorable replica)
         self.observer_ok = 0
         self.observer_escalations = 0
+        # sharded-plane ladder: reads that refreshed the client's map
+        # view and retried once against the new epoch (a healthy reshard
+        # in flight must not surface as a client error)
+        self.map_retries = 0
         self.verify_s: list[float] = []
 
     def note_verify(self, dt: float) -> None:
@@ -63,6 +67,8 @@ class ReadClientStats:
         if self.observer_ok or self.observer_escalations:
             out["observer_ok"] = self.observer_ok
             out["observer_escalations"] = self.observer_escalations
+        if self.map_retries:
+            out["map_retries"] = self.map_retries
         if self.reads:
             out["fanout"] = round(
                 (self.msgs_sent + self.replies_seen) / self.reads, 2)
@@ -133,7 +139,8 @@ class VerifyingReadClient(PoolClient):
                  checker=None,
                  shard_resolver: Optional[Callable[[Request],
                                                    Optional[Sequence[str]]]]
-                 = None):
+                 = None,
+                 map_refresh: Optional[Callable[[], bool]] = None):
         super().__init__(node_addrs, f)
         self.observer_addrs = dict(observer_addrs or {})
         self._all_addrs = {**self.observer_addrs, **self.node_addrs}
@@ -149,6 +156,12 @@ class VerifyingReadClient(PoolClient):
         # nodes don't hold the key and a "verified" answer from one
         # (absence against ITS root) would be a wrong-shard lie
         self.shard_resolver = shard_resolver
+        # map_refresh() -> True when the client's shard map view
+        # advanced to a newer epoch. A read that fails with a stale_map
+        # verdict (or exhausts its ladder) refreshes and retries ONCE
+        # against the new routing — a healthy reshard in flight must
+        # not surface as a terminal read failure.
+        self.map_refresh = map_refresh
         self.stats = self.checker.stats
 
     def _addr_of(self, name: str) -> tuple:
@@ -161,14 +174,52 @@ class VerifyingReadClient(PoolClient):
         """-> the verified REPLY dict (or the legacy f+1-agreed reply
         after escalation). Raises TimeoutError when every rung fails."""
         self.stats.reads += 1
-        data = pack(request.to_dict())
-        req_key = (request.identifier, request.req_id)
+        for attempt in (0, 1):
+            msg = await self._walk_ladder(request, per_node_timeout)
+            if msg is not None:
+                return msg
+            # ladder exhausted (or cut short by a stale_map verdict):
+            # refresh the map view and retry ONCE iff the epoch moved —
+            # the owning shard may have changed under a live reshard
+            if attempt or self.map_refresh is None or \
+                    not self.map_refresh():
+                break
+            self.stats.map_retries += 1
+        shard_nodes = self._shard_ladder(request)
+        # escalation: the legacy f+1 matching-reply broadcast — reached
+        # when the pool cannot anchor proofs yet or every proof-bearing
+        # rung lied/timed out; either way the quorum path stays sound
+        # (f+1 CONTENT-matching replies). A sharded read broadcasts to
+        # the OWNING shard only — its quorum lives there
+        self.stats.fallbacks += 1
+        if shard_nodes is not None and not shard_nodes:
+            # the owning shard is known but none of its nodes are
+            # dialable: broadcasting to FOREIGN nodes could only "agree"
+            # on absence against the wrong root — fail closed instead
+            raise TimeoutError("no reachable node of the owning shard")
+        targets = list(shard_nodes) if shard_nodes else list(self.node_addrs)
+        msg = await self.submit(request, timeout, to=targets)
+        self.stats.msgs_sent += len(targets)
+        self.stats.replies_seen += len(targets)
+        return msg
+
+    def _shard_ladder(self, request: Request) -> Optional[list]:
         shard_nodes = self.shard_resolver(request) \
             if self.shard_resolver is not None else None
+        if shard_nodes is None:
+            return None
+        return [n for n in shard_nodes if n in self.node_addrs]
+
+    async def _walk_ladder(self, request: Request,
+                           per_node_timeout: float) -> Optional[dict]:
+        """One pass down the failover ladder; -> the verified reply, or
+        None when every rung failed (caller refreshes/escalates)."""
+        data = pack(request.to_dict())
+        req_key = (request.identifier, request.req_id)
+        shard_nodes = self._shard_ladder(request)
         if shard_nodes is not None:
             # owning-shard ladder: fail over WITHIN the shard first; the
             # observer tier is skipped (observers anchor one flat pool)
-            shard_nodes = [n for n in shard_nodes if n in self.node_addrs]
             ladder = ladder_order(shard_nodes, request)
         else:
             ladder = (ladder_order(list(self.observer_addrs), request)
@@ -192,6 +243,13 @@ class VerifyingReadClient(PoolClient):
                 if name in self.observer_addrs:
                     self.stats.observer_ok += 1
                 return msg
+            if reason == "stale_map" and self.map_refresh is not None:
+                # the answering node served a superseded map: cut
+                # straight to the refresh-and-retry path. WITHOUT a
+                # refresh hook, keep walking — another rung of the same
+                # shard may already serve the current epoch, and a
+                # verified single reply beats the broadcast fallback
+                return None
             if reason == proofs.NO_PROOF:
                 if name in self.observer_addrs:
                     # anchor-lagged observer escalates to the next rung
@@ -199,22 +257,7 @@ class VerifyingReadClient(PoolClient):
                     self.stats.observer_escalations += 1
                     continue
                 break                    # pool can't prove: broadcast
-        # escalation: the legacy f+1 matching-reply broadcast — reached
-        # when the pool cannot anchor proofs yet or every proof-bearing
-        # rung lied/timed out; either way the quorum path stays sound
-        # (f+1 CONTENT-matching replies). A sharded read broadcasts to
-        # the OWNING shard only — its quorum lives there
-        self.stats.fallbacks += 1
-        if shard_nodes is not None and not shard_nodes:
-            # the owning shard is known but none of its nodes are
-            # dialable: broadcasting to FOREIGN nodes could only "agree"
-            # on absence against the wrong root — fail closed instead
-            raise TimeoutError("no reachable node of the owning shard")
-        targets = list(shard_nodes) if shard_nodes else list(self.node_addrs)
-        msg = await self.submit(request, timeout, to=targets)
-        self.stats.msgs_sent += len(targets)
-        self.stats.replies_seen += len(targets)
-        return msg
+        return None
 
 
 class SimReadDriver:
@@ -237,7 +280,8 @@ class SimReadDriver:
                  checker=None,
                  shard_resolver: Optional[Callable[[Request],
                                                    Optional[Sequence[str]]]]
-                 = None):
+                 = None,
+                 map_refresh: Optional[Callable[[], bool]] = None):
         self._submit = submit
         self._collect = collect
         self._pump = pump
@@ -251,6 +295,10 @@ class SimReadDriver:
             bls_keys, freshness_s=freshness_s, now=now,
             n_nodes=len(node_names))
         self.shard_resolver = shard_resolver
+        # stale_map / exhausted ladder -> refresh the map view and retry
+        # once against the new epoch (VerifyingReadClient documents the
+        # contract): a healthy reshard must not error client reads
+        self.map_refresh = map_refresh
         self.stats = self.checker.stats
 
     def read(self, request: Request, per_node_s: float = 1.0,
@@ -259,6 +307,23 @@ class SimReadDriver:
         """-> the verified result dict, or None when every rung failed
         (caller escalates to its own broadcast path)."""
         self.stats.reads += 1
+        for attempt in (0, 1):
+            result = self._walk_ladder(request, per_node_s, step_s,
+                                       order if attempt == 0 else None)
+            if result is not None:
+                return result
+            # an explicit caller-built order is the caller's routing
+            # decision — never second-guessed by a refresh
+            if attempt or order is not None or self.map_refresh is None \
+                    or not self.map_refresh():
+                break
+            self.stats.map_retries += 1
+        self.stats.fallbacks += 1
+        return None
+
+    def _walk_ladder(self, request: Request, per_node_s: float,
+                     step_s: float, order: Optional[Sequence[str]]
+                     ) -> Optional[dict]:
         if order is None:
             shard_nodes = self.shard_resolver(request) \
                 if self.shard_resolver is not None else None
@@ -290,12 +355,17 @@ class SimReadDriver:
                 if name in observers:
                     self.stats.observer_ok += 1
                 return result
+            if reason == "stale_map" and self.map_refresh is not None:
+                # the answering node served a superseded map: cut to
+                # the refresh-and-retry path. Without a refresh hook,
+                # keep walking — another rung may serve the current
+                # epoch (VerifyingReadClient documents the contract)
+                return None
             if reason == proofs.NO_PROOF:
                 if name in observers:
                     self.stats.observer_escalations += 1
                     continue             # a validator can still prove
                 break
-        self.stats.fallbacks += 1
         return None
 
     def _await_reply(self, name: str, request: Request, per_node_s: float,

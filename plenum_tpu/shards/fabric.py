@@ -135,6 +135,13 @@ class ShardedSimFabric:
         self.timer = timer if timer is not None else MockTimer()
         self.config = config or Config(Max3PCBatchWait=0.05)
         self.metrics = MetricsCollector()
+        # live-reshard bookkeeping: the boot parameters a split-off
+        # shard is built with, and where merged-away sub-pools go
+        self.seed = seed
+        self.nodes_per_shard = nodes_per_shard
+        self.latency = latency
+        self.tracing = tracing
+        self.retired: dict[int, SimShard] = {}
         self.pipeline = None
         if share_pipeline:
             # ONE submission ring for every co-hosted shard: client-auth
@@ -145,6 +152,10 @@ class ShardedSimFabric:
             self.pipeline = CryptoPipeline(ed_inner=CpuEd25519Verifier(),
                                            config=self.config)
         self.shards: dict[int, SimShard] = {}
+        # kept for live splits: a pre-registered verifier for a future
+        # shard id (add_shard looks the new sid up here, so a split
+        # target can join the same faultable crypto plane)
+        self.shard_verifiers = dict(shard_verifiers or {})
         for sid in range(n_shards):
             # shard_verifiers: {sid: shared crypto plane} — the seam the
             # shard-confined device_flap fuzz faults ONE shard through
@@ -152,7 +163,7 @@ class ShardedSimFabric:
                              self.timer, seed * 1009 + sid * 7919 + 3,
                              self.config, pipeline=self.pipeline,
                              tracing=tracing,
-                             verifier=(shard_verifiers or {}).get(sid))
+                             verifier=self.shard_verifiers.get(sid))
             if latency is not None:
                 shard.net.set_latency(*latency)
             self.shards[sid] = shard
@@ -187,10 +198,7 @@ class ShardedSimFabric:
             config=self.config, tracer=self.fabric_tracer,
             metrics=self.metrics)
         for sid, shard in self.shards.items():
-            for node in shard.nodes.values():
-                if node.telemetry.enabled:
-                    node.telemetry.tags = {"shard": sid}
-                    node.telemetry.add_sink(self.aggregator.ingest)
+            self._wire_shard_telemetry(sid, shard)
         # raw router (bench/sim writes -> owning shard's client inboxes;
         # every shard node pays its own auth, like the flat baseline) and
         # the behind-ingress router (one front-door auth -> fan to the
@@ -212,6 +220,14 @@ class ShardedSimFabric:
         # (re-registered per ladder rung, popped as each reply drains)
         self._pending_keys: dict[tuple, bytes] = {}
         self._ordered_emitted: dict[int, int] = {}
+        # live split/merge (reshard.py): migrations run as mapping-ledger
+        # transactions driven from the prod loop; every shard intake is
+        # guarded so a stale routing decision racing the ratchet is
+        # forwarded (inside the handoff window) or NACKed fail-closed
+        from .reshard import ReshardManager
+        self.reshard = ReshardManager(self)
+        self.stale_nacks: list = []
+        self._xsw = None
 
     @property
     def nodes(self) -> dict:
@@ -222,40 +238,156 @@ class ShardedSimFabric:
 
     # --- sinks ------------------------------------------------------------
 
+    def _shard_of(self, sid: int) -> "SimShard":
+        shard = self.shards.get(sid)
+        return shard if shard is not None else self.retired[sid]
+
+    def _guarded(self, sid: int, request: Request, frm: str) -> bool:
+        """The reshard intake guard: True = the caller should deliver to
+        `sid`; False = the guard already forwarded the write to its new
+        owner or NACKed it fail-closed (shards/reshard.py)."""
+        reshard = getattr(self, "reshard", None)
+        if reshard is None:
+            return True
+        verdict = reshard.guard(sid, request, frm)
+        if verdict == "stale":
+            self._nack_stale(request, frm)
+        return verdict is None
+
+    def _nack_stale(self, request: Request, frm: str) -> None:
+        """A stale-epoch write past the handoff window: an explicit
+        retryable refusal (the sim twin of the front door's NACK) —
+        recorded on the fabric, never a silent drop."""
+        from plenum_tpu.common.node_messages import RequestNack
+        from .reshard import STALE_WRITE_NACK
+        self.stale_nacks.append(
+            RequestNack(identifier=request.identifier,
+                        req_id=request.req_id,
+                        reason=STALE_WRITE_NACK))
+
+    def deliver_to_shard(self, sid: int, request: Request,
+                         frm: str) -> None:
+        """Raw delivery used by the handoff forwarder — bypasses the
+        guard (the target IS the new owner)."""
+        self._shard_of(sid).submit(request, client=frm)
+
     def _raw_sink(self, sid: int):
         def sink(request: Request, frm: str) -> None:
-            self.shards[sid].submit(request, client=frm)
+            if self._guarded(sid, request, frm):
+                self._shard_of(sid).submit(request, client=frm)
         return sink
 
     def _preverified_sink(self, sid: int):
         def sink(request: Request, frm: str) -> None:
-            for name in self.shards[sid].names:
-                self.shards[sid].nodes[name].submit_preverified(request, frm)
+            if not self._guarded(sid, request, frm):
+                return
+            shard = self._shard_of(sid)
+            for name in shard.names:
+                shard.nodes[name].submit_preverified(request, frm)
         return sink
+
+    # --- elastic membership (reshard.py drives these) -----------------------
+
+    def _wire_shard_telemetry(self, sid: int, shard: "SimShard") -> None:
+        for node in shard.nodes.values():
+            if node.telemetry.enabled:
+                node.telemetry.tags = {"shard": sid}
+                node.telemetry.add_sink(self.aggregator.ingest)
+                # the per-shard mapping-epoch + migration-progress state
+                # section the fleet console renders (satellite: watch a
+                # reshard converge live)
+                node.telemetry.add_source(
+                    "shard_map",
+                    lambda s=sid: self.reshard.state_for(s)
+                    if getattr(self, "reshard", None) is not None else {})
+
+    def add_shard(self, sid: int,
+                  nodes_per_shard: Optional[int] = None,
+                  verifier=None) -> "SimShard":
+        """Boot a fresh sub-pool mid-run (the split target). It joins
+        the fabric's routers and telemetry immediately; it joins the
+        MAP only when the migration ratchets the epoch. The new shard
+        shares the fabric's pipeline and any verifier pre-registered
+        for its sid in `shard_verifiers` (or passed here), so a split
+        target is not silently outside the configured crypto plane."""
+        n = nodes_per_shard or self.nodes_per_shard
+        shard = SimShard(sid, shard_node_names(sid, n), self.timer,
+                         self.seed * 1009 + sid * 7919 + 3, self.config,
+                         pipeline=self.pipeline, tracing=self.tracing,
+                         verifier=verifier
+                         or self.shard_verifiers.get(sid))
+        if self.latency is not None:
+            shard.net.set_latency(*self.latency)
+        self.shards[sid] = shard
+        for name in shard.names:
+            self.node_shard[name] = sid
+        self.gates[sid] = ShardReadGate(self.mapping)
+        self._wire_shard_telemetry(sid, shard)
+        self.router.add_sink(sid, self._raw_sink(sid))
+        self.ingress_router.add_sink(sid, self._preverified_sink(sid))
+        return shard
+
+    def retire_shard(self, sid: int) -> None:
+        """Decommission a merged-away (or abandoned split) sub-pool: it
+        stops being prodded, leaves both routers, and is FORGOTTEN by
+        the aggregator — a decommissioned node must read as gone, not
+        as a 0.0-health page."""
+        shard = self.shards.pop(sid, None)
+        if shard is None:
+            return
+        self.retired[sid] = shard
+        self.router.remove_sink(sid)
+        self.ingress_router.remove_sink(sid)
+        for name, node in shard.nodes.items():
+            if node.telemetry.enabled:
+                node.telemetry.stop()
+            self.aggregator.forget_node(name)
 
     def ingress_plane(self, entry_node: str, **kw):
         """An entry front door whose verified writes route ACROSS shards
-        instead of into the entry node's own pipeline."""
-        from plenum_tpu.common.node_messages import RequestNack
+        instead of into the entry node's own pipeline. A write whose
+        owning shard scores 0.0 health (DOWN by the aggregator's
+        staleness rule) is fast-NACKed with a retryable LoadShed hint
+        instead of timing out against a dead sub-pool."""
+        from plenum_tpu.common.node_messages import LoadShed, RequestNack
         from plenum_tpu.ingress import IngressPlane
         node = self.shards[self.node_shard[entry_node]].nodes[entry_node]
+
+        def shard_down(request: Request, frm: str, sid: int) -> None:
+            # passed PER CALL so every front door answers through ITS
+            # OWN client channel — several planes share one router
+            node._client_send(LoadShed(
+                identifier=request.identifier, req_id=request.req_id,
+                reason=f"owning shard {sid} unavailable",
+                retry_after=self.config.INGRESS_TICK_INTERVAL * 10), frm)
 
         def sink(request: Request, frm: str) -> None:
             # an admitted, auth-verified write the map cannot place
             # NACKs through the front door, never black-holes — the
             # client must not wait out its reply timeout (router.py)
-            if self.ingress_router.route(request, frm) is None:
+            if self.ingress_router.route(
+                    request, frm, on_shard_down=shard_down) is None and \
+                    self.ingress_router.shard_of(request) is None:
                 node._client_send(RequestNack(
                     identifier=request.identifier, req_id=request.req_id,
                     reason="no shard owns this key"), frm)
 
         return IngressPlane(node, sink=sink, **kw)
 
+    def cross_writes(self):
+        """The fabric's proof-carrying cross-shard write manager
+        (shards/cross_write.py), created on first use."""
+        if self._xsw is None:
+            from .cross_write import CrossShardWrites
+            self._xsw = CrossShardWrites(self)
+        return self._xsw
+
     # --- driving ----------------------------------------------------------
 
     def prod_all(self) -> None:
         self.timer.service()
-        for shard in self.shards.values():
+        self.reshard.service()
+        for shard in list(self.shards.values()):
             shard.prod()
 
     def run(self, seconds: float = 5.0, step: float = 0.1) -> None:
@@ -263,7 +395,8 @@ class ShardedSimFabric:
         `prod_all` against the wall clock instead (bench_configs)."""
         elapsed = 0.0
         while elapsed < seconds:
-            for shard in self.shards.values():
+            self.reshard.service()
+            for shard in list(self.shards.values()):
                 shard.prod()
             self.timer.advance(step)
             elapsed += step
@@ -326,12 +459,15 @@ class ShardedSimFabric:
             except ValueError:
                 pass
             sid = self.node_shard[name]
-            self.shards[sid].nodes[name].handle_client_message(
+            # a retired (merged-away) node still accepts the message but
+            # is never prodded: the rung times out and the ladder's map
+            # refresh re-routes to the live owner
+            self._shard_of(sid).nodes[name].handle_client_message(
                 request.to_dict(), client)
 
         def collect(name):
             sid = self.node_shard[name]
-            shard = self.shards[sid]
+            shard = self._shard_of(sid)
             msgs = shard.client_msgs[name]
             out = []
             keep = []
@@ -354,6 +490,21 @@ class ShardedSimFabric:
             submit, collect, pump or self.run, all_names, bls_keys={},
             now=self.timer.get_current_time, checker=checker,
             shard_resolver=view.nodes_for)
+
+        def map_refresh() -> bool:
+            """Re-sync the client's routing view from the mapping
+            ledger; True when the epoch advanced (the ladder retries
+            once against the new owner instead of erroring — clients
+            must not fail during a healthy reshard). The node roster
+            refreshes too: a split's new sub-pool postdates the driver."""
+            before = view.min_epoch
+            view.refresh(self.mapping)
+            checker.note_epoch(view.min_epoch)
+            driver.node_names = [n for s in self.shards.values()
+                                 for n in s.names]
+            return view.min_epoch > before
+
+        driver.map_refresh = map_refresh
         # expose the aggregator's live per-shard health on the read
         # ladder (signal only — the ladder's failover policy is
         # unchanged): callers can flag reads served from degraded shards
@@ -400,6 +551,10 @@ class ShardedSimFabric:
                              sorted(self.aggregator.shard_health().items())},
             "load_imbalance": index,
             "hot_shard": hot,
+            "reshard": self.reshard.summary(),
+            "stale_nacks": len(self.stale_nacks),
+            **({"cross_writes": self._xsw.summary()}
+               if self._xsw is not None else {}),
             "alerts": [a.to_dict() for a in self.aggregator.alerts[-20:]],
             **({"pipeline": self.pipeline.summary()}
                if self.pipeline is not None else {}),

@@ -122,6 +122,20 @@ def equal_ranges(n_shards: int) -> list[tuple[str, Optional[str]]]:
     return out
 
 
+def range_midpoint(lo: str, hi: Optional[str]) -> str:
+    """The split point of [lo, hi): the numeric midpoint as a full-width
+    hex string (hi=None means the top of the sha256 space)."""
+    lo_i = int(lo, 16)
+    hi_i = (1 << 256) if hi is None else int(hi, 16)
+    assert hi_i > lo_i + 1, "range too narrow to split"
+    return f"{(lo_i + hi_i) // 2:064x}"
+
+
+def ranges_adjacent(a: ShardDescriptor, b: ShardDescriptor) -> bool:
+    """True when a's range ends exactly where b's begins."""
+    return a.hi is not None and a.hi == b.lo
+
+
 class MappingLedger:
     """Directory-side: holds descriptors, anchors each epoch's tree.
 
@@ -172,12 +186,24 @@ class MappingLedger:
         return self._ms
 
     def reshard(self, descriptors: Sequence[ShardDescriptor]) -> None:
-        """Install a new map under a bumped epoch (the future resharding
-        entry point; today's callers are the stale-map fuzz rungs)."""
+        """Install a new map under a bumped epoch — the resharding
+        commit point: the instant this publishes, proofs minted under
+        the superseded map are STALE for every ratcheted verifier."""
         self.epoch += 1
         for d in descriptors:
             d.epoch = self.epoch
         self.descriptors = list(descriptors)
+        self.publish()
+
+    def rotate_signer(self, name: str, new_signer) -> None:
+        """Replace one directory-committee member's signing key and
+        re-sign the current map root under the new committee. Proofs
+        minted under the OLD committee fail `bad_map_multi_sig` against
+        any verifier holding the rotated trust root — the directory twin
+        of the pool-BLS rotation the membership_churn fuzz exercises."""
+        if name not in self.signers:
+            raise KeyError(f"{name} is not a directory signer")
+        self.signers[name] = new_signer
         self.publish()
 
     def shard_of(self, key: bytes) -> ShardDescriptor:

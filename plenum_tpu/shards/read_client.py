@@ -158,6 +158,11 @@ class CrossShardReadCheck:
             key, proof, self.directory_keys, n_directory=self.n_directory,
             min_epoch=self.min_epoch, freshness_s=self.map_freshness_s,
             now=self.now, ms_cache=self._map_ms_cache)
+        if desc is not None and desc.epoch > self.min_epoch:
+            # a VERIFIED proof citing a newer epoch ratchets the client:
+            # having seen epoch e, it never accepts an older map again
+            # (the fail-closed half of resharding, mapping.py)
+            self.min_epoch = desc.epoch
         if desc is None:
             # a missing/forged/stale ownership proof is an AFFIRMATIVE
             # failure (fail closed -> fail over within the shard), never

@@ -41,13 +41,22 @@ class ShardRouter:
                                                   None]] = None,
                  health_provider: Optional[Callable[[], Mapping[int, float]]]
                  = None,
-                 degraded_floor: float = 0.5):
+                 degraded_floor: float = 0.5,
+                 on_shard_down: Optional[Callable[[Request, str, int],
+                                                  None]] = None):
         from plenum_tpu.common.tracing import NULL_TRACER
         self.mapping = mapping
         self.sinks = dict(sinks)
         self.metrics = metrics or MetricsCollector()
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.on_unroutable = on_unroutable
+        # fast-NACK seam: when the fleet aggregator scores the owning
+        # shard 0.0 (DOWN — every member silent past the staleness
+        # bound), a wired front door refuses the write immediately with
+        # a RETRYABLE hint instead of letting the client time out
+        # against a dead sub-pool. None (the default) keeps routing
+        # un-gated: health stays signal-only, exactly as before.
+        self.on_shard_down = on_shard_down
         # live per-shard health from the fleet aggregator
         # (observability/aggregator.py), surfaced through summary() so a
         # degraded shard is visible at the routing layer — SIGNAL ONLY:
@@ -57,8 +66,19 @@ class ShardRouter:
         # as "degraded" in summaries when it would not alert either
         self.health_provider = health_provider
         self.degraded_floor = degraded_floor
-        self.stats = {"routed": 0, "unroutable": 0,
+        self.stats = {"routed": 0, "unroutable": 0, "fast_nacked": 0,
                       "per_shard": {sid: 0 for sid in self.sinks}}
+
+    def add_sink(self, sid: int,
+                 sink: Callable[[Request, str], None]) -> None:
+        """Register a freshly split-off shard's intake (live reshard)."""
+        self.sinks[sid] = sink
+        self.stats["per_shard"].setdefault(sid, 0)
+
+    def remove_sink(self, sid: int) -> None:
+        """Retire a merged-away shard's intake; its traffic history
+        stays in per_shard for the report."""
+        self.sinks.pop(sid, None)
 
     def shard_of(self, request: Request) -> Optional[int]:
         try:
@@ -67,10 +87,17 @@ class ShardRouter:
         except Exception:
             return None
 
-    def route(self, request: Request, frm: str) -> Optional[int]:
+    def route(self, request: Request, frm: str,
+              on_shard_down: Optional[Callable[[Request, str, int],
+                                               None]] = None
+              ) -> Optional[int]:
         """-> the shard id the write went to, or None (unroutable: no
         owning shard in the map, or no sink for it — surfaced through
-        on_unroutable so the front door can NACK instead of black-hole)."""
+        on_unroutable so the front door can NACK instead of black-hole).
+        `on_shard_down` may be passed PER CALL so each front door's
+        fast-NACK replies through its own client channel (a router
+        shared by several ingress planes must not clobber one global
+        callback); falls back to the instance-level one."""
         sid = self.shard_of(request)
         sink = self.sinks.get(sid) if sid is not None else None
         if sink is None:
@@ -79,8 +106,23 @@ class ShardRouter:
             if self.on_unroutable is not None:
                 self.on_unroutable(request, frm, "no shard owns this key")
             return None
+        if on_shard_down is None:
+            on_shard_down = self.on_shard_down
+        if on_shard_down is not None and \
+                self.health_provider is not None and \
+                self.health_provider().get(sid) == 0.0:
+            # the owning shard is DOWN by the aggregator's staleness
+            # rule (every member silent) — refuse fast and retryable
+            # rather than black-hole into a dead sub-pool. 0.0 exactly:
+            # merely-degraded shards (breaker open, view change) still
+            # take writes and must keep taking them.
+            self.stats["fast_nacked"] += 1
+            self.metrics.add_event(MetricsName.SHARD_FAST_NACKS)
+            on_shard_down(request, frm, sid)
+            return None
         self.stats["routed"] += 1
-        self.stats["per_shard"][sid] += 1
+        self.stats["per_shard"][sid] = \
+            self.stats["per_shard"].get(sid, 0) + 1
         self.metrics.add_event(MetricsName.SHARD_ROUTED)
         if self.tracer.enabled:
             self.tracer.emit(tracing.SHARD_ROUTE, request.digest,
@@ -91,6 +133,7 @@ class ShardRouter:
     def summary(self) -> dict:
         out = {"routed": self.stats["routed"],
                "unroutable": self.stats["unroutable"],
+               "fast_nacked": self.stats["fast_nacked"],
                "per_shard": dict(self.stats["per_shard"])}
         if self.health_provider is not None:
             health = self.health_provider()

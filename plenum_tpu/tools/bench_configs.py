@@ -1142,6 +1142,106 @@ def _telemetry_hot_shard_arm(n_txns: int = 120) -> dict:
     }
 
 
+def config12_reshard(n_users: int = 320, phase_s: float = 20.0) -> dict:
+    """Elastic-resharding acceptance on the bench line (docs/sharding.md
+    "Elastic resharding"): a deterministic sim-time 2-shard fabric under
+    a zipfian hot-range workload (90% of writes key into shard 0). The
+    PR 11 aggregator flags the hot shard, ``maybe_split`` consumes the
+    signal and live-splits the hot range onto a new sub-pool UNDER the
+    same load, and the run publishes:
+
+    * pre/post aggregate TPS (sim-time) and the recovery ratio — the
+      acceptance gate is post >= 0.8 * pre within the run;
+    * the load-imbalance index before (hot flagged) and after (below
+      ``SHARD_IMBALANCE_THRESHOLD``);
+    * the migration ledger: txns copied, handoff forwards, epoch.
+    """
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.config import Config
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import NYM
+    from plenum_tpu.shards import ShardedSimFabric
+
+    try:
+        config = Config(Max3PCBatchWait=0.05, TELEMETRY_INTERVAL=0.5,
+                        SLO_BURN_SLOW_WINDOW=30.0,
+                        STATE_FRESHNESS_UPDATE_INTERVAL=600.0)
+        fab = ShardedSimFabric(n_shards=2, nodes_per_shard=3, seed=23,
+                               config=config)
+        # mine the zipfian request pools: 90% hot (shard 0), 10% cold
+        # the pools must outlast all three driven phases (the zipfian
+        # cursor advancing past the hot pool's end would fake a post-
+        # reshard skew flip)
+        hot, cold = [], []
+        i = 0
+        while (len(hot) < n_users or len(cold) < n_users // 6) \
+                and i < 12 * n_users:
+            i += 1
+            u = Ed25519Signer(seed=(b"rz%08d" % i).ljust(32, b"\0")[:32])
+            req = Request(fab.trustee.identifier, i,
+                          {"type": NYM, "dest": u.identifier,
+                           "verkey": u.verkey_b58})
+            req.signature = fab.trustee.sign_b58(req.signing_bytes())
+            (hot if fab.router.shard_of(req) == 0 else cold).append(req)
+
+        cursor = {"h": 0, "c": 0, "n": 0}
+
+        def drive(seconds: float) -> float:
+            """Zipfian-paced submission; -> ordered txns per SIM second."""
+            t0 = fab.timer.get_current_time()
+            base = sum(s.ordered_count() for s in fab.shards.values())
+            steps = int(seconds / 0.25)
+            for k in range(steps):
+                cursor["n"] += 1
+                if cursor["n"] % 10 and cursor["h"] < len(hot):
+                    fab.submit_write(hot[cursor["h"]])
+                    cursor["h"] += 1
+                elif cursor["c"] < len(cold):
+                    fab.submit_write(cold[cursor["c"]])
+                    cursor["c"] += 1
+                fab.run(0.25)
+                fab.ordered_counts()
+            dt = fab.timer.get_current_time() - t0
+            done = sum(s.ordered_count()
+                       for s in fab.shards.values()) - base
+            return round(done / dt, 2) if dt else 0.0
+
+        pre_tps = drive(phase_s)
+        index_before, hot_sid = fab.aggregator.load_imbalance()
+        m = fab.reshard.maybe_split()          # consume the PR 11 signal
+        if m is None:
+            return {"error": f"imbalance signal never flagged the hot "
+                             f"shard (index={index_before})"}
+        during_tps = drive(phase_s)            # reshard runs under load
+        elapsed = 0.0
+        while m.phase not in ("done", "aborted") and elapsed < 120.0:
+            fab.run(0.5)
+            elapsed += 0.5
+        # the post phase runs 2x so the imbalance window judges a sample
+        # big enough that a 72-write binomial wobble cannot re-flag a
+        # healthily split range
+        post_tps = drive(2 * phase_s)          # post-reshard steady state
+        index_after, hot_after = fab.aggregator.load_imbalance()
+        return {
+            "pre_tps": pre_tps,
+            "during_tps": during_tps,
+            "post_tps": post_tps,
+            "recovery_ratio": round(post_tps / pre_tps, 2)
+            if pre_tps else None,
+            "imbalance_before": index_before,
+            "hot_shard_flagged": hot_sid,
+            "imbalance_after": index_after,
+            "hot_shard_after": hot_after,
+            "imbalance_threshold": config.SHARD_IMBALANCE_THRESHOLD,
+            "migration": m.to_dict(),
+            "epoch": fab.mapping.epoch,
+            "shards_after": len(fab.shards),
+            "stale_nacks": len(fab.stale_nacks),
+        }
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     for name, fn in (("config1b", config1b_distinct_signers),
                      ("config2", config2_three_instances_mixed),
@@ -1152,7 +1252,8 @@ def main():
                      ("config7", config7_ingress_10k),
                      ("config8", config8_pipeline_ab),
                      ("config10", config10_shards),
-                     ("config11", config11_telemetry)):
+                     ("config11", config11_telemetry),
+                     ("config12", config12_reshard)):
         print(name, json.dumps(fn()), flush=True)
 
 

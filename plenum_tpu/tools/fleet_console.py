@@ -77,21 +77,38 @@ def render(agg, incidents, last_n: int = 5) -> str:
     s = agg.fleet_summary()
     lines = [f"fleet @ t={s['t']:.2f}  snapshots={s['snapshots']}  "
              f"nodes={len(s['nodes'])}"]
+    epochs = s.get("mapping_epochs", {})
+    migrations = s.get("migrations", {})
     hdr = (f"  {'node':12} {'shard':>5} {'health':>7} {'seq':>6} "
-           f"{'anchor_age':>10}")
+           f"{'anchor_age':>10} {'epoch':>6} {'migration':>16}")
     lines.append(hdr)
     lines.append("  " + "-" * (len(hdr) - 2))
     for name, row in s["nodes"].items():
         h = row["health"]
         age = row["anchor_age"]
+        shard = row["shard"]
+        epoch = epochs.get(str(shard)) if shard is not None else None
+        mig = migrations.get(str(shard)) if shard is not None else None
+        mig_cell = "-"
+        if mig:
+            mig_cell = (f"{mig.get('role', '?')[:3]}:"
+                        f"{mig.get('phase', '?')}"
+                        f"@{mig.get('progress', 0.0):.0%}")
         lines.append(
-            f"  {name:12} {str(row['shard'] if row['shard'] is not None else '-'):>5} "
+            f"  {name:12} {str(shard if shard is not None else '-'):>5} "
             f"{'-' if h is None else format(h, '.2f'):>7} "
             f"{str(row['seq'] if row['seq'] is not None else '-'):>6} "
-            f"{'-' if age is None else format(age, '.1f'):>10}")
+            f"{'-' if age is None else format(age, '.1f'):>10} "
+            f"{str(epoch if epoch is not None else '-'):>6} "
+            f"{mig_cell:>16}")
     if s["shard_health"]:
         lines.append(f"  shard health: {s['shard_health']}  "
                      f"ordered/s: {s['ordered_rates']}")
+    if migrations:
+        lines.append("  migrations: " + ", ".join(
+            f"shard {sid}: {m.get('role')} {m.get('phase')} "
+            f"{m.get('progress', 0.0):.0%}"
+            for sid, m in sorted(migrations.items())))
     if s["load_imbalance"] is not None:
         hot = s["hot_shard"]
         lines.append(f"  load imbalance index: {s['load_imbalance']}"
@@ -213,6 +230,48 @@ def self_check() -> int:
     if not any(a.kind == "shard.imbalance" for a in agg4.alerts):
         problems.append("imbalance raised no alert")
 
+    # 4b) reshard convergence: the per-shard mapping-epoch + migration-
+    # progress columns an operator watches a live split through — the
+    # laggard's epoch is what shows, and the migration column clears
+    # when the handoff completes
+    agg4b = FleetAggregator(config=config)
+
+    def resharding(node, seq, t, shard, epoch, mig=None):
+        snap = healthy(node, seq, t, ordered=seq, shard=shard)
+        snap["state"]["shard_map"] = {"epoch": epoch,
+                                      **({"migration": mig} if mig else {})}
+        return snap
+
+    agg4b.ingest(resharding("S0N2", 0, 0.0, 0, 0))    # laggard: epoch 0
+    agg4b.ingest(resharding("S0N1", 0, 0.5, 0, 1,
+                            mig={"role": "source", "phase": "copying",
+                                 "progress": 0.4}))
+    agg4b.ingest(resharding("S2N1", 0, 0.5, 2, 1,
+                            mig={"role": "target", "phase": "copying",
+                                 "progress": 0.4}))
+    if agg4b.mapping_epochs() != {0: 0, 2: 1}:
+        problems.append(f"mapping epochs wrong (laggard must show): "
+                        f"{agg4b.mapping_epochs()}")
+    migs = agg4b.migrations()
+    if set(migs) != {0, 2} or migs[0].get("role") != "source" \
+            or migs[2].get("role") != "target":
+        problems.append(f"migration columns wrong: {migs}")
+    txt = render(agg4b, [])
+    if "sou:copying@40%" not in txt or "migrations:" not in txt:
+        problems.append("console does not render migration progress")
+    # the handoff completes: migration column clears, epochs converge
+    agg4b.ingest(resharding("S0N1", 1, 1.0, 0, 1))
+    agg4b.ingest(resharding("S0N2", 1, 1.0, 0, 1))
+    agg4b.ingest(resharding("S2N1", 1, 1.0, 2, 1))
+    if agg4b.migrations() or agg4b.mapping_epochs() != {0: 1, 2: 1}:
+        problems.append(
+            f"post-reshard view did not converge: "
+            f"{agg4b.migrations()} {agg4b.mapping_epochs()}")
+    # a decommissioned (merged-away) node is FORGOTTEN, not paged
+    agg4b.forget_node("S2N1")
+    if "S2N1" in agg4b.fleet_summary()["nodes"]:
+        problems.append("forget_node left the retired node enrolled")
+
     # 5) incident clustering: anomalies on two nodes within the gap fold
     # into ONE incident; a distant one stands alone
     dumps = [
@@ -232,7 +291,7 @@ def self_check() -> int:
 
     # 6) the renderer survives every view above (smoke, not goldens)
     try:
-        for a in (agg, agg2, agg3, agg4):
+        for a in (agg, agg2, agg3, agg4, agg4b):
             render(a, incidents)
     except Exception as e:
         problems.append(f"render failed: {type(e).__name__}: {e}")

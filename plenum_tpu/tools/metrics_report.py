@@ -471,6 +471,23 @@ def derive_summary(folds: dict[str, dict], span_s: float,
                 percentile(cv["samples"], 0.95))
         elif cv.get("mean") is not None:
             section["cross_verify_ms_mean"] = _ms(cv["mean"])
+        # elastic resharding + cross-shard write 2PC (shards/reshard.py,
+        # shards/cross_write.py): migration volume, the copy cursor's
+        # replays, handoff forwards, fail-closed stale NACKs, the front
+        # door's dead-shard fast-NACKs, and the 2PC outcome ledger —
+        # zero half-commits is the invariant, so aborts are a first-
+        # class figure, not a failure smell
+        for key, name in (("reshard_migrations", "shards.reshard_migrations"),
+                          ("reshard_copied", "shards.reshard_copied"),
+                          ("reshard_forwarded", "shards.reshard_forwarded"),
+                          ("reshard_stale_nacks",
+                           "shards.reshard_stale_nacks"),
+                          ("fast_nacked", "shards.fast_nacks"),
+                          ("cross_writes", "shards.xsw_begun"),
+                          ("cross_write_commits", "shards.xsw_commits"),
+                          ("cross_write_aborts", "shards.xsw_aborts")):
+            if folds.get(name, {}).get("count"):
+                section[key] = int(s(name))
         out["shards"] = {k: v for k, v in section.items()
                          if v is not None}
     # observer read fan-out: push intake + anchor verification verdicts

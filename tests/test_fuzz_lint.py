@@ -1,0 +1,83 @@
+"""tools/fuzz_lint.py: every registered sim-fuzz kind must keep an
+always-on (non-slow) smoke rung in tier-1 — a kind living only in the
+slow sweep is silent coverage loss."""
+from __future__ import annotations
+
+import textwrap
+
+from plenum_tpu.tools.fuzz_lint import run_lint
+
+
+def test_fuzz_suite_smoke_coverage():
+    """The real suite: every scenario runner (base kinds AND the
+    run_*_with_* compositions) is referenced by a non-slow test."""
+    out = run_lint()
+    assert out["check"] == "ok", out["problems"]
+    assert out["scenarios"] >= 10        # the kinds this repo has grown
+    assert out["smoke_covered"] == out["scenarios"]
+    # the reshard kind introduced with live split/merge is registered
+    assert "run_reshard_fuzz_scenario" in out["kinds"]
+
+
+def test_fuzz_lint_catches_sweep_only_kind(tmp_path):
+    """A scenario with ONLY a slow sweep must fail the lint; adding a
+    smoke rung clears it."""
+    bad = tmp_path / "bad_fuzz.py"
+    bad.write_text(textwrap.dedent("""
+        import pytest
+
+        def run_orphan_scenario(seed):
+            pass
+
+        @pytest.mark.slow
+        def test_orphan_fuzz():
+            run_orphan_scenario(1)
+    """))
+    out = run_lint(str(bad))
+    assert out["check"] == "FAIL"
+    assert any("run_orphan_scenario" in p for p in out["problems"])
+
+    good = tmp_path / "good_fuzz.py"
+    good.write_text(textwrap.dedent("""
+        import pytest
+
+        def run_orphan_scenario(seed):
+            pass
+
+        @pytest.mark.slow
+        def test_orphan_fuzz():
+            run_orphan_scenario(1)
+
+        def test_orphan_smoke():
+            run_orphan_scenario(2)
+    """))
+    out = run_lint(str(good))
+    assert out["check"] == "ok", out["problems"]
+
+
+def test_fuzz_lint_smoke_via_lambda_counts(tmp_path):
+    """The suite's idiom wraps scenarios in lambdas (force_rung pinning);
+    the AST walk must see through them."""
+    f = tmp_path / "lambda_fuzz.py"
+    f.write_text(textwrap.dedent("""
+        def run_thing_scenario(seed, force_rung=None):
+            pass
+
+        def _run_with_artifacts(fn, seed):
+            fn(seed)
+
+        def test_thing_smoke():
+            _run_with_artifacts(
+                lambda s: run_thing_scenario(s, force_rung=0), 1)
+    """))
+    out = run_lint(str(f))
+    assert out["check"] == "ok", out["problems"]
+
+
+def test_fuzz_lint_naming_drift_fails(tmp_path):
+    """If the suite's naming convention drifts so discovery finds
+    nothing, the lint fails loudly instead of vacuously passing."""
+    f = tmp_path / "empty_fuzz.py"
+    f.write_text("def helper():\n    pass\n")
+    out = run_lint(str(f))
+    assert out["check"] == "FAIL"

@@ -412,6 +412,231 @@ def test_unreachable_owning_shard_fails_closed():
     assert s["fallbacks"] == 1 and s["msgs_sent"] == 0
 
 
+# --- elastic resharding (shards/reshard.py) ---------------------------------
+
+def _seed_shard0(fab, n=5, tag=b"el"):
+    """Order n writes owned by shard 0 (some land in the upper half of
+    its range — the slice a midpoint split moves)."""
+    users = []
+    rid = 0
+    for k in range(n):
+        u = user_on_shard(fab, 0, tag, start=k * 13)
+        rid += 1
+        users.append(u)
+        assert fab.submit_write(signed_write(fab, u, rid)) == 0
+    fab.run(10.0)
+    assert fab.shards[0].domain_sizes() == {n + 1}
+    return users
+
+
+def test_live_split_migrates_range_under_traffic():
+    fab = make_fabric()
+    users = _seed_shard0(fab)
+    m = fab.reshard.split(0)
+    assert sorted(fab.shards) == [0, 1, 2] and m.phase == "copying"
+    # traffic DURING the migration keeps routing through the live map
+    during = [user_on_shard(fab, 0, b"mid", start=k * 29) for k in range(3)]
+    for i, u in enumerate(during):
+        fab.submit_write(signed_write(fab, u, 100 + i))
+    for _ in range(120):
+        fab.run(0.5)
+        if m.phase == "done":
+            break
+    assert m.phase == "done", m.to_dict()
+    assert fab.mapping.epoch == 1                 # the ledger transaction
+    assert fab.shards[2].ordered_count() >= 1     # the range moved
+    # EVERY write (pre-split, mid-split) verifies at its current owner
+    driver = fab.read_driver()
+    for i, u in enumerate(users + during):
+        q = Request("r", 500 + i, {"type": GET_NYM, "dest": u.identifier})
+        res = driver.read(q, per_node_s=2.0, step_s=0.1)
+        assert res is not None and \
+            res["data"]["verkey"] == u.verkey_b58, \
+            (u.identifier, driver.stats.summary())
+    s = driver.stats.summary()
+    assert s["fallbacks"] == 0 and s["map_proof_failures"] == 0
+    # no duplicate: each moved DID ordered EXACTLY once at the target
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    from plenum_tpu.execution import txn as txn_lib
+    node = next(iter(fab.shards[2].nodes.values()))
+    ledger = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
+    dests = [txn_lib.txn_data(ledger.get_by_seq_no(i)).get("dest")
+             for i in range(2, ledger.size + 1)]
+    assert len(dests) == len(set(dests)), f"duplicated writes: {dests}"
+
+
+def test_live_merge_retires_source():
+    fab = make_fabric()
+    u0 = user_on_shard(fab, 0, b"mg0")
+    u1 = user_on_shard(fab, 1, b"mg1")
+    for rid, u in ((1, u0), (2, u1)):
+        fab.submit_write(signed_write(fab, u, rid))
+    fab.run(10.0)
+    m = fab.reshard.merge(1, 0)
+    for _ in range(120):
+        fab.run(0.5)
+        if m.phase == "done":
+            break
+    assert m.phase == "done", m.to_dict()
+    assert fab.mapping.epoch == 1
+    assert sorted(fab.shards) == [0] and 1 in fab.retired
+    # the merged-away shard's data verifies from the surviving shard
+    driver = fab.read_driver()
+    for i, u in enumerate((u0, u1)):
+        q = Request("r", 600 + i, {"type": GET_NYM, "dest": u.identifier})
+        res = driver.read(q, per_node_s=2.0, step_s=0.1)
+        assert res is not None and res["data"]["verkey"] == u.verkey_b58
+    # post-merge writes for the moved range route to the survivor
+    u2 = user_on_shard(fab, 0, b"mg2", start=50)
+    assert fab.submit_write(signed_write(fab, u2, 3)) == 0
+    # the aggregator forgot the retired nodes (gone, not 0.0-health)
+    assert not any(n.startswith("S1N") for n in fab.aggregator.latest)
+
+
+def test_stale_route_forwarded_in_window_then_nacked():
+    """The dual-ownership handoff contract: a write landing at the OLD
+    owner after the ratchet is forwarded (ordered exactly once at the
+    new owner) inside the window, and NACKed fail-closed after it."""
+    fab = make_fabric()
+    _seed_shard0(fab, n=3)
+    m = fab.reshard.split(0)
+    while m.phase == "copying":
+        fab.run(0.5)
+    assert m.phase == "handoff"
+    stale_sink = fab.router.sinks[0]          # a stale router's decision
+    mover = user_on_shard(fab, 2, b"race")    # key the new map gives to 2
+    req = signed_write(fab, mover, 300)
+    before = fab.shards[2].ordered_count()
+    stale_sink(req, "stale-client")
+    for _ in range(40):
+        fab.run(0.5)
+        if fab.shards[2].ordered_count() > before:
+            break
+    assert fab.shards[2].ordered_count() == before + 1, \
+        "forwarded write not ordered at the new owner"
+    assert m.forwarded == 1 and not fab.stale_nacks
+    # drain the window; past it the same stale route fails closed
+    for _ in range(240):
+        fab.run(0.5)
+        done = fab.reshard.history and \
+            fab.timer.get_current_time() > (m.drain_until or 1e18)
+        if done:
+            break
+    late = signed_write(fab, user_on_shard(fab, 2, b"race", start=40), 301)
+    count2 = fab.shards[2].ordered_count()
+    count0 = fab.shards[0].ordered_count()
+    stale_sink(late, "stale-client")
+    fab.run(5.0)
+    assert fab.stale_nacks, "late stale write was not NACKed"
+    assert fab.shards[2].ordered_count() == count2
+    assert fab.shards[0].ordered_count() == count0, \
+        "late stale write ordered at the OLD owner (double ownership)"
+
+
+def test_read_ladder_refreshes_on_reshard():
+    """Satellite: a client whose map view predates the reshard must not
+    error — the ladder refreshes the view and retries once against the
+    new owner."""
+    fab = make_fabric()
+    users = _seed_shard0(fab)
+    driver = fab.read_driver()                # view at epoch 0
+    m = fab.reshard.split(0)
+    for _ in range(120):
+        fab.run(0.5)
+        if m.phase == "done":
+            break
+    assert m.phase == "done"
+    moved = next(u for u in users
+                 if fab.router.shard_of(
+                     Request("p", 1, {"type": GET_NYM,
+                                      "dest": u.identifier})) == 2)
+    q = Request("r", 700, {"type": GET_NYM, "dest": moved.identifier})
+    res = driver.read(q, per_node_s=1.0, step_s=0.1)
+    s = driver.stats.summary()
+    assert res is not None and res["data"]["verkey"] == moved.verkey_b58, s
+    assert s["map_retries"] == 1 and s["fallbacks"] == 0, s
+
+
+def test_maybe_split_consumes_imbalance_signal():
+    """The PR 11 aggregator's hot-shard flag is the split trigger."""
+    fab = make_fabric()
+    # synthetic skewed telemetry: shard 0 orders 50x shard 1's rate
+    for i in range(30):
+        t = float(i)
+        for name, sid, rate in (("S0N1", 0, 50), ("S1N1", 1, 1)):
+            fab.aggregator.ingest({
+                "v": 1, "node": name, "seq": i, "t": t,
+                "tags": {"shard": sid}, "counters": {}, "sampled": {},
+                "state": {"node": {"ordered_total": i * rate}}})
+    index, hot = fab.aggregator.load_imbalance()
+    assert hot == 0 and index >= fab.config.SHARD_IMBALANCE_THRESHOLD
+    m = fab.reshard.maybe_split()
+    assert m is not None and m.source == 0
+    assert fab.reshard.maybe_split() is None    # one migration at a time
+
+
+def test_front_door_fast_nacks_dead_shard():
+    """Satellite: a write whose owning shard scores 0.0 health (every
+    member silent past the staleness bound) is refused immediately with
+    a retryable LoadShed instead of timing out against a dead pool."""
+    from plenum_tpu.common.node_messages import LoadShed
+
+    fab = make_fabric()
+    entry = fab.shards[0].names[0]
+    ing = fab.ingress_plane(entry, tick=False)
+    # shard 1 went dark: its members' last snapshots are far behind the
+    # fleet clock the (live) shard-0 members keep advancing
+    for name in fab.shards[1].names:
+        fab.aggregator.ingest({"v": 1, "node": name, "seq": 0, "t": 0.0,
+                               "tags": {"shard": 1}, "counters": {},
+                               "sampled": {}, "state": {}})
+    for i, name in enumerate(fab.shards[0].names):
+        fab.aggregator.ingest({"v": 1, "node": name, "seq": 9, "t": 100.0,
+                               "tags": {"shard": 0}, "counters": {},
+                               "sampled": {}, "state": {}})
+    assert fab.aggregator.shard_health()[1] == 0.0
+    u = user_on_shard(fab, 1, b"dead")
+    ing.submit(signed_write(fab, u, 1).to_dict(), "cli-x")
+    for _ in range(30):
+        ing.service()
+        fab.run(0.2)
+        sheds = [msg for msg, _ in fab.shards[0].client_msgs[entry]
+                 if isinstance(msg, LoadShed)]
+        if sheds:
+            break
+    assert sheds and "unavailable" in sheds[0].reason
+    assert sheds[0].retry_after > 0          # the RETRYABLE hint
+    assert fab.ingress_router.stats["fast_nacked"] == 1
+    assert fab.shards[1].ordered_count() == 0
+
+
+def test_directory_signer_rotation_stales_old_committee():
+    """Satellite: rotating one directory signer re-signs the map root;
+    proofs minted under the old committee fail closed against the
+    rotated trust root."""
+    from plenum_tpu.crypto.bls import BlsCryptoSigner
+
+    ml = make_map(2)
+    key = routing_key({"dest": "RotDid"})
+    old_proof = ml.ownership_proof(key)
+    old_keys = dict(ml.directory_keys)
+    new_signer = BlsCryptoSigner(seed=b"rotated-dir-1".ljust(32, b"\0"))
+    ml.rotate_signer("Dir1", new_signer)
+    new_keys = ml.directory_keys
+    assert new_keys != old_keys
+    # freshly minted proof verifies against the NEW trust root
+    fresh = ml.ownership_proof(key)
+    assert verify_ownership(key, fresh, new_keys, now=NOW)[1] == "ok"
+    # the OLD committee's proof fails closed against the new root
+    assert verify_ownership(key, old_proof, new_keys, now=NOW)[1] \
+        == "bad_map_multi_sig"
+    # and the new proof fails against a verifier still on the old root
+    assert verify_ownership(key, fresh, old_keys, now=NOW)[1] \
+        == "bad_map_multi_sig"
+    with pytest.raises(KeyError):
+        ml.rotate_signer("NotADir", new_signer)
+
+
 # --- observability ----------------------------------------------------------
 
 def _folds_from(collector):

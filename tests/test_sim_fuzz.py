@@ -1532,3 +1532,539 @@ def test_sim_cross_shard_smoke():
         lambda s: run_cross_shard_fuzz_scenario(s, force_rung=0), 1)
     _run_with_artifacts(
         lambda s: run_cross_shard_fuzz_scenario(s, force_rung=3), 2)
+
+
+# --- scenario kind `reshard`: the SHARD MAP ITSELF is in motion -------------
+# Live split/merge (shards/reshard.py) and proof-carrying cross-shard
+# writes (shards/cross_write.py) under fire: every admitted write across
+# a migration must be ordered EXACTLY ONCE (no drop, no duplicate),
+# every stale-epoch or partitioned cross-shard write must fail closed
+# with ZERO half-commits, and a coordinator crash between prepare and
+# commit must never lose atomicity. Composes with partition (rung 2),
+# the ratchet race (rung 3), the 2PC fault matrix (rungs 4/5), and
+# device_flap / client_flood via the run_reshard_with_* runners.
+
+
+def _reshard_fabric(seed: int, shard_verifiers=None):
+    from plenum_tpu.shards import ShardedSimFabric
+    return _track(ShardedSimFabric(
+        n_shards=2, nodes_per_shard=4, seed=seed, config=Config(**FAST),
+        shard_verifiers=shard_verifiers))
+
+
+def _drive_migration(fab, m, timeout: float = 90.0) -> None:
+    elapsed = 0.0
+    while elapsed < timeout and m.phase not in ("done", "aborted"):
+        fab.run(0.5)
+        elapsed += 0.5
+
+
+def _owner_sid(fab, req) -> int:
+    return fab.router.shard_of(req)
+
+
+def _assert_exactly_once(fab, seed: int, writes) -> None:
+    """Every admitted write is ordered exactly once at its CURRENT
+    owner (post-migration map), and nowhere gains a duplicate."""
+    from plenum_tpu.execution import txn as txn_lib
+    from plenum_tpu.execution.txn import NYM
+    ledger_dests: dict[int, list] = {}
+    for sid, shard in fab.shards.items():
+        node = next(iter(shard.nodes.values()))
+        ledger = node.c.db.get_ledger(DOMAIN_LEDGER_ID)
+        # NYM creations only: 2PC records are ATTRIBs that legitimately
+        # repeat their shard's anchor DID
+        ledger_dests[sid] = [
+            txn_lib.txn_data(ledger.get_by_seq_no(i)).get("dest")
+            for i in range(2, ledger.size + 1)
+            if txn_lib.txn_type_of(ledger.get_by_seq_no(i)) == NYM]
+    for sid, dests in ledger_dests.items():
+        dup = [d for d in set(dests) if dests.count(d) > 1]
+        assert not dup, f"seed {seed}: duplicates on shard {sid}: {dup}"
+    for user, req in writes:
+        owner = _owner_sid(fab, req)
+        assert owner is not None, f"seed {seed}: write lost from the map"
+        assert user.identifier in ledger_dests[owner], \
+            (f"seed {seed}: write {user.identifier[:8]} missing at its "
+             f"owner {owner} ({ {s: len(d) for s, d in ledger_dests.items()} })")
+
+
+def run_reshard_fuzz_scenario(seed: int, force_rung=None,
+                              faulted_plane=None) -> None:
+    from plenum_tpu.execution.txn import GET_NYM
+    from test_shards import signed_write, user_on_shard
+
+    rng = SimRandom(seed * 49979693 + 41)
+    rung = rng.integer(0, 5) if force_rung is None else force_rung
+
+    shard_verifiers = None
+    if faulted_plane is not None:
+        shard_verifiers = {0: faulted_plane[0]}   # the SOURCE shard's plane
+    fab = _reshard_fabric(seed, shard_verifiers=shard_verifiers)
+    if faulted_plane is not None:
+        sup, faulty = faulted_plane
+        sup.set_clock(fab.timer.get_current_time)
+        faulty.set_clock(fab.timer.get_current_time)
+
+    # zipfian-shaped seed load: most writes key into shard 0 (the hot
+    # range a split relieves), a trickle into shard 1
+    writes = []
+    rid = 0
+    n_hot = 4 + rng.integer(0, 2)
+    for k in range(n_hot):
+        u = user_on_shard(fab, 0, b"rs%d-" % seed, start=k * 17)
+        rid += 1
+        writes.append((u, signed_write(fab, u, rid)))
+    u_cold = user_on_shard(fab, 1, b"rc%d-" % seed)
+    rid += 1
+    writes.append((u_cold, signed_write(fab, u_cold, rid)))
+    for _u, req in writes:
+        assert fab.submit_write(req) is not None
+    elapsed = 0.0
+    while elapsed < 30.0 and any(
+            s.ordered_count() < 1 for s in fab.shards.values()):
+        fab.run(0.5)
+        elapsed += 0.5
+    assert fab.shards[0].ordered_count() >= n_hot, \
+        f"seed {seed}: hot shard never ordered its seed load"
+
+    if faulted_plane is not None:
+        # the source shard's crypto plane faults BEFORE the split: the
+        # whole migration (copy replays, handoff) rides the supervisor's
+        # breaker + hedged CPU fallback
+        getattr(faulted_plane[1],
+                ("wedge", "drop", "corrupt")[rng.integer(0, 2)])()
+
+    if rung == 0:
+        # HEALTHY SPLIT UNDER TRAFFIC: the hot range splits onto a new
+        # sub-pool while writes keep flowing; exactly-once everywhere,
+        # epoch ratchets, a stale-view reader refreshes instead of
+        # erroring
+        stale_driver = fab.read_driver()          # view predates the split
+        m = fab.reshard.split(0)
+        for k in range(3):                        # mid-migration traffic
+            u = user_on_shard(fab, 0, b"rm%d-" % seed, start=k * 23)
+            rid += 1
+            req = signed_write(fab, u, rid)
+            writes.append((u, req))
+            assert fab.submit_write(req) is not None
+        _drive_migration(fab, m)
+        assert m.phase == "done", \
+            f"seed {seed}: migration stuck: {m.to_dict()}"
+        assert fab.mapping.epoch == 1 and len(fab.shards) == 3
+        _assert_exactly_once(fab, seed, writes)
+        moved = next((u for u, req in writes if _owner_sid(fab, req) == 2),
+                     None)
+        assert moved is not None, f"seed {seed}: split moved nothing"
+        q = Request("rr", 900, {"type": GET_NYM, "dest": moved.identifier})
+        res = stale_driver.read(q, per_node_s=1.5, step_s=0.1)
+        s = stale_driver.stats.summary()
+        assert res is not None and \
+            res["data"]["verkey"] == moved.verkey_b58, \
+            f"seed {seed}: stale-view read errored during healthy reshard {s}"
+        assert s.get("map_retries", 0) == 1 and s["fallbacks"] == 0, s
+    elif rung == 1:
+        # LIVE MERGE: shard 1's whole range folds into shard 0 under
+        # traffic; the source retires, its data verifies at the survivor
+        m = fab.reshard.merge(1, 0)
+        _drive_migration(fab, m)
+        assert m.phase == "done", \
+            f"seed {seed}: merge stuck: {m.to_dict()}"
+        assert fab.mapping.epoch == 1 and sorted(fab.shards) == [0]
+        _assert_exactly_once(fab, seed, writes)
+        driver = fab.read_driver()
+        q = Request("rr", 901, {"type": GET_NYM,
+                                "dest": u_cold.identifier})
+        res = driver.read(q, per_node_s=2.0, step_s=0.1)
+        assert res is not None and \
+            res["data"]["verkey"] == u_cold.verkey_b58, \
+            f"seed {seed}: merged-away data unreadable at the survivor"
+        assert not any(n.startswith("S1N") for n in fab.aggregator.latest)
+    elif rung == 2:
+        # RESHARD MID-PARTITION: the split target's primary is cut off
+        # mid-copy — the migration must NOT ratchet while the copy
+        # cannot complete (source keeps ownership, no write lost), then
+        # complete after the heal + the target's own view change
+        m = fab.reshard.split(0)
+        tshard = fab.shards[m.target]
+        primary = tshard.nodes[tshard.names[0]] \
+            .master_replica.data.primary_name
+        rules = [tshard.net.add_rule(Discard(), match_dst(primary)),
+                 tshard.net.add_rule(Discard(), match_frm(primary))]
+        fab.run(rng.float(3.0, 6.0))
+        # the fail-closed coupling: the epoch ratchets IFF the copy
+        # completed (the target's survivors may legitimately view-change
+        # around their cut primary and finish early — but a ratchet with
+        # the copy incomplete would be data loss)
+        assert (fab.mapping.epoch == 0) == (m.phase == "copying"), \
+            f"seed {seed}: ratchet/copy desync: epoch=" \
+            f"{fab.mapping.epoch} phase={m.phase}"
+        if m.phase == "copying":
+            assert not m.pending or fab.mapping.epoch == 0
+        # writes during the (possibly stalled) migration are never lost
+        u = user_on_shard(fab, 0, b"rp%d-" % seed, start=31)
+        rid += 1
+        req = signed_write(fab, u, rid)
+        writes.append((u, req))
+        assert fab.submit_write(req) is not None
+        for r in rules:
+            tshard.net.remove_rule(r)
+        _drive_migration(fab, m, timeout=120.0)
+        assert m.phase == "done", \
+            f"seed {seed}: migration never recovered from the partition " \
+            f"({m.to_dict()})"
+        _assert_exactly_once(fab, seed, writes)
+    elif rung == 3:
+        # STALE-EPOCH WRITES RACING THE RATCHET: a write landing at the
+        # OLD owner inside the handoff window is forwarded and ordered
+        # exactly once at the NEW owner; past the window it fails closed
+        # (explicit NACK, ordered NOWHERE)
+        m = fab.reshard.split(0)
+        while m.phase == "copying":
+            fab.run(0.5)
+        assert m.phase == "handoff"
+        stale_sink = fab.router.sinks[0]
+        mover = user_on_shard(fab, 2, b"rw%d-" % seed)
+        rid += 1
+        req = signed_write(fab, mover, rid)
+        writes.append((mover, req))
+        before = fab.shards[2].ordered_count()
+        stale_sink(req, "stale-client")
+        elapsed = 0.0
+        while elapsed < 30.0 and fab.shards[2].ordered_count() <= before:
+            fab.run(0.5)
+            elapsed += 0.5
+        assert fab.shards[2].ordered_count() == before + 1, \
+            f"seed {seed}: in-window stale write dropped"
+        assert m.forwarded >= 1 and not fab.stale_nacks
+        # run out the window (+ drain grace), then race again: fail closed
+        fab.run(fab.config.RESHARD_HANDOFF_WINDOW * 3 + 5.0)
+        late_u = user_on_shard(fab, 2, b"rw%d-" % seed, start=60)
+        rid += 1
+        late = signed_write(fab, late_u, rid)
+        c0, c2 = fab.shards[0].ordered_count(), fab.shards[2].ordered_count()
+        stale_sink(late, "stale-client")
+        fab.run(5.0)
+        assert fab.stale_nacks, f"seed {seed}: late stale write not NACKed"
+        assert fab.shards[0].ordered_count() == c0 and \
+            fab.shards[2].ordered_count() == c2, \
+            f"seed {seed}: post-window stale write ordered somewhere"
+        _assert_exactly_once(fab, seed, writes)
+    elif rung == 4:
+        # 2PC COORDINATOR CRASH between prepare and commit: the
+        # participant's lock TTL resolves via the anchored decision read
+        # (proven absence -> abort), ledger recovery orders the abort —
+        # and a later transaction over the same dependency commits
+        import json as _json
+        from plenum_tpu.execution.txn import ATTRIB, NYM
+        xsw = fab.cross_writes()
+        home = user_on_shard(fab, 0, b"xh%d-" % seed, start=80)
+        txid = xsw.begin(
+            0, 1, {"type": NYM, "dest": home.identifier,
+                   "verkey": home.verkey_b58},
+            {"type": GET_NYM, "dest": u_cold.identifier},
+            {"type": ATTRIB, "dest": u_cold.identifier,
+             "raw": _json.dumps({"linked": home.identifier})})
+        assert xsw.step(txid) == "prepared"
+        crash_after_lock = rng.integer(0, 1) == 1
+        if crash_after_lock:
+            assert xsw.step(txid) == "locked"
+        fab.run(25.0)                  # crash; TTLs expire
+        rec = xsw.recover_from_ledger(0)
+        assert txid in rec["aborted"], f"seed {seed}: {rec}"
+        xsw.participant(1).service()
+        assert xsw.participant(1).locks == {}, \
+            f"seed {seed}: orphan lock survived the crash"
+        records = xsw._scan_records(0)
+        assert records[txid]["decision"]["decision"] == "abort"
+        # atomicity: NEITHER half applied
+        node0 = next(iter(fab.shards[0].nodes.values()))
+        from plenum_tpu.execution import txn as txn_lib
+        ledger0 = node0.c.db.get_ledger(DOMAIN_LEDGER_ID)
+        assert not any(
+            txn_lib.txn_data(ledger0.get_by_seq_no(i)).get("dest")
+            == home.identifier for i in range(2, ledger0.size + 1)), \
+            f"seed {seed}: half-commit at home after crash"
+        # the dependency is free again: a retry commits cleanly
+        home2 = user_on_shard(fab, 0, b"xh%d-" % seed, start=120)
+        txid2 = xsw.begin(
+            0, 1, {"type": NYM, "dest": home2.identifier,
+                   "verkey": home2.verkey_b58},
+            {"type": GET_NYM, "dest": u_cold.identifier})
+        assert xsw.drive(txid2) == "committed", \
+            f"seed {seed}: retry after crash-abort failed"
+    else:
+        # 2PC RACING THE RATCHET: a LIVE SPLIT of the coordinator's own
+        # shard lands between lock and commit — the transaction must
+        # abort fail-closed (epoch changed), with zero half-commits,
+        # while the migration itself completes
+        from plenum_tpu.execution.txn import NYM
+        xsw = fab.cross_writes()
+        xsw._anchor(0)                 # anchors ordered pre-migration
+        xsw._anchor(1)
+        home = user_on_shard(fab, 0, b"xr%d-" % seed, start=80)
+        txid = xsw.begin(
+            0, 1, {"type": NYM, "dest": home.identifier,
+                   "verkey": home.verkey_b58},
+            {"type": GET_NYM, "dest": u_cold.identifier})
+        assert xsw.step(txid) == "prepared"
+        assert xsw.step(txid) == "locked"
+        m = fab.reshard.split(0)       # the map moves under the 2PC
+        _drive_migration(fab, m)
+        assert m.phase == "done" and fab.mapping.epoch == 1
+        assert xsw.step(txid) == "aborted"
+        assert xsw.txs[txid].abort_reason == "epoch_changed", \
+            f"seed {seed}: {xsw.txs[txid].abort_reason}"
+        assert xsw.participant(1).locks == {}
+        node0 = next(iter(fab.shards[0].nodes.values()))
+        from plenum_tpu.execution import txn as txn_lib
+        ledger0 = node0.c.db.get_ledger(DOMAIN_LEDGER_ID)
+        assert not any(
+            txn_lib.txn_data(ledger0.get_by_seq_no(i)).get("dest")
+            == home.identifier for i in range(2, ledger0.size + 1)), \
+            f"seed {seed}: half-commit despite the epoch ratchet"
+        _assert_exactly_once(fab, seed, writes)
+
+    if faulted_plane is not None:
+        sup, faulty = faulted_plane
+        st = sup.supervisor_stats()
+        assert st["fallback_batches"] >= 1, \
+            f"seed {seed}: reshard under crypto fault never took fallback"
+        assert sup.stats["verdict_forks"] == 0
+
+    for shard in fab.shards.values():
+        assert_safety(shard)
+
+
+def run_reshard_with_device_flap(seed: int) -> None:
+    """A live split while the SOURCE shard's crypto plane is faulted:
+    the copy replays and the handoff ride hedged CPU fallback, and the
+    migration still completes exactly-once."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.faults import FaultyVerifier
+    from plenum_tpu.parallel.supervisor import (CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    rng = SimRandom(seed * 67867979 + 7)
+    faulty = FaultyVerifier(CpuEd25519Verifier())
+    sup = SupervisedVerifier(
+        faulty, fallback=CpuEd25519Verifier(),
+        breaker=CircuitBreaker(fail_threshold=2,
+                               cooldown=rng.float(0.5, 1.5)),
+        budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
+                              warm_max=1.0, cold_max=1.0))
+    # rung 0 (the live split): its mid-migration writes drive auth
+    # through the faulted source plane, so the breaker + hedged CPU
+    # fallback are actually exercised by the migration itself
+    run_reshard_fuzz_scenario(seed, force_rung=0,
+                              faulted_plane=(sup, faulty))
+
+
+def run_reshard_with_client_flood(seed: int) -> None:
+    """A live split while hot clients flood the front door: over-cap
+    bursts shed EXPLICITLY, the honest client's write (owned by the
+    migrating shard) survives the migration, and the reshard completes."""
+    from plenum_tpu.client.sim_clients import burst_writes
+    from plenum_tpu.common.node_messages import LoadShed
+    from test_shards import signed_write, user_on_shard
+
+    rng = SimRandom(seed * 37199 + 11)
+    cap = rng.integer(2, 4)
+    from plenum_tpu.shards import ShardedSimFabric
+    fab = _track(ShardedSimFabric(
+        n_shards=2, nodes_per_shard=4, seed=seed,
+        config=Config(**FAST, INGRESS_CLIENT_QUEUE_CAP=cap)))
+    entry = fab.shards[0].names[0]
+    ing = fab.ingress_plane(entry, tick=False)
+
+    honest = user_on_shard(fab, 0, b"fl%d-" % seed)
+    req = signed_write(fab, honest, 1)
+    fab.submit_write(req)
+    elapsed = 0.0
+    while elapsed < 20.0 and fab.shards[0].ordered_count() < 1:
+        fab.run(0.5)
+        elapsed += 0.5
+    assert fab.shards[0].ordered_count() >= 1
+
+    m = fab.reshard.split(0)
+    n_hot = rng.integer(4, 8)
+    per_client = cap + rng.integer(3, 5)
+    for client, burst_req in burst_writes(fab.trustee, n_hot, per_client,
+                                          seed=seed):
+        ing.submit(burst_req.to_dict(), client)
+    honest2 = user_on_shard(fab, 0, b"fh%d-" % seed, start=40)
+    ing.submit(signed_write(fab, honest2, 2).to_dict(), "honest-2")
+    for _ in range(240):
+        ing.service()
+        fab.run(0.5)
+        if m.phase == "done":
+            break
+    assert m.phase == "done", \
+        f"seed {seed}: reshard starved by the flood ({m.to_dict()})"
+    sheds = [msg for msg, _ in fab.shards[0].client_msgs[entry]
+             if isinstance(msg, LoadShed)]
+    assert len(sheds) >= n_hot * (per_client - cap), \
+        f"seed {seed}: over-cap burst not shed explicitly"
+    owner = fab.router.shard_of(signed_write(fab, honest2, 2))
+    node = next(iter(fab.shards[owner].nodes.values()))
+    elapsed = 0.0
+    while elapsed < 30.0 and node._executed_txn(
+            signed_write(fab, honest2, 2)) is None:
+        ing.service()
+        fab.run(0.5)
+        elapsed += 0.5
+    assert node._executed_txn(signed_write(fab, honest2, 2)) is not None, \
+        f"seed {seed}: honest write lost across flood + migration"
+    for shard in fab.shards.values():
+        assert_safety(shard)
+
+
+RESHARD_SEEDS = 20
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_reshard_fuzz(bucket):
+    for seed in range(bucket * 5, (bucket + 1) * 5):
+        _run_with_artifacts(run_reshard_fuzz_scenario, seed)
+
+
+def test_sim_reshard_smoke():
+    """Two rungs always run in the default suite: the healthy split
+    under traffic (exactly-once + the stale-view reader refreshing) and
+    the ratchet race (in-window forward, post-window fail-closed NACK)."""
+    _run_with_artifacts(
+        lambda s: run_reshard_fuzz_scenario(s, force_rung=0), 1)
+    _run_with_artifacts(
+        lambda s: run_reshard_fuzz_scenario(s, force_rung=3), 2)
+
+
+def test_sim_reshard_2pc_smoke():
+    """The 2PC fault rungs always run: coordinator crash between
+    prepare and commit (atomicity through recovery), and the live split
+    racing an in-flight cross-shard write (fail-closed epoch abort)."""
+    _run_with_artifacts(
+        lambda s: run_reshard_fuzz_scenario(s, force_rung=4), 3)
+    _run_with_artifacts(
+        lambda s: run_reshard_fuzz_scenario(s, force_rung=5), 4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(2))
+def test_sim_reshard_device_flap_fuzz(bucket):
+    for seed in range(bucket * 3, (bucket + 1) * 3):
+        _run_with_artifacts(run_reshard_with_device_flap, seed)
+
+
+def test_sim_reshard_device_flap_smoke():
+    _run_with_artifacts(run_reshard_with_device_flap, 1)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(2))
+def test_sim_reshard_client_flood_fuzz(bucket):
+    for seed in range(bucket * 2, (bucket + 1) * 2):
+        _run_with_artifacts(run_reshard_with_client_flood, seed)
+
+
+def test_sim_reshard_client_flood_smoke():
+    _run_with_artifacts(run_reshard_with_client_flood, 1)
+
+
+# --- membership_churn satellite: DIRECTORY-COMMITTEE key rotation -----------
+
+
+def run_membership_churn_dir_rotation_scenario(seed: int) -> None:
+    """Rotate one directory-committee signer MID-LOAD: the mapping root
+    re-signs under the new committee, old-committee map proofs fail
+    closed against the rotated trust root, and reads/writes keep
+    flowing for clients holding the new root."""
+    from plenum_tpu.crypto.bls import BlsCryptoSigner
+    from plenum_tpu.execution.txn import GET_NYM
+    from test_shards import signed_write, user_on_shard
+
+    rng = SimRandom(seed * 23456789 + 13)
+    fab = _reshard_fabric(seed)
+    users = {sid: user_on_shard(fab, sid, b"dr%d-" % seed)
+             for sid in fab.shards}
+    for rid, (sid, u) in enumerate(sorted(users.items()), start=1):
+        assert fab.submit_write(signed_write(fab, u, rid)) == sid
+    elapsed = 0.0
+    while elapsed < 25.0 and any(s.ordered_count() < 1
+                                 for s in fab.shards.values()):
+        fab.run(0.5)
+        elapsed += 0.5
+
+    victim_sid = rng.integer(0, 1)
+    key = fab.mapping.shard_of(
+        users[victim_sid].identifier.encode())     # sanity: map intact
+    old_keys = dict(fab.mapping.directory_keys)
+    old_proof = fab.mapping.ownership_proof(
+        users[victim_sid].identifier.encode())
+    stale_client = fab.read_driver()               # trusts the OLD root
+    assert stale_client.checker.directory_keys == old_keys
+
+    # rotate one signer mid-load; writes keep flowing around it
+    victim_dir = sorted(fab.directory)[rng.integer(0, 3)]
+    new_signer = BlsCryptoSigner(
+        seed=(b"dirrot%d-%s" % (seed, victim_dir.encode()))
+        .ljust(32, b"\0")[:32])
+    fab.mapping.rotate_signer(victim_dir, new_signer)
+    u_mid = user_on_shard(fab, 0, b"dm%d-" % seed, start=30)
+    elapsed, target = 0.0, fab.shards[0].ordered_count() + 1
+    assert fab.submit_write(signed_write(fab, u_mid, 50)) == 0
+    while elapsed < 25.0 and fab.shards[0].ordered_count() < target:
+        fab.run(0.5)
+        elapsed += 0.5
+    assert fab.shards[0].ordered_count() >= target, \
+        f"seed {seed}: pool stalled across the directory rotation"
+
+    from plenum_tpu.shards import verify_ownership
+    new_keys = fab.mapping.directory_keys
+    # the root RE-SIGNED: fresh proofs verify under the new committee
+    fresh = fab.mapping.ownership_proof(users[victim_sid]
+                                        .identifier.encode())
+    got, why = verify_ownership(users[victim_sid].identifier.encode(),
+                                fresh, new_keys,
+                                now=fab.timer.get_current_time)
+    assert why == "ok" and got.shard_id == key.shard_id, \
+        f"seed {seed}: re-signed root does not verify ({why})"
+    # OLD-committee proofs fail closed against the rotated trust root
+    got, why = verify_ownership(users[victim_sid].identifier.encode(),
+                                old_proof, new_keys,
+                                now=fab.timer.get_current_time)
+    assert got is None and why == "bad_map_multi_sig", \
+        f"seed {seed}: old-committee proof accepted ({why})"
+    # a client on the NEW root verifies reads end to end
+    fresh_client = fab.read_driver()
+    q = Request("dr", 60, {"type": GET_NYM,
+                           "dest": users[victim_sid].identifier})
+    res = fresh_client.read(q, per_node_s=2.0, step_s=0.1)
+    assert res is not None and fresh_client.stats.summary()[
+        "map_proof_failures"] == 0, \
+        f"seed {seed}: rotated root broke healthy reads"
+    # a client still pinning the OLD root rejects the new signature —
+    # fail closed, never a silently-accepted downgrade
+    q2 = Request("dr", 61, dict(q.operation))
+    res = stale_client.read(q2, per_node_s=1.0, step_s=0.1)
+    s = stale_client.stats.summary()
+    assert res is None and \
+        s["map_failure_reasons"].get("bad_map_multi_sig", 0) >= 1, \
+        f"seed {seed}: old-root client accepted the rotated committee {s}"
+    for shard in fab.shards.values():
+        assert_safety(shard)
+
+
+DIR_ROTATION_SEEDS = 8
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(2))
+def test_sim_membership_churn_dir_rotation_fuzz(bucket):
+    for seed in range(bucket * 4, (bucket + 1) * 4):
+        _run_with_artifacts(run_membership_churn_dir_rotation_scenario,
+                            seed)
+
+
+def test_sim_membership_churn_dir_rotation_smoke():
+    _run_with_artifacts(run_membership_churn_dir_rotation_scenario, 1)
