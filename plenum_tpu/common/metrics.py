@@ -78,8 +78,21 @@ class MetricsName:
     READ_CACHE_HITS = "read_plane.cache_hits"
     READ_PROOFS_STATE = "read_plane.proofs_state"
     READ_PROOFS_MERKLE = "read_plane.proofs_merkle"
+    READ_PROOFS_VERKLE = "read_plane.proofs_verkle"
     READ_PROOFLESS = "read_plane.proofless"
     READ_ANCHOR_UPDATES = "read_plane.anchor_updates"
+    # per-kind envelope byte sizes (sampled -> p50/p95 in the report):
+    # proof bytes are the product WAN clients download, so the
+    # bytes-per-verified-read A/B (bench config13) reads production
+    # counters, not a bench-only tally. Single-key and multi-key
+    # envelopes sample SEPARATE names per kind — mixing a 16-key page
+    # into the single-read distribution would make its p95 describe
+    # nothing a client actually downloads per read
+    READ_PROOF_BYTES_STATE = "read_plane.proof_bytes_state"
+    READ_PROOF_BYTES_STATE_MULTI = "read_plane.proof_bytes_state_multi"
+    READ_PROOF_BYTES_MERKLE = "read_plane.proof_bytes_merkle"
+    READ_PROOF_BYTES_VERKLE = "read_plane.proof_bytes_verkle"
+    READ_PROOF_BYTES_VERKLE_MULTI = "read_plane.proof_bytes_verkle_multi"
     # ingress plane (ingress/plane.py): admitted/shed counters, the
     # queue-wait and total-queue-depth distributions (sampled -> p50/p95
     # in the report), per-dispatch auth batch size (sampled -> the batch
@@ -323,6 +336,11 @@ SAMPLED_NAMES = frozenset({
     MetricsName.BLS_PAIRINGS_PER_BATCH,
     MetricsName.CRYPTO_DISPATCH_BUDGET,
     MetricsName.READ_PROOF_GEN_TIME,
+    MetricsName.READ_PROOF_BYTES_STATE,
+    MetricsName.READ_PROOF_BYTES_STATE_MULTI,
+    MetricsName.READ_PROOF_BYTES_MERKLE,
+    MetricsName.READ_PROOF_BYTES_VERKLE,
+    MetricsName.READ_PROOF_BYTES_VERKLE_MULTI,
     MetricsName.SHARD_CROSS_VERIFY_TIME,
     MetricsName.INGRESS_QUEUE_WAIT, MetricsName.INGRESS_QUEUE_DEPTH,
     MetricsName.INGRESS_AUTH_BATCH,

@@ -347,6 +347,21 @@ class Config:
     # tunneled-TPU dispatch costs more than ~1k host hashes)
     PIPELINE_SHA_MIN_BATCH: int = 1024
 
+    # --- state commitment seam (state/commitment/) ---
+    # scheme every ledger's state uses: 'mpt' (default; wire format
+    # unchanged from the pre-interface code) or 'verkle' (wide-branching
+    # KZG commitments with aggregated multi-key openings — one envelope
+    # answers a whole client page; see docs/state_commitment.md)
+    STATE_COMMITMENT: str = "mpt"
+    # per-ledger overrides: {ledger_id: backend}; an entry wins over the
+    # pool-wide default (e.g. verkle for the read-heavy domain ledger,
+    # mpt for pool/config). Every node of a pool MUST agree — the
+    # backend defines the signed root anchors
+    STATE_COMMITMENT_PER_LEDGER: dict = field(default_factory=dict)
+    # Verkle branching factor (power of two <= 256). 256 = one stem byte
+    # per level, depth ~2 at 10k keys; smaller widths only for tests
+    VERKLE_WIDTH: int = 256
+
     # --- storage ---
     kv_backend: str = "memory"          # 'memory' | 'file'
 

@@ -12,6 +12,8 @@ from contextlib import ExitStack, contextmanager
 from typing import Iterable, Iterator, Optional
 
 from plenum_tpu.ledger.ledger import Ledger
+# any StateCommitment backend (state/commitment/); PruningState is the
+# default — the annotation names the interface shape, not the class
 from plenum_tpu.state.pruning_state import PruningState
 
 BLS_STORE_LABEL = "bls"

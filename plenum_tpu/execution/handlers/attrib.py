@@ -140,19 +140,25 @@ class GetAttrHandler(ReadRequestHandler):
                 except KeyError:
                     data = None
         root = self.state.committed_head_hash
-        proof = self.state.generate_state_proof(key, root_hash=root,
-                                                serialize=True)
         result = {"type": GET_ATTR, "dest": op["dest"],
                   "attr_name": op["attr_name"], "data": data,
                   "meta": meta,
                   "seqNo": meta.get("seqNo") if meta else None,
-                  "txnTime": meta.get("txnTime") if meta else None,
-                  "state_proof": {"root_hash": root.hex(),
-                                  "proof_nodes": proof.hex()
-                                  if isinstance(proof, bytes) else proof}}
-        bls_store = self.db.bls_store
-        if bls_store is not None:
-            sig = bls_store.get(root.hex())
-            if sig is not None:
-                result["state_proof"]["multi_signature"] = sig.to_list()
+                  "txnTime": meta.get("txnTime") if meta else None}
+        # legacy MPT-format state_proof: skipped on non-mpt ledgers (see
+        # GetNymHandler.get_result — a second aggregated opening nothing
+        # can verify would be dead weight; read_proof carries the real one)
+        from plenum_tpu.state.commitment import (BACKEND_MPT,
+                                                 commitment_backend_of)
+        if commitment_backend_of(self.state) == BACKEND_MPT:
+            proof = self.state.generate_state_proof(key, root_hash=root,
+                                                    serialize=True)
+            result["state_proof"] = {"root_hash": root.hex(),
+                                     "proof_nodes": proof.hex()
+                                     if isinstance(proof, bytes) else proof}
+            bls_store = self.db.bls_store
+            if bls_store is not None:
+                sig = bls_store.get(root.hex())
+                if sig is not None:
+                    result["state_proof"]["multi_signature"] = sig.to_list()
         return result

@@ -131,17 +131,25 @@ class GetNymHandler(ReadRequestHandler):
         raw = self.state.get(key, committed=True)
         data = unpack(raw) if raw is not None else None
         root = self.state.committed_head_hash
-        proof = self.state.generate_state_proof(key, root_hash=root,
-                                                serialize=True)
         result = {"type": GET_NYM, "dest": did, "data": data,
                   "seqNo": data.get("seqNo") if data else None,
-                  "txnTime": data.get("txnTime") if data else None,
-                  "state_proof": {"root_hash": root.hex(),
-                                  "proof_nodes": proof.hex()
-                                  if isinstance(proof, bytes) else proof}}
-        bls_store = self.db.bls_store
-        if bls_store is not None:
-            sig = bls_store.get(root.hex())
-            if sig is not None:
-                result["state_proof"]["multi_signature"] = sig.to_list()
+                  "txnTime": data.get("txnTime") if data else None}
+        # legacy MPT-format state_proof field: only legacy MPT verifiers
+        # consume it, so a non-mpt ledger skips it — generating a second
+        # aggregated opening per read that nothing can check would double
+        # proof-gen cost for dead wire weight (verkle clients verify the
+        # read_proof envelope the ReadPlane attaches)
+        from plenum_tpu.state.commitment import (BACKEND_MPT,
+                                                 commitment_backend_of)
+        if commitment_backend_of(self.state) == BACKEND_MPT:
+            proof = self.state.generate_state_proof(key, root_hash=root,
+                                                    serialize=True)
+            result["state_proof"] = {"root_hash": root.hex(),
+                                     "proof_nodes": proof.hex()
+                                     if isinstance(proof, bytes) else proof}
+            bls_store = self.db.bls_store
+            if bls_store is not None:
+                sig = bls_store.get(root.hex())
+                if sig is not None:
+                    result["state_proof"]["multi_signature"] = sig.to_list()
         return result

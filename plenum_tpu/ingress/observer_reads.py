@@ -197,13 +197,22 @@ class SimObserver:
                  anchor_lag_max=FROM_CONFIG,
                  send: Optional[Callable] = None,
                  metrics: Optional[MetricsCollector] = None,
-                 tracer=None):
+                 tracer=None, state_commitment: str = "mpt",
+                 state_commitment_per_ledger: Optional[dict] = None,
+                 verkle_width: Optional[int] = None):
         from plenum_tpu.node.bootstrap import NodeBootstrap
         from plenum_tpu.node.observer import NodeObserver
         self.name = name
         self.client_id = f"obs:{name}"
         self.validator_names = list(validator_names)
-        components = NodeBootstrap(name, genesis_txns=genesis).build()
+        # the observer's replicated state MUST use the validators' scheme
+        # — its roots have to land on the multi-signed anchors, or every
+        # read it serves degrades to proofless escalation
+        components = NodeBootstrap(
+            name, genesis_txns=genesis,
+            state_commitment=state_commitment,
+            state_commitment_per_ledger=state_commitment_per_ledger,
+            verkle_width=verkle_width).build()
         self.c = components
         self.observer = NodeObserver(components, f=f)
         self.gate = ObserverReadGate(

@@ -42,7 +42,6 @@ from plenum_tpu.ledger.ledger import Ledger
 from plenum_tpu.ledger.tree_hasher import make_tree_hasher
 from plenum_tpu.node.client_authn import CoreAuthNr, ReqAuthenticator
 from plenum_tpu.node.pool_manager import TxnPoolManager
-from plenum_tpu.state.pruning_state import PruningState
 from plenum_tpu.storage.kv_file import KvFile
 from plenum_tpu.storage.kv_memory import KvMemory
 
@@ -78,7 +77,10 @@ class NodeBootstrap:
                  storage_backend: str = "native",
                  plugins=None,
                  verifier=None,
-                 pipeline=None):
+                 pipeline=None,
+                 state_commitment: str = "mpt",
+                 state_commitment_per_ledger: Optional[dict] = None,
+                 verkle_width: Optional[int] = None):
         self.name = name
         self.genesis = genesis_txns or {}
         self.data_dir = data_dir
@@ -102,6 +104,13 @@ class NodeBootstrap:
         # checks all stage into its shared ring (co-hosted nodes pass ONE
         # instance — that sharing IS the cross-node coalescing/dedup)
         self.pipeline = pipeline
+        # per-ledger state commitment scheme (state/commitment/): 'mpt'
+        # default, 'verkle' for aggregated multi-key openings; the whole
+        # pool must agree (the backend defines the signed root anchors)
+        self.state_commitment = state_commitment
+        self.state_commitment_per_ledger = \
+            dict(state_commitment_per_ledger or {})
+        self.verkle_width = verkle_width
 
     # --- storage factories -------------------------------------------------
 
@@ -154,16 +163,28 @@ class NodeBootstrap:
 
     # --- build -------------------------------------------------------------
 
+    def _state(self, ledger_id: int, label: str):
+        """Per-ledger state through the commitment seam: the configured
+        scheme ('mpt' default; the Verkle backend additionally stages its
+        batch commitment updates through the shared pipeline's commitment
+        wave kind when one is wired)."""
+        from plenum_tpu.state.commitment import (backend_for_ledger,
+                                                 make_state)
+        backend = backend_for_ledger(ledger_id, self.state_commitment,
+                                     self.state_commitment_per_ledger)
+        return make_state(backend, db=self._kv(label),
+                          width=self.verkle_width, pipeline=self.pipeline)
+
     def build(self) -> NodeComponents:
         db = DatabaseManager()
         # catchup order: audit, pool, config, domain (ref node.py:142)
         db.register_ledger(AUDIT_LEDGER_ID, self._ledger(AUDIT_LEDGER_ID, "audit"))
         db.register_ledger(POOL_LEDGER_ID, self._ledger(POOL_LEDGER_ID, "pool"),
-                           PruningState(self._kv("pool_state")))
+                           self._state(POOL_LEDGER_ID, "pool_state"))
         db.register_ledger(CONFIG_LEDGER_ID, self._ledger(CONFIG_LEDGER_ID, "config"),
-                           PruningState(self._kv("config_state")))
+                           self._state(CONFIG_LEDGER_ID, "config_state"))
         db.register_ledger(DOMAIN_LEDGER_ID, self._ledger(DOMAIN_LEDGER_ID, "domain"),
-                           PruningState(self._kv("domain_state")))
+                           self._state(DOMAIN_LEDGER_ID, "domain_state"))
         db.register_store(TS_STORE_LABEL,
                           StateTsStore(self._kv("ts_store")))
         db.register_store(SEQ_NO_DB_LABEL, self._kv("seq_no_db"))

@@ -644,6 +644,8 @@ class Node:
                                rp["proofs_state"])
         self.metrics.add_event(MetricsName.READ_PROOFS_MERKLE,
                                rp["proofs_merkle"])
+        self.metrics.add_event(MetricsName.READ_PROOFS_VERKLE,
+                               rp["proofs_verkle"])
         self.metrics.add_event(MetricsName.READ_PROOFLESS,
                                rp["proofless"])
         self.metrics.add_event(MetricsName.READ_ANCHOR_UPDATES,

@@ -61,16 +61,24 @@ class ObserverNode:
                  storage_backend: str = "memory",
                  client_port: Optional[int] = None,
                  client_host: str = "0.0.0.0",
-                 anchor_lag_max=FROM_CONFIG):
+                 anchor_lag_max=FROM_CONFIG,
+                 state_commitment: str = "mpt",
+                 state_commitment_per_ledger: Optional[dict] = None,
+                 verkle_width: Optional[int] = None):
         import time as _time
 
         from plenum_tpu.ingress.observer_reads import ObserverReadGate
         from plenum_tpu.node.bootstrap import NodeBootstrap
         self.name = name
         self.addrs = dict(addrs)
+        # replicated state rides the validators' commitment scheme (the
+        # multi-signed anchors are scheme-defined; see SimObserver note)
         components = NodeBootstrap(
             name, genesis_txns=genesis_txns, data_dir=data_dir,
-            storage_backend=storage_backend).build()
+            storage_backend=storage_backend,
+            state_commitment=state_commitment,
+            state_commitment_per_ledger=state_commitment_per_ledger,
+            verkle_width=verkle_width).build()
         self.observer = NodeObserver(components, f=f)
         # read fan-out (ROADMAP item 3): serve PR 4 read_proof envelopes
         # from the replicated state at the last VERIFIED BLS anchor;

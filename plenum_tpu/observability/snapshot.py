@@ -103,8 +103,14 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
     "reads": frozenset({
         MetricsName.READ_QUERIES, MetricsName.READ_PROOF_GEN_TIME,
         MetricsName.READ_CACHE_HITS, MetricsName.READ_PROOFS_STATE,
-        MetricsName.READ_PROOFS_MERKLE, MetricsName.READ_PROOFLESS,
+        MetricsName.READ_PROOFS_MERKLE, MetricsName.READ_PROOFS_VERKLE,
+        MetricsName.READ_PROOFLESS,
         MetricsName.READ_ANCHOR_UPDATES,
+        MetricsName.READ_PROOF_BYTES_STATE,
+        MetricsName.READ_PROOF_BYTES_STATE_MULTI,
+        MetricsName.READ_PROOF_BYTES_MERKLE,
+        MetricsName.READ_PROOF_BYTES_VERKLE,
+        MetricsName.READ_PROOF_BYTES_VERKLE_MULTI,
         MetricsName.OBSERVER_PUSHES, MetricsName.OBSERVER_MS_ADOPTED,
         MetricsName.OBSERVER_MS_REJECTED,
         MetricsName.OBSERVER_STALE_SUPPRESSED,

@@ -15,14 +15,15 @@ coalescing ACROSS call sites, not within them.
 co-hosted nodes share (the in-process pool, a multi-replica host, the
 bench topology):
 
-* **One submission ring, three kinds.** Ingress client-auth Ed25519
+* **One submission ring, four kinds.** Ingress client-auth Ed25519
   items (node/client_authn.py `submit_batch`), commit-path BLS batch
-  checks (crypto/bls.py `batch_verify`), and ledger Merkle leaf/interior
-  hashing (ledger/tree_hasher.py) all stage into per-kind rings with
-  per-kind completion tokens — callers keep today's submit/collect
-  semantics unchanged (the adapters at the bottom of this module
-  implement the existing `Ed25519Verifier` / `BlsCryptoVerifier` /
-  `TreeHasher` protocols).
+  checks (crypto/bls.py `batch_verify`), ledger Merkle leaf/interior
+  hashing (ledger/tree_hasher.py), and state-commitment waves (Verkle
+  node recommits + aggregated proof generation, state/commitment/) all
+  stage into per-kind rings with per-kind completion tokens — callers
+  keep today's submit/collect semantics unchanged (the adapters at the
+  bottom of this module implement the existing `Ed25519Verifier` /
+  `BlsCryptoVerifier` / `TreeHasher` protocols).
 
 * **Shape-bucketed pinned dispatch.** Ed25519 waves pad to a pinned
   power-of-two bucket ladder so steady state never meets a novel XLA
@@ -78,6 +79,7 @@ from plenum_tpu.ops.ed25519 import L as _ED_L
 KIND_ED = "ed"
 KIND_BLS = "bls"
 KIND_SHA = "sha"
+KIND_CMT = "cmt"                 # state-commitment updates / proof gen
 
 # rolling controller window per knob decision
 _CTL_WINDOW = 256
@@ -271,7 +273,8 @@ class CryptoPipeline:
 
     def __init__(self, ed_inner: Optional[Ed25519Verifier] = None,
                  bls_inner=None, config=None, now=None,
-                 sha_device: bool = False, sha_min_device: int = 1024):
+                 sha_device: bool = False, sha_min_device: int = 1024,
+                 cmt_inner=None):
         from plenum_tpu.config import Config
         self.config = config or Config()
         self._now = now or time.monotonic
@@ -289,6 +292,12 @@ class CryptoPipeline:
         self._bls_inner = bls_inner
         self._sha_device = sha_device
         self._sha_min_device = sha_min_device
+        # state-commitment lane engine (state/commitment/): injectable so
+        # a device MSM backend can slot in behind supervise(); None =
+        # lazy default KZG engine. Degrade contract mirrors the ed lane:
+        # an engine failure re-runs the wave on the default host engine,
+        # never raises into the caller
+        self._cmt_inner = cmt_inner
 
         # pinned bucket ladder: pow2 steps between the config bounds
         b, self.buckets = self.config.PIPELINE_MIN_BUCKET, []
@@ -304,11 +313,13 @@ class CryptoPipeline:
         self._ed_first_staged: Optional[float] = None
         self._bls_staged: list[_SyncToken] = []
         self._sha_staged: list[_SyncToken] = []
+        self._cmt_staged: list[_SyncToken] = []
 
         # bounded content-keyed caches (cross-flush dedup; pure functions
         # of content, so a hit can never change a verdict/digest)
         self._ed_cache: dict[bytes, bool] = {}
         self._sha_cache: dict[bytes, bytes] = {}
+        self._cmt_cache: dict[bytes, object] = {}
         self._CACHE_MAX = 65536
 
         # compile-shape guard: every distinct dispatched shape key; after
@@ -336,6 +347,7 @@ class CryptoPipeline:
             "overflow_waves": 0,
             "bls_batches": 0, "bls_items": 0, "bls_unique": 0,
             "sha_batches": 0, "sha_items": 0, "sha_unique": 0,
+            "cmt_batches": 0, "cmt_items": 0, "cmt_unique": 0,
             "unpinned_shapes": 0,
         }
 
@@ -434,6 +446,7 @@ class CryptoPipeline:
         n = sum(len(t.items) - t.planned for t in self._ed_staged)
         n += sum(len(t.items) for t in self._bls_staged)
         n += sum(len(t.items) for t in self._sha_staged)
+        n += sum(len(t.items) for t in self._cmt_staged)
         return n
 
     def _cache_bucket(self, n_keys: int, bucket: int) -> tuple:
@@ -673,6 +686,7 @@ class CryptoPipeline:
         if force:
             progressed |= self._flush_bls()
             progressed |= self._flush_sha()
+            progressed |= self._flush_cmt()
         return progressed
 
     def flush(self) -> None:
@@ -825,6 +839,120 @@ class CryptoPipeline:
             self._flush_sha()
         return token.results
 
+    # --- state commitment: batched node recommits / proof generation -------
+
+    def submit_commitment(self, jobs: Sequence[tuple]) -> _SyncToken:
+        """jobs (hashable content, produced by the Verkle backend):
+          ("commit", width, ((slot, scalar), ...))        -> (f_tau, c_enc)
+          ("multiproof", ((c_enc, f_tau, z, y), ...))     -> (d_enc, pi_enc)
+        Co-hosted nodes committing the SAME ordered batch to the same
+        state stage IDENTICAL jobs — content dedup makes the recommit
+        cost per wave one per distinct node vector, not one per replica
+        (the same cross-submitter saving as the ed/sha lanes)."""
+        tok = _SyncToken([tuple(j) for j in jobs])
+        self.stats["submitted_items"] += len(tok.items)
+        self._cmt_staged.append(tok)
+        return tok
+
+    @staticmethod
+    def _cmt_key(job: tuple) -> bytes:
+        # content key over the job tuple; scalars are bigints (mod R), so
+        # repr — deterministic for ints/bytes/tuples — beats msgpack here
+        return hashlib.sha256(repr(job).encode()).digest()
+
+    # bucket-pad filler: a width-2 empty commit is the cheapest valid job
+    _CMT_PAD_JOB = ("commit", 2, ())
+
+    def _cmt_run(self, jobs: Sequence[tuple]) -> list:
+        """Host engine with PER-JOB fault isolation: a malformed job
+        resolves to None (its submitter's inline fallback recomputes),
+        never taking the rest of the wave down with it."""
+        from plenum_tpu.state.commitment import kzg
+        out = []
+        for job in jobs:
+            try:
+                if job[0] == "commit":
+                    out.append(kzg.engine_for(job[1])
+                               .commit(dict(job[2])))
+                elif job[0] == "multiproof":
+                    out.append(kzg.prove_multi(list(job[1])))
+                else:
+                    out.append(None)
+            except Exception:
+                out.append(None)
+        return out
+
+    def _flush_cmt(self) -> bool:
+        if not self._cmt_staged:
+            return False
+        staged, self._cmt_staged = self._cmt_staged, []
+        unique: "OrderedDict[bytes, tuple]" = OrderedDict()
+        for tok in staged:
+            for i, job in enumerate(tok.items):
+                try:
+                    key = self._cmt_key(job)
+                except Exception:
+                    tok.plan[i] = ("k", None)
+                    continue
+                hit = self._cmt_cache.get(key)
+                if hit is not None:
+                    tok.plan[i] = ("k", hit)
+                    self.stats["dedup_hits"] += 1
+                    self.stats["cache_hits"] += 1
+                    continue
+                if key in unique:
+                    self.stats["dedup_hits"] += 1
+                else:
+                    unique[key] = job
+                tok.plan[i] = ("u", key)
+        todo = list(unique.values())
+        self.stats["cmt_batches"] += 1
+        self.stats["cmt_items"] += sum(len(t.items) for t in staged)
+        self.stats["cmt_unique"] += len(todo)
+        results: list = []
+        if todo:
+            # same pinned-shape discipline as the ed lane: the wave is
+            # PADDED to the pow2 bucket the guard records, so what a
+            # device MSM engine behind cmt_inner compiles is exactly the
+            # noted shape (a noted-but-unpadded bucket would let ragged
+            # lengths recompile in steady state with unpinned_shapes=0)
+            bucket = 1
+            while bucket < len(todo):
+                bucket *= 2
+            self.note_shape((KIND_CMT, bucket))
+            engine = self._cmt_inner
+            if engine is None:
+                # host engine: no compiled shapes, so no pad lanes
+                results = self._cmt_run(todo)
+            else:
+                wave = todo + [self._CMT_PAD_JOB] * (bucket - len(todo))
+                try:
+                    results = list(engine.run_jobs(wave))[:len(todo)]
+                    if len(results) != len(todo):
+                        raise ValueError("engine returned a short wave")
+                except Exception:
+                    # breaker-style degrade: re-run on the default host
+                    # engine (per-job isolated — a still-failing job is
+                    # None and its submitter's inline path recomputes)
+                    results = self._cmt_run(todo)
+            by_key = dict(zip(unique.keys(), results))
+            for key, res in by_key.items():
+                if res is not None:
+                    verdict_cache_put(self._cmt_cache, self._CACHE_MAX,
+                                      key, res)
+        else:
+            by_key = {}
+        for tok in staged:
+            tok.results = [e[1] if e[0] == "k" else by_key.get(e[1])
+                           for e in tok.plan]
+        return True
+
+    def collect_commitment(self, token: _SyncToken, wait: bool = True):
+        if token.results is None:
+            self.service()           # overlap: pump the ed lane first
+            self._flush_cmt()
+        return token.results
+
     # --- adapters ----------------------------------------------------------
 
     def verifier(self) -> "PipelineVerifier":
@@ -878,6 +1006,8 @@ class CryptoPipeline:
             "bls": {k: self.stats[f"bls_{k}"]
                     for k in ("batches", "items", "unique")},
             "sha": {k: self.stats[f"sha_{k}"]
+                    for k in ("batches", "items", "unique")},
+            "cmt": {k: self.stats[f"cmt_{k}"]
                     for k in ("batches", "items", "unique")},
         }
         if self.controller is not None:

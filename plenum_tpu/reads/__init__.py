@@ -2,7 +2,9 @@
 
 A read answered by ONE node is trustworthy when the reply carries a proof
 anchored to a BLS multi-signed root: an MPT state proof against the signed
-state root for trie-backed queries, an RFC-6962 inclusion proof against the
+state root for trie-backed queries (or ONE aggregated Verkle multi-key
+opening on wide-commitment ledgers — state/commitment/, the ``verkle``
+envelope kind), an RFC-6962 inclusion proof against the
 signed txn root for GET_TXN. The server half (ReadPlane) wraps every
 ReadRequestManager result in that envelope and caches results per signed
 root; the client half (VerifyingReadClient / SimReadDriver) sends each read

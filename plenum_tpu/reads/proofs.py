@@ -6,7 +6,7 @@ the ONE format both sides speak — the server's ReadPlane builds it
 `verify_read_proof`, which fails CLOSED: any malformed, truncated, or
 tampered envelope verifies False, never raises, never True.
 
-Two proof kinds:
+Three proof kinds:
 
 ``state`` — trie-backed queries. A chain of MPT proofs, every entry under
     ONE signed state root: ``entries[i] = {key, value, proof}``. The
@@ -14,6 +14,14 @@ Two proof kinds:
     node cannot substitute a different key) via `state_read_plan`, checks
     each proof, then checks the visible result data is the proven values'
     projection (`check_consistency`).
+
+``verkle`` — the same queries on a Verkle-backed ledger
+    (state/commitment/): the entries carry keys+values only, and ONE
+    aggregated multi-key opening at the envelope level
+    (``proof = {width, commitments, keys, d, pi}``) covers the whole
+    page. Key derivation and data-consistency rules are identical to
+    ``state``; MPT-backed ledgers never emit this kind (nothing changes
+    on their wire).
 
 ``merkle`` — GET_TXN. RFC-6962 inclusion of the txn leaf in the ledger's
     Merkle tree at the SIGNED tree size, anchored to the multi-sig's
@@ -44,6 +52,10 @@ from plenum_tpu.execution.txn import (GET_ATTR, GET_FROZEN_LEDGERS, GET_NYM,
 READ_PROOF = "read_proof"
 KIND_STATE = "state"
 KIND_MERKLE = "merkle"
+# wide-commitment state (state/commitment/verkle.py): ONE aggregated
+# multi-key opening answers the whole key page — the entries carry no
+# per-key proof field; the envelope-level "proof" covers them all
+KIND_VERKLE = "verkle"
 
 # Default client freshness bound. Anchors refresh when a batch commits OR
 # when the primary's periodic freshness batch re-signs idle roots
@@ -211,6 +223,52 @@ def build_state_envelope(ms: MultiSignature, ledger_id: int, root_hex: str,
     }
 
 
+def verkle_proof_to_wire(proof: Mapping) -> dict:
+    """batch_open output (raw bytes) -> the hex-field wire form the
+    envelope carries (symmetric with the other kinds' hex discipline)."""
+    return {
+        "width": int(proof["width"]),
+        "commitments": [c.hex() for c in proof["commitments"]],
+        "keys": [{"path": [[int(ci), int(slot)] for ci, slot in k["path"]],
+                  "term": [k["term"][0]] + [x.hex() for x in k["term"][1:]]}
+                 for k in proof["keys"]],
+        "d": proof["d"].hex(),
+        "pi": proof["pi"].hex(),
+    }
+
+
+def wire_to_verkle_proof(wire: Mapping) -> dict:
+    return {
+        "width": int(wire["width"]),
+        "commitments": [bytes.fromhex(c) for c in wire["commitments"]],
+        "keys": [{"path": [[int(ci), int(slot)]
+                           for ci, slot in k["path"]],
+                  "term": [k["term"][0]] + [bytes.fromhex(x)
+                                            for x in k["term"][1:]]}
+                 for k in wire["keys"]],
+        "d": bytes.fromhex(wire["d"]),
+        "pi": bytes.fromhex(wire["pi"]),
+    }
+
+
+def build_verkle_envelope(ms: MultiSignature, ledger_id: int,
+                          root_hex: str,
+                          entries: Sequence[tuple[bytes, Optional[bytes]]],
+                          proof: Mapping) -> dict:
+    """entries: the page's (key, value) pairs in plan order; proof: ONE
+    aggregated batch_open covering every entry."""
+    return {
+        "kind": KIND_VERKLE,
+        "ledger_id": ledger_id,
+        "root_hash": root_hex,
+        "entries": [{"key": k.hex(),
+                     "value": v.hex() if v is not None else None}
+                    for k, v in entries],
+        "proof": verkle_proof_to_wire(proof),
+        "multi_signature": ms.to_list(),
+    }
+
+
 def build_merkle_envelope(ms: MultiSignature, ledger_id: int, root_hex: str,
                           seq_no: int, tree_size: int,
                           audit_path: Sequence[bytes],
@@ -235,6 +293,85 @@ def build_merkle_envelope(ms: MultiSignature, ledger_id: int, root_hex: str,
 # --- verification (client side) ---------------------------------------------
 
 NO_PROOF = "no_proof"          # distinguished: fall back, don't fail over
+
+
+def _verify_anchor(env: Mapping, bls_keys: Mapping[str, str],
+                   freshness_s: float, now, n_nodes,
+                   ms_cache: Optional[dict] = None):
+    """The anchor preamble every envelope verifier shares: multi-sig
+    against the pool keys (memoized via ms_cache when given) + the
+    freshness window. -> (MultiSignature, "ok") or (None, reason)."""
+    ms = MultiSignature.from_list(list(env["multi_signature"]))
+    cache_key = (ms.signature, ms.participants, ms.value)
+    verdict = ms_cache.get(cache_key) if ms_cache is not None else None
+    if verdict is None:
+        verdict = ms.verify(bls_keys, n=n_nodes)
+        if ms_cache is not None:
+            if len(ms_cache) >= 1024:
+                ms_cache.clear()
+            ms_cache[cache_key] = verdict
+    if not verdict:
+        return None, "bad_multi_sig"
+    clock = now() if now is not None else time.time()
+    if abs(clock - ms.value.timestamp) > freshness_s:
+        return None, "stale"
+    return ms, "ok"
+
+
+def verify_page_envelope(env: Mapping, keys: Sequence[bytes],
+                         bls_keys: Mapping[str, str],
+                         ledger_id: int,
+                         freshness_s: float = DEFAULT_FRESHNESS_S,
+                         now: Optional[Callable[[], float]] = None,
+                         n_nodes: Optional[int] = None
+                         ) -> tuple[bool, Optional[list], str]:
+    """Verify a ReadPlane.page_envelope against the CLIENT's own intent:
+    its key page AND its target ledger (a lying server cannot substitute
+    another page — or a signed envelope from a DIFFERENT ledger where
+    the same key bytes resolve differently), then multi-sig, freshness,
+    signed-root binding, and the proof(s) — one aggregated opening for
+    ``verkle``, per-key chains for ``state``.
+    -> (ok, values-in-page-order, reason); never raises."""
+    try:
+        ms, reason = _verify_anchor(env, bls_keys, freshness_s, now,
+                                    n_nodes)
+        if ms is None:
+            return False, None, reason
+        root_hex = env["root_hash"]
+        if ms.value.state_root_hash != root_hex or \
+                ms.value.ledger_id != ledger_id or \
+                int(env["ledger_id"]) != ledger_id:
+            return False, None, "unsigned_root"
+        root = bytes.fromhex(root_hex)
+        entries = env["entries"]
+        if len(entries) != len(keys):
+            return False, None, "key_chain_mismatch"
+        values = []
+        pairs = []
+        for e, key in zip(entries, keys):
+            if bytes.fromhex(e["key"]) != bytes(key):
+                return False, None, "key_mismatch"
+            value = bytes.fromhex(e["value"]) \
+                if e.get("value") is not None else None
+            values.append(value)
+            pairs.append((bytes(key), value))
+        kind = env.get("kind")
+        if kind == KIND_VERKLE:
+            from plenum_tpu.state.commitment.verkle import VerkleState
+            if not VerkleState.verify_batch_proof(
+                    root, pairs, wire_to_verkle_proof(env["proof"])):
+                return False, None, "bad_verkle_proof"
+        elif kind == KIND_STATE:
+            from plenum_tpu.state.pruning_state import PruningState
+            for e, (key, value) in zip(entries, pairs):
+                if not PruningState.verify_state_proof(
+                        root, key, value, bytes.fromhex(e["proof"])):
+                    return False, None, "bad_state_proof"
+        else:
+            return False, None, "bad_kind"
+        return True, values, "ok"
+    except Exception:
+        return False, None, "malformed"
 
 
 def verify_read_proof(txn_type: Optional[str], operation: Mapping,
@@ -268,7 +405,7 @@ def _verify(txn_type, operation, result, bls_keys, freshness_s, now,
     if not isinstance(env, Mapping):
         return False, NO_PROOF
     kind = env.get("kind")
-    if kind not in (KIND_STATE, KIND_MERKLE):
+    if kind not in (KIND_STATE, KIND_MERKLE, KIND_VERKLE):
         return False, NO_PROOF if kind in (None, "none") else "bad_kind"
 
     # the proof must be about THIS result, not a spliced-in honest one
@@ -277,23 +414,15 @@ def _verify(txn_type, operation, result, bls_keys, freshness_s, now,
             bytes.fromhex(claimed) != result_digest(result):
         return False, "result_digest_mismatch"
 
-    ms = MultiSignature.from_list(list(env["multi_signature"]))
-    cache_key = (ms.signature, ms.participants, ms.value)
-    verdict = ms_cache.get(cache_key) if ms_cache is not None else None
-    if verdict is None:
-        verdict = ms.verify(bls_keys, n=n_nodes)
-        if ms_cache is not None:
-            if len(ms_cache) >= 1024:
-                ms_cache.clear()
-            ms_cache[cache_key] = verdict
-    if not verdict:
-        return False, "bad_multi_sig"
-    clock = now() if now is not None else time.time()
-    if abs(clock - ms.value.timestamp) > freshness_s:
-        return False, "stale"
+    ms, reason = _verify_anchor(env, bls_keys, freshness_s, now, n_nodes,
+                                ms_cache=ms_cache)
+    if ms is None:
+        return False, reason
 
     if kind == KIND_STATE:
         return _verify_state(txn_type, operation, result, env, ms)
+    if kind == KIND_VERKLE:
+        return _verify_verkle(txn_type, operation, result, env, ms)
     return _verify_merkle(operation, result, env, ms)
 
 
@@ -324,6 +453,47 @@ def _verify_state(txn_type, operation, result, env, ms) -> tuple[bool, str]:
         if not PruningState.verify_state_proof(
                 root, key, value, bytes.fromhex(e["proof"])):
             return False, "bad_state_proof"
+    if not check_consistency(txn_type, operation, values, result):
+        return False, "data_mismatch"
+    return True, "ok"
+
+
+def _verify_verkle(txn_type, operation, result, env, ms
+                   ) -> tuple[bool, str]:
+    """The Verkle twin of _verify_state: same client-derived key chain,
+    same signed-root anchoring, same data-consistency projection — but
+    the whole page rides ONE aggregated opening (state/commitment/
+    verkle.py verify_batch_proof), so a spliced value inside the page
+    (one key's value swapped, everything else honest) fails the single
+    pairing check, not just its own entry."""
+    from plenum_tpu.state.commitment.verkle import VerkleState
+    plan = state_read_plan(txn_type, operation)
+    if plan is None:
+        return False, "unplannable_query"
+    if result.get("type") != txn_type:
+        return False, "wrong_type_echo"
+    ledger_id, steps = plan
+    if int(env["ledger_id"]) != ledger_id or \
+            ms.value.ledger_id != ledger_id:
+        return False, "wrong_ledger"
+    root_hex = env["root_hash"]
+    if ms.value.state_root_hash != root_hex:
+        return False, "unsigned_root"
+    entries = env["entries"]
+    values = [bytes.fromhex(e["value"]) if e.get("value") is not None
+              else None for e in entries]
+    expected = resolve_plan_keys(steps, values)
+    if expected is None or len(entries) != len(expected):
+        return False, "key_chain_mismatch"
+    pairs = []
+    for e, key, value in zip(entries, expected, values):
+        if bytes.fromhex(e["key"]) != key:
+            return False, "key_mismatch"
+        pairs.append((key, value))
+    proof = wire_to_verkle_proof(env["proof"])
+    if not VerkleState.verify_batch_proof(bytes.fromhex(root_hex),
+                                          pairs, proof):
+        return False, "bad_verkle_proof"
     if not check_consistency(txn_type, operation, values, result):
         return False, "data_mismatch"
     return True, "ok"

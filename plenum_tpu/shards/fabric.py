@@ -71,7 +71,11 @@ class SimShard:
                 name, genesis_txns=self.genesis,
                 crypto_backend=config.crypto_backend,
                 verifier=verifier,
-                pipeline=pipeline).build()
+                pipeline=pipeline,
+                state_commitment=config.STATE_COMMITMENT,
+                state_commitment_per_ledger=(
+                    config.STATE_COMMITMENT_PER_LEDGER),
+                verkle_width=config.VERKLE_WIDTH).build()
             tracer = Tracer(name, timer.get_current_time,
                             clock_domain="shared",
                             tags={"shard": shard_id}) if tracing else None

@@ -104,11 +104,13 @@ class PruningState:
     @staticmethod
     def verify_state_proof(root_hash: bytes, key: bytes, value: Optional[bytes],
                            proof) -> bool:
-        """Check that `key` maps to `value` (None = absent) under root_hash."""
+        """Check that `key` maps to `value` (None = absent) under root_hash.
+        Fails CLOSED: undecodable proof bytes are False, never a raise
+        (the StateCommitment verifier contract both backends pin)."""
         from . import rlp as _rlp
-        if isinstance(proof, (bytes, bytearray)):
-            proof = _rlp.decode(bytes(proof))
         try:
+            if isinstance(proof, (bytes, bytearray)):
+                proof = _rlp.decode(bytes(proof))
             present, got = Trie.verify_proof(root_hash, key, list(proof))
         except Exception:
             return False

@@ -1242,6 +1242,166 @@ def config12_reshard(n_users: int = 320, phase_s: float = 20.0) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def config13_commitment(page_size: int = 16, n_dids: int = 48,
+                        n_pages: int = 8, timeout: float = 90.0) -> dict:
+    """Proof-size / verify-time A/B between the two state-commitment
+    backends (docs/state_commitment.md): the SAME 4-node pool + DID set,
+    once with STATE_COMMITMENT=mpt and once =verkle.
+
+    Measures, per arm:
+
+    * a 16-key client page as ONE envelope (`ReadPlane.page_envelope` —
+      Verkle aggregates the whole page into one opening; MPT's baseline
+      is the honest per-key sibling chains), bytes from the PRODUCTION
+      proof-byte counters (read_plane.proof_bytes_*), client verify
+      p50/p95 over `verify_page_envelope`;
+    * single verified GET_NYM reads through the ordinary ladder
+      (driver verify p50/p95 + per-envelope bytes);
+    * the expected transfer time of one page over the ``lossy_wan``
+      inter-region link profile (2.5e6 B/s, 3% loss -> x1/(1-p)
+      expected retransmission bytes) — the bytes-are-the-product
+      framing for WAN clients.
+
+    Arms run INTERLEAVED with one discarded warm-up and medians of 3
+    (the bench-host contention lesson from config5).
+    """
+    import plenum_tpu.tools.local_pool as lp
+    from plenum_tpu.common.metrics import MetricsName, percentile
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.common.serialization import pack
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import GET_NYM, NYM
+    from plenum_tpu.reads import SimReadDriver
+    from plenum_tpu.reads.proofs import verify_page_envelope
+
+    LOSSY_BW = 2.5e6                 # bytes/s (lossy_wan inter-region)
+    LOSSY_LOSS = 0.03
+
+    def one_arm(backend: str) -> dict:
+        (names, nodes, timer, trustee,
+         replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(
+             4, "cpu", config_overrides={"STATE_COMMITMENT": backend})
+        users, setup = [], []
+        for i in range(n_dids):
+            u = Ed25519Signer(seed=(b"c13%05d" % i).ljust(32, b"\0")[:32])
+            users.append(u)
+            req = Request(trustee.identifier, i + 1,
+                          {"type": NYM, "dest": u.identifier,
+                           "verkey": u.verkey_b58})
+            req.signature = trustee.sign_b58(req.signing_bytes())
+            setup.append(req)
+        done, _ = _drive_inprocess(names, nodes, timer, replies, ReplyCls,
+                                   plane, setup, timeout)
+        if done < len(setup):
+            return {"error": f"{backend}: ordered {done}/{len(setup)}"}
+        bls_keys = lp.pool_bls_keys(names)
+        node = nodes[names[0]]
+
+        # --- single reads through the verified ladder ---
+        def submit(name, req):
+            nodes[name].handle_client_message(req.to_dict(), "c13")
+
+        def collect(name):
+            out = [m.result for _, m, c in replies[name]
+                   if isinstance(m, ReplyCls) and c == "c13"]
+            replies[name].clear()
+            return out
+
+        def pump(seconds):
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                timer.service()
+                for nd in nodes.values():
+                    nd.prod()
+
+        driver = SimReadDriver(submit, collect, pump, names, bls_keys,
+                               freshness_s=1e12,
+                               now=timer.get_current_time)
+        served = 0
+        for i, u in enumerate(users[:page_size]):
+            q = Request("c13r", i + 1,
+                        {"type": GET_NYM, "dest": u.identifier})
+            if driver.read(q, per_node_s=2.0, step_s=0.001) is not None:
+                served += 1
+        s = driver.stats.summary()
+
+        # --- the 16-key page as ONE envelope ---
+        page_keys = [u.identifier.encode() for u in users[:page_size]]
+        gen_s: list[float] = []
+        env = None
+        for _ in range(n_pages):
+            t0 = time.perf_counter()
+            env = node.read_plane.page_envelope(DOMAIN, page_keys)
+            gen_s.append(time.perf_counter() - t0)
+        if env is None:
+            return {"error": f"{backend}: page envelope unanchorable"}
+        page_bytes = len(pack(env))
+        ver_s: list[float] = []
+        for _ in range(n_pages):
+            t0 = time.perf_counter()
+            ok, values, why = verify_page_envelope(
+                env, page_keys, bls_keys, DOMAIN, freshness_s=1e12,
+                now=timer.get_current_time)
+            ver_s.append(time.perf_counter() - t0)
+            if not ok:
+                return {"error": f"{backend}: page verify failed ({why})"}
+
+        # production proof-byte counters (the satellite contract: the
+        # A/B reads what the node actually sampled, not a bench tally)
+        metric = (MetricsName.READ_PROOF_BYTES_VERKLE_MULTI
+                  if backend == "verkle"
+                  else MetricsName.READ_PROOF_BYTES_STATE_MULTI)
+        acc = node.metrics.accumulators.get(metric)
+        counter_bytes = None
+        if acc is not None and acc.samples:
+            counter_bytes = {
+                "p50": int(percentile(acc.samples, 0.5)),
+                "p95": int(percentile(acc.samples, 0.95)),
+            }
+        transfer_ms = page_bytes / LOSSY_BW / (1 - LOSSY_LOSS) * 1000
+        return {
+            "singles_served": served,
+            "single_verify_ms_p50": s.get("verify_ms_p50"),
+            "single_verify_ms_p95": s.get("verify_ms_p95"),
+            "page_bytes": page_bytes,
+            "bytes_per_read": round(page_bytes / page_size, 1),
+            "page_gen_ms_p50": round(
+                percentile(gen_s, 0.5) * 1000, 2),
+            "page_verify_ms_p50": round(
+                percentile(ver_s, 0.5) * 1000, 2),
+            "page_verify_ms_p95": round(
+                percentile(ver_s, 0.95) * 1000, 2),
+            "proof_bytes_counter": counter_bytes,
+            "lossy_wan_page_transfer_ms": round(transfer_ms, 2),
+        }
+
+    try:
+        one_arm("mpt")                           # warm-up, discarded
+        runs = {"mpt": [], "verkle": []}
+        for _ in range(3):                       # interleaved
+            for backend in ("mpt", "verkle"):
+                arm = one_arm(backend)
+                if "error" in arm:
+                    return arm
+                runs[backend].append(arm)
+        out: dict = {"page_size": page_size, "n_dids": n_dids}
+        for backend in ("mpt", "verkle"):
+            arms = sorted(runs[backend],
+                          key=lambda a: a["page_verify_ms_p50"])
+            out[backend] = arms[1]               # median by verify time
+        out["bytes_reduction"] = round(
+            out["mpt"]["page_bytes"] / out["verkle"]["page_bytes"], 2)
+        # TS-Verkle-derived client budget (docs/state_commitment.md):
+        # per-page = 2 pairings + one MSM over <= page*depth openings
+        out["verify_budget_ms_p95"] = 60.0
+        out["verify_within_budget"] = (
+            out["verkle"]["page_verify_ms_p95"]
+            <= out["verify_budget_ms_p95"])
+        return out
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     for name, fn in (("config1b", config1b_distinct_signers),
                      ("config2", config2_three_instances_mixed),
@@ -1253,7 +1413,8 @@ def main():
                      ("config8", config8_pipeline_ab),
                      ("config10", config10_shards),
                      ("config11", config11_telemetry),
-                     ("config12", config12_reshard)):
+                     ("config12", config12_reshard),
+                     ("config13", config13_commitment)):
         print(name, json.dumps(fn()), flush=True)
 
 

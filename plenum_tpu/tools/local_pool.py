@@ -138,7 +138,10 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1,
         components = NodeBootstrap(
             name, genesis_txns=genesis, crypto_backend=backend,
             verifier=None if pipeline is not None else plane,
-            pipeline=pipeline).build()
+            pipeline=pipeline,
+            state_commitment=config.STATE_COMMITMENT,
+            state_commitment_per_ledger=config.STATE_COMMITMENT_PER_LEDGER,
+            verkle_width=config.VERKLE_WIDTH).build()
         # traced runs carry real Tracers (shared in-process clock, so
         # assembly alignment is the identity); untraced runs keep the
         # NullTracer fast path and stay the honest TPS figures

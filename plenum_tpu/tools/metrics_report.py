@@ -377,6 +377,7 @@ def derive_summary(folds: dict[str, dict], span_s: float,
             else None,
             "proofs_state": int(cum("read_plane.proofs_state") or 0),
             "proofs_merkle": int(cum("read_plane.proofs_merkle") or 0),
+            "proofs_verkle": int(cum("read_plane.proofs_verkle") or 0),
             "proofless": int(cum("read_plane.proofless") or 0),
             "anchor_updates": int(
                 cum("read_plane.anchor_updates") or 0),
@@ -392,6 +393,18 @@ def derive_summary(folds: dict[str, dict], span_s: float,
                 percentile(gen["samples"], 0.95))
         elif gen.get("mean") is not None:
             section["proof_gen_ms_mean"] = _ms(gen["mean"])
+        # per-kind envelope bytes: what a verified read costs the client
+        # to download — the bytes-per-read A/B (config13) reads THESE
+        for kind in ("state", "state_multi", "merkle", "verkle",
+                     "verkle_multi"):
+            pb = folds.get(f"read_plane.proof_bytes_{kind}", {})
+            if pb.get("samples"):
+                section[f"proof_bytes_{kind}_p50"] = int(
+                    percentile(pb["samples"], 0.5))
+                section[f"proof_bytes_{kind}_p95"] = int(
+                    percentile(pb["samples"], 0.95))
+            elif pb.get("mean") is not None:
+                section[f"proof_bytes_{kind}_mean"] = int(pb["mean"])
         out["read_plane"] = {k: v for k, v in section.items()
                              if v is not None}
     # ingress plane (docs/ingress.md): admission vs shed volume, the

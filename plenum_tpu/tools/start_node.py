@@ -103,7 +103,13 @@ def build_node(name: str, base_dir: str, backend: str = "cpu",
     components = NodeBootstrap(
         name, genesis_txns=genesis, data_dir=data_dir,
         crypto_backend=backend, storage_backend=storage_backend,
-        bls_seed=bytes.fromhex(keys["bls_seed"])).build()
+        bls_seed=bytes.fromhex(keys["bls_seed"]),
+        # commitment scheme rides the ONE config (PLENUM_CONFIG_JSON
+        # {"STATE_COMMITMENT": "verkle"}) — the whole pool must agree,
+        # and an observer follows with start_observer --state-commitment
+        state_commitment=config.STATE_COMMITMENT,
+        state_commitment_per_ledger=config.STATE_COMMITMENT_PER_LEDGER,
+        verkle_width=config.VERKLE_WIDTH).build()
     timer = QueueTimer(time.perf_counter)
     # durable metrics history next to the node's keys so operators can run
     # tools.metrics_report after (or during) a run — the reference flushes

@@ -38,6 +38,19 @@ def main(argv=None):
                          "validator) once the newest verified anchor is "
                          "older than this; default: "
                          "Config.OBSERVER_ANCHOR_LAG_MAX")
+    ap.add_argument("--state-commitment", default="mpt",
+                    choices=["mpt", "verkle"],
+                    help="MUST match the pool's STATE_COMMITMENT: the "
+                         "observer's replicated roots have to land on "
+                         "the multi-signed anchors, or every read it "
+                         "serves degrades to proofless escalation")
+    ap.add_argument("--verkle-width", type=int, default=None,
+                    help="pool's VERKLE_WIDTH (verkle pools only)")
+    ap.add_argument("--state-commitment-per-ledger", default=None,
+                    help='JSON {"<ledger_id>": "<backend>"} — must match '
+                         "the pool's STATE_COMMITMENT_PER_LEDGER; a "
+                         "diverging ledger's replicated roots never land "
+                         "on the signed anchors (proofless reads)")
     args = ap.parse_args(argv)
 
     genesis = load_genesis_files(args.base_dir)
@@ -52,7 +65,12 @@ def main(argv=None):
                        client_port=args.client_port,
                        anchor_lag_max=FROM_CONFIG
                        if args.anchor_lag_max is None
-                       else args.anchor_lag_max)
+                       else args.anchor_lag_max,
+                       state_commitment=args.state_commitment,
+                       state_commitment_per_ledger=json.loads(
+                           args.state_commitment_per_ledger)
+                       if args.state_commitment_per_ledger else None,
+                       verkle_width=args.verkle_width)
 
     async def run():
         stop = asyncio.Event()

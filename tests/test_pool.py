@@ -92,7 +92,11 @@ class Pool:
             crypto_backend=self.config.crypto_backend,
             storage_backend=self.config.kv_backend,
             verifier=self.verifier,
-            pipeline=self.pipeline).build()
+            pipeline=self.pipeline,
+            state_commitment=self.config.STATE_COMMITMENT,
+            state_commitment_per_ledger=(
+                self.config.STATE_COMMITMENT_PER_LEDGER),
+            verkle_width=self.config.VERKLE_WIDTH).build()
         from plenum_tpu.common.tracing import Tracer
         tracer = Tracer(name, self.timer.get_current_time,
                         clock_domain="shared") if self.tracing else None

@@ -1,0 +1,55 @@
+"""Golden-vector pin for the state-commitment proof formats.
+
+tools/proof_vectors.py writes canonical (keys -> root -> proof ->
+verify) fixtures for BOTH backends into tests/vectors/; this tier-1
+test regenerates them in-process and verifies the checked-in bytes with
+the current verifiers. A verifier-side encoding drift (transcript
+order, domain separator, leaf-scalar preimage, RLP/msgpack layout)
+breaks HERE instead of silently invalidating every proof a deployed
+client already holds — the exact discipline the wire-format tests apply
+to messages.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from plenum_tpu.tools import proof_vectors as pv
+
+
+@pytest.fixture(scope="module")
+def checked_in():
+    with open(pv.VECTORS_PATH) as fh:
+        return json.load(fh)
+
+
+def test_vectors_match_and_verify(checked_in):
+    problems = pv.check_vectors(checked_in)
+    assert not problems, "\n".join(problems)
+
+
+def test_vectors_cover_both_backends(checked_in):
+    assert set(checked_in["backends"]) == {"mpt", "verkle"}
+    for backend, vec in checked_in["backends"].items():
+        for field in ("root", "single_proof", "absence_proof",
+                      "page_proof"):
+            assert vec.get(field), f"{backend}.{field} empty"
+
+
+def test_tampered_vector_fails_closed(checked_in):
+    """A flipped byte anywhere in a checked-in proof must verify False —
+    the vectors double as a canonical tamper fixture for client code."""
+    from plenum_tpu.state.commitment import PruningState, VerkleState
+    for backend, cls in (("mpt", PruningState), ("verkle", VerkleState)):
+        vec = checked_in["backends"][backend]
+        root = bytes.fromhex(vec["root"])
+        proof = bytearray(bytes.fromhex(vec["single_proof"]))
+        proof[len(proof) // 2] ^= 0x01
+        assert not cls.verify_state_proof(
+            root, pv.FIXTURE_KEYS[0], pv.FIXTURE_VALUES[0], bytes(proof))
+        # and against a different root the honest proof fails too
+        bad_root = bytes(32)
+        assert not cls.verify_state_proof(
+            bad_root, pv.FIXTURE_KEYS[0], pv.FIXTURE_VALUES[0],
+            bytes.fromhex(vec["single_proof"]))

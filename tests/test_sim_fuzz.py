@@ -592,6 +592,145 @@ def run_lying_reader_scenario(seed: int) -> None:
     assert_safety(pool)
 
 
+def run_lying_reader_verkle_scenario(seed: int) -> None:
+    """The lying_reader family on a VERKLE-backed pool (STATE_COMMITMENT
+    config seam): a Byzantine node forges wide-commitment read replies
+    and every rung must fail CLOSED and fail over to an honest node —
+
+    * ``forge_opening``: the aggregated opening proof (pi) is tampered;
+    * ``wrong_root``: the envelope cites a commitment root the pool
+      never signed;
+    * ``splice_multi``: one key's value is swapped INSIDE an aggregated
+      multi-key answer (the 2-key TAA chain), with the result data and
+      result_digest rebound by a smart liar — only the single pairing
+      check can catch it;
+    * ``strip``: the proof is removed entirely -> the ladder escalates
+      to the f+1 broadcast, which must still agree on honest content.
+    """
+    import copy
+
+    from plenum_tpu.common.node_messages import (CONFIG_LEDGER_ID, Reply)
+    from plenum_tpu.common.serialization import pack as _pack
+    from plenum_tpu.execution.txn import (GET_NYM,
+                                          GET_TXN_AUTHOR_AGREEMENT,
+                                          TXN_AUTHOR_AGREEMENT)
+    from plenum_tpu.reads import READ_PROOF, result_digest
+    from test_reads import FOREVER, LyingPlane, make_driver
+
+    rng = SimRandom(seed * 7177 + 29)
+    pool = _track(Pool(seed=seed,
+                       config=Config(**FAST, STATE_COMMITMENT="verkle")))
+    user = Ed25519Signer(seed=(b"vliar%d" % seed).ljust(32, b"\0")[:32])
+    assert _order_and_time(pool, signed_nym(pool.trustee, user, 1), 2) \
+        is not None, f"seed {seed}: verkle pool failed to order"
+    # a TAA gives GET_TXN_AUTHOR_AGREEMENT its 2-key deref chain — the
+    # aggregated MULTI-key envelope the splice rung attacks
+    taa = Request(pool.trustee.identifier, 2,
+                  {"type": TXN_AUTHOR_AGREEMENT, "version": "1",
+                   "text": "terms %d" % seed})
+    taa.signature = pool.trustee.sign_b58(taa.signing_bytes())
+    pool.submit(taa)
+    config_ledger = pool.nodes[pool.names[0]].c.db.get_ledger(
+        CONFIG_LEDGER_ID)
+    waited = 0.0
+    while config_ledger.size < 1 and waited < 20.0:
+        pool.run(0.5)
+        waited += 0.5
+    assert config_ledger.size >= 1, f"seed {seed}: TAA never ordered"
+    pool.run(1.0)                    # let the config anchor land
+
+    def forge_opening(result):
+        env = result.get(READ_PROOF)
+        if env and env.get("kind") == "verkle":
+            pi = bytearray(bytes.fromhex(env["proof"]["pi"]))
+            pi[0] ^= 0xFF
+            pi[-1] ^= 0xFF
+            env["proof"]["pi"] = bytes(pi).hex()
+        return result
+
+    def wrong_root(result):
+        env = result.get(READ_PROOF)
+        if env and env.get("kind") == "verkle":
+            env["root_hash"] = "ab" * 32
+            env["result_digest"] = result_digest(result).hex()
+        return result
+
+    def splice_multi(result):
+        env = result.get(READ_PROOF)
+        if env and env.get("kind") == "verkle" \
+                and len(env.get("entries", ())) >= 2:
+            # swap the terminal key's value inside the aggregated proof;
+            # rebind data + digest so key chain, consistency, and digest
+            # ALL pass — only the pairing check stands
+            forged = dict(result.get("data") or {}, text="EVIL TERMS")
+            env["entries"][-1]["value"] = _pack(forged).hex()
+            result["data"] = forged
+            env["result_digest"] = result_digest(result).hex()
+        return result
+
+    def strip(result):
+        result.pop(READ_PROOF, None)
+        return result
+
+    kind, mutate, query = [
+        ("forge_opening", forge_opening,
+         {"type": GET_NYM, "dest": user.identifier}),
+        ("wrong_root", wrong_root,
+         {"type": GET_NYM, "dest": user.identifier}),
+        ("splice_multi", splice_multi,
+         {"type": GET_TXN_AUTHOR_AGREEMENT}),
+        ("strip", strip,
+         {"type": GET_NYM, "dest": user.identifier}),
+    ][rng.integer(0, 3)]
+    liar = pool.names[rng.integer(0, len(pool.names) - 1)]
+    node = pool.nodes[liar]
+    node.read_plane = LyingPlane(node.read_plane, mutate)
+
+    driver = make_driver(pool, client="vfuzz", freshness_s=FOREVER)
+    q = Request("vfuzz", 50, dict(query))
+    order = [liar] + [n for n in pool.names if n != liar]
+    t0 = pool.timer.get_current_time()
+    res = driver.read(q, per_node_s=2.0, order=order)
+    took = pool.timer.get_current_time() - t0
+    deadline = 2.0 * len(pool.names) + 1.0
+    assert took <= deadline, \
+        f"seed {seed}: {kind} read took {took:.1f}s > {deadline:.1f}s"
+    s = driver.stats
+    if kind == "strip":
+        # no proof at all -> escalate to the legacy f+1 broadcast; the
+        # content vote key keeps the liar's divergent data sub-quorum
+        assert res is None and s.fallbacks == 1, f"seed {seed}"
+        from plenum_tpu.client.client import PoolClient
+        pool.submit(q, client="vfuzz-bc")
+        pool.run(2.0)
+        votes: dict = {}
+        for name in pool.names:
+            for m, c in pool.client_msgs[name]:
+                if c == "vfuzz-bc" and isinstance(m, Reply):
+                    key = PoolClient._vote_key(
+                        {"op": "REPLY", "result": copy.deepcopy(m.result)})
+                    votes[key] = votes.get(key, 0) + 1
+        agreed = [k for k, v in votes.items()
+                  if v >= pool.nodes[liar].f + 1]
+        assert len(agreed) == 1, f"seed {seed}: votes {votes}"
+    else:
+        assert res is not None, f"seed {seed}: {kind} never failed over"
+        env = res.get(READ_PROOF) or {}
+        assert env.get("kind") == "verkle", \
+            f"seed {seed}: honest reply not verkle ({env.get('kind')})"
+        if kind == "splice_multi":
+            assert len(env.get("entries", ())) >= 2, \
+                f"seed {seed}: splice rung got a single-key envelope"
+            assert res["data"]["text"] == "terms %d" % seed, f"seed {seed}"
+        else:
+            assert res["data"]["verkey"] == user.verkey_b58, f"seed {seed}"
+        assert s.verify_failures >= 1 and s.failovers >= 1, \
+            f"seed {seed}: {kind} accepted a forged verkle reply " \
+            f"({s.summary()})"
+        assert s.single_reply_ok == 1 and s.fallbacks == 0, f"seed {seed}"
+    assert_safety(pool)
+
+
 # --- scenario kind `client_flood`: the FRONT DOOR is under attack -----------
 # Seed-driven bursts of hot clients (including bad-signature floods) hit
 # per-node ingress planes while honest steady clients keep writing. The
@@ -799,6 +938,21 @@ def test_sim_lying_reader_fuzz(bucket):
 def test_sim_lying_reader_smoke():
     """One lying_reader scenario always runs in the default suite."""
     _run_with_artifacts(run_lying_reader_scenario, 2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_lying_reader_verkle_fuzz(bucket):
+    for seed in range(bucket * 5, (bucket + 1) * 5):
+        _run_with_artifacts(run_lying_reader_verkle_scenario, seed)
+
+
+def test_sim_lying_reader_verkle_smoke():
+    """Two verkle rungs always run in the default suite: seed 4 draws
+    the spliced-multi-key rung (the aggregated-proof-specific forgery),
+    seed 9 the stripped-proof escalation."""
+    _run_with_artifacts(run_lying_reader_verkle_scenario, 4)
+    _run_with_artifacts(run_lying_reader_verkle_scenario, 9)
 
 
 def test_sim_lying_reader_stale_replay():

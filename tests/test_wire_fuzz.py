@@ -266,6 +266,72 @@ def _corrupt_tree(rng: random.Random, obj):
     return obj
 
 
+def _proof_bearing_result_verkle():
+    """The Verkle twin of _proof_bearing_result: a pool whose domain
+    state rides the wide-commitment backend, so the GET_NYM reply
+    carries a ``verkle`` envelope (aggregated opening, no per-entry
+    proof field)."""
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.config import Config
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.tools.local_pool import pool_bls_keys
+
+    pool = Pool(seed=33, config=Config(Max3PCBatchWait=0.05,
+                                       STATE_COMMITMENT="verkle"))
+    user = Ed25519Signer(seed=b"wirefuzz-vk-user".ljust(32, b"\0")[:32])
+    pool.submit(signed_nym(pool.trustee, user, 1))
+    pool.run(6.0)
+    q = Request("wf", 1, {"type": GET_NYM, "dest": user.identifier})
+    result = pool.nodes["Alpha"].read_plane.answer(q)
+    return pool, q, result, pool_bls_keys(pool.names)
+
+
+def test_verkle_envelope_roundtrips_and_fails_closed():
+    """Same contract reads/proofs.py pins for MPT, for the new kind: the
+    verkle envelope survives the wire roundtrip verbatim and STILL
+    verifies; ~300 random corruptions of the envelope (or the result it
+    binds) each verify False — never raise, never True unless the
+    corruption was an exact no-op."""
+    from plenum_tpu.common.node_messages import Reply
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.reads import READ_PROOF, verify_read_proof
+
+    pool, q, result, keys = _proof_bearing_result_verkle()
+    now = pool.timer.get_current_time
+    assert result[READ_PROOF]["kind"] == "verkle", \
+        "verkle-backed pool served a non-verkle envelope"
+
+    wire = unpack(pack(Reply(result=result).to_dict()))
+    rt_result = wire["result"]
+    ok, reason = verify_read_proof(GET_NYM, q.operation, rt_result, keys,
+                                   freshness_s=1e12, now=now)
+    assert ok, f"roundtrip broke verkle verification: {reason}"
+
+    rng = random.Random(31337)
+    verified = rejected = 0
+    for _ in range(N_CASES):
+        bad = _corrupt_tree(rng, rt_result)
+        try:
+            ok, reason = verify_read_proof(GET_NYM, q.operation, bad,
+                                           keys, freshness_s=1e12,
+                                           now=now)
+        except Exception as e:           # pragma: no cover
+            raise AssertionError(
+                f"verify_read_proof raised {type(e).__name__} on "
+                f"corrupted verkle envelope") from e
+        if ok:
+            assert bad.get(READ_PROOF) == rt_result.get(READ_PROOF) \
+                and {k: v for k, v in bad.items()
+                     if k not in ("identifier", "reqId")} \
+                == {k: v for k, v in rt_result.items()
+                    if k not in ("identifier", "reqId")}, \
+                f"corrupted verkle envelope VERIFIED: {bad}"
+            verified += 1
+        else:
+            rejected += 1
+    assert rejected > N_CASES // 2       # most corruptions must reject
+
+
 def test_read_proof_envelope_roundtrips_and_fails_closed():
     """The proof-bearing REPLY survives the wire roundtrip verbatim and
     STILL verifies; any corruption of the envelope (or of the result it
