@@ -451,6 +451,24 @@ def main():
             }
     except Exception as e:               # the headline line must survive
         result["configs_error"] = f"{type(e).__name__}: {e}"
+    # multi-device pipeline A/B on 8 forced CPU host devices — the
+    # scale-out headline's measured stand-in, published with jax_source
+    # provenance and per-device dispatch counts (its own try block so an
+    # earlier config raising must not blank it)
+    try:
+        from plenum_tpu.tools import bench_configs as bc
+        c14 = bc.config14_multichip()
+        if "error" in c14:
+            result["config14_multichip"] = c14["error"]
+        else:
+            result["config14_multichip"] = {
+                k: c14[k] for k in
+                ("jax_source", "n_devices", "one_device_items_per_s",
+                 "multi_device_items_per_s", "scaling",
+                 "per_device_dispatches", "one_device_dispatches",
+                 "unpinned_shapes") if c14.get(k) is not None}
+    except Exception as e:
+        result["config14_multichip"] = f"{type(e).__name__}: {e}"
     # fused-pipeline A/B on JAX-ON-CPU — published UNCONDITIONALLY: its
     # own try block (an earlier config raising must not blank it) AND
     # independent of relay state — same code path the TPU runs,

@@ -217,6 +217,12 @@ class MetricsName:
     PIPELINE_CTL_FLUSH_WAIT = "pipeline_ctl.flush_wait"
     PIPELINE_CTL_BUCKET_FLOOR = "pipeline_ctl.bucket_floor"
     PIPELINE_CTL_DECISIONS = "pipeline_ctl.decisions"
+    # multi-device ring: per-chip lane gauges (the device_* satellite of
+    # the scale-out pipeline — which chip is sick, how even the spread)
+    PIPELINE_DEVICE_LANES = "pipeline_dev.lanes"
+    PIPELINE_DEVICE_BREAKERS_OPEN = "pipeline_dev.breakers_open"
+    PIPELINE_DEVICE_OCCUPANCY_MAX = "pipeline_dev.occupancy_max"
+    PIPELINE_DEVICE_DISPATCH_SPREAD = "pipeline_dev.dispatch_spread"
     # transport
     NODE_MSGS_IN = "transport.node_msgs_in"
     NODE_FRAMES_OUT = "transport.node_frames_out"

@@ -346,6 +346,19 @@ class Config:
     # unique SHA messages below this per flush stay on hashlib (one
     # tunneled-TPU dispatch costs more than ~1k host hashes)
     PIPELINE_SHA_MIN_BATCH: int = 1024
+    # multi-device scale-out: shard the submission ring across this many
+    # chips, one independently breakable lane per device (per-lane wave
+    # queue + pinned-bucket set + breaker). 1 = the single-ring PR 8
+    # pipeline exactly (no lane indirection — pinned by microbenchmark);
+    # 0 = every local device. Lanes wrap when the host has fewer chips.
+    PIPELINE_DEVICES: int = 1
+    # per-lane dispatch threads: same-thread async dispatch SERIALIZES
+    # executions across devices on the CPU backend (measured: 4 async
+    # waves = 4x one wave; 4 threaded waves = 1x), so device-backed
+    # lanes dispatch from a worker thread each. None = auto (threads
+    # only for lanes pinned to a real device); False forces inline
+    # dispatch (deterministic sims/fuzz).
+    PIPELINE_LANE_THREADS: Optional[bool] = None
 
     # --- state commitment seam (state/commitment/) ---
     # scheme every ledger's state uses: 'mpt' (default; wire format

@@ -282,13 +282,20 @@ class JaxEd25519Verifier(Ed25519Verifier):
     # is ported (it overrides _device_verify on the staged arrays).
     _compressed_dispatch = True
 
-    def __init__(self, min_batch: int = 1, cache_size: int = 65536):
+    def __init__(self, min_batch: int = 1, cache_size: int = 65536,
+                 device=None):
         # verkeys are attacker-supplied; the cache must be bounded (FIFO
         # evict). value: int32[4, 4, NLIMB] quarter-point rows, or None
         # for invalid keys
         self._pt_cache: dict[bytes, Optional[np.ndarray]] = {}
         self._cache_size = cache_size
         self._min_batch = min_batch
+        # multi-device lane pinning (ops.ed25519.stage_on): every dispatch
+        # commits its staged arrays to THIS chip, so N verifiers over N
+        # devices run N concurrent kernel executions — the per-lane
+        # sharding seam the multi-device pipeline builds on. None = the
+        # backend default device (single-chip behavior, unchanged).
+        self.device = device
 
     def _neg_a_limbs(self, vk: bytes) -> Optional[np.ndarray]:
         if vk in self._pt_cache:
@@ -394,10 +401,8 @@ class JaxEd25519Verifier(Ed25519Verifier):
         return m_pad, (small if n_keys <= small else m_pad)
 
     def _device_verify_bytes(self, s_u8, h_u8, k_u8, idx, r_u8):
-        import jax.numpy as jnp
         return _ops.verify_kernel_bytes(
-            jnp.asarray(s_u8), jnp.asarray(h_u8), jnp.asarray(k_u8),
-            jnp.asarray(idx), jnp.asarray(r_u8))
+            *_ops.stage_on(self.device, s_u8, h_u8, k_u8, idx, r_u8))
 
     def _dispatch_limbs(self, items: Sequence[VerifyItem]):
         n = len(items)
@@ -463,11 +468,9 @@ class JaxEd25519Verifier(Ed25519Verifier):
         """Staged host arrays -> flat verdict array on device. Subclasses
         re-route the dispatch (ShardedJaxEd25519Verifier shards it over a
         mesh); the host staging above is identical either way."""
-        import jax.numpy as jnp
         return _ops.verify_kernel_indexed(
-            jnp.asarray(s_digits), jnp.asarray(h_digits),
-            jnp.asarray(aq_unique), jnp.asarray(idx),
-            jnp.asarray(ry), jnp.asarray(r_sign))
+            *_ops.stage_on(self.device, s_digits, h_digits, aq_unique,
+                           idx, ry, r_sign))
 
     def rewarm(self) -> None:
         """Plane-supervisor re-warm hook: drop the staged key material so

@@ -78,6 +78,7 @@ class NodeBootstrap:
                  plugins=None,
                  verifier=None,
                  pipeline=None,
+                 pipeline_lane: Optional[int] = None,
                  state_commitment: str = "mpt",
                  state_commitment_per_ledger: Optional[dict] = None,
                  verkle_width: Optional[int] = None):
@@ -104,6 +105,10 @@ class NodeBootstrap:
         # checks all stage into its shared ring (co-hosted nodes pass ONE
         # instance — that sharing IS the cross-node coalescing/dedup)
         self.pipeline = pipeline
+        # multi-device ring placement pin: this node's submissions stage
+        # into the named chip lane (sharded fabrics pin co-hosted
+        # sub-pool shards to DISTINCT chips; None = ring-chosen lane)
+        self.pipeline_lane = pipeline_lane
         # per-ledger state commitment scheme (state/commitment/): 'mpt'
         # default, 'verkle' for aggregated multi-key openings; the whole
         # pool must agree (the backend defines the signed root anchors)
@@ -234,7 +239,8 @@ class NodeBootstrap:
         if self.verifier is not None:
             authn_verifier = self.verifier
         elif self.pipeline is not None:
-            authn_verifier = self.pipeline.verifier()
+            authn_verifier = self.pipeline.verifier(
+                lane=self.pipeline_lane)
         else:
             authn_verifier = make_verifier(
                 self.crypto_backend, min_batch=self.verifier_min_batch)

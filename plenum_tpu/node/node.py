@@ -704,13 +704,23 @@ class Node:
             return {}
         st = pipe.stats
         dispatches = st.get("dispatches", 0)
-        return {
+        out = {
             "occupancy": pipe.occupancy(),
             "dispatches": dispatches,
             "bucket_hit_rate": round(
                 st.get("bucket_hits", 0) / dispatches, 3)
             if dispatches else None,
         }
+        # multi-device ring: per-chip lane gauges so the fleet console
+        # can show WHICH chip is sick (breaker per lane), plus the open
+        # count the aggregator's health fold reads
+        devices = pipe.device_state()
+        if devices:
+            out["devices"] = devices
+            out["breakers_open"] = sum(
+                1 for d in devices
+                if d.get("breaker") not in ("closed", "none"))
+        return out
 
     def attach_fleet_aggregator(self, aggregator) -> None:
         """Route inbound TELEMETRY snapshots (and this node's own) into

@@ -114,6 +114,9 @@ HEALTH_PENALTIES = (
     ("vc_in_progress", 0.2),        # ordering paused for the view change
     ("shedding", 0.2),              # front door refusing new work
     ("anchor_stale", 0.3),          # serving reads at a stale root
+    ("lane_breaker_open", 0.2),     # one chip of the multi-device ring
+    #                                 degraded (other lanes still serve,
+    #                                 so lighter than the plane breaker)
 )
 
 
@@ -236,6 +239,7 @@ class FleetAggregator:
         node_state = state.get("node", {})
         crypto = state.get("crypto", {})
         ingress = state.get("ingress", {})
+        pipeline = state.get("pipeline", {})
         breaker = crypto.get("breaker_state")
         return {
             "read_only_degraded": node_state.get("read_only_degraded"),
@@ -244,6 +248,12 @@ class FleetAggregator:
             "breaker_open": breaker == "open",
             "breaker_half_open": breaker == "half_open",
             "shedding": ingress.get("shedding"),
+            # multi-device ring: ANY chip lane degraded dings health
+            # lightly (distinct from breaker_open so one sick chip in an
+            # 8-lane ring reads as -0.2, not -0.5; the node-level crypto
+            # breaker — lane 0's, the find_supervisor view — still
+            # carries the full plane-down penalty when it opens)
+            "lane_breaker_open": bool(pipeline.get("breakers_open")),
         }
 
     def anchor_age(self, node: str) -> Optional[float]:

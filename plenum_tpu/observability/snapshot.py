@@ -99,6 +99,10 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
         MetricsName.PIPELINE_CTL_FLUSH_WAIT,
         MetricsName.PIPELINE_CTL_BUCKET_FLOOR,
         MetricsName.PIPELINE_CTL_DECISIONS,
+        MetricsName.PIPELINE_DEVICE_LANES,
+        MetricsName.PIPELINE_DEVICE_BREAKERS_OPEN,
+        MetricsName.PIPELINE_DEVICE_OCCUPANCY_MAX,
+        MetricsName.PIPELINE_DEVICE_DISPATCH_SPREAD,
     }),
     "reads": frozenset({
         MetricsName.READ_QUERIES, MetricsName.READ_PROOF_GEN_TIME,

@@ -7,16 +7,79 @@ bucket, the sharded crypto plane), and on a tunneled TPU a single XLA
 compile costs minutes. With the cache, only the first process ever pays it;
 every later node/bench/test process deserializes the compiled executable in
 seconds. Cache location override: PLENUM_TPU_JAX_CACHE (useful for CI).
+
+The cache directory is scoped by a HOST FINGERPRINT (platform + CPU
+feature flags): XLA:CPU cache entries are ahead-of-time compiled for the
+build machine's exact feature set, and loading one on a different host
+is at best a `cpu_aot_loader` machine-feature-mismatch warning and at
+worst a SIGILL mid-verify (the MULTICHIP_r02..r05 failure — a cache
+written on the fleet's AVX-512-richer build host crept into this
+container). Scoping the path means a foreign host's entries are simply
+never SEEN: the first run on a new machine pays a fresh JIT compile
+instead of trusting an incompatible AOT blob. `aot_preflight()` is the
+explicit check harnesses run to report which case they're in.
 """
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 
 import jax
 
-_cache_dir = os.environ.get(
+
+def host_fingerprint() -> str:
+    """Stable per-machine fingerprint of the ISA surface XLA:CPU compiles
+    against: platform tag + the sorted CPU feature flags. Two hosts with
+    the same flags can safely share AOT cache entries; any flag drift
+    (the SIGILL risk) changes the fingerprint and isolates the caches."""
+    h = hashlib.sha256()
+    h.update(platform.machine().encode())
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    h.update(" ".join(sorted(line.split(":", 1)[1].split()))
+                             .encode())
+                    break
+    except OSError:
+        h.update(platform.processor().encode())
+    return h.hexdigest()[:12]
+
+
+_cache_root = os.environ.get(
     "PLENUM_TPU_JAX_CACHE",
     os.path.join(os.path.expanduser("~"), ".cache", "plenum_tpu", "jax"))
+_cache_dir = os.path.join(_cache_root, f"host-{host_fingerprint()}")
+
+
+def aot_preflight() -> dict:
+    """Report the persistent-cache compatibility story for this host:
+    whether a foreign host's AOT entries exist alongside (the stale
+    state that used to crash the MULTICHIP harness) and whether THIS
+    host's scoped cache is already warm. Never raises; harnesses fold
+    the dict into their provenance row."""
+    out = {"fingerprint": host_fingerprint(), "cache_dir": _cache_dir,
+           "warm_entries": 0, "foreign_hosts": 0, "legacy_entries": 0}
+    try:
+        if os.path.isdir(_cache_dir):
+            out["warm_entries"] = sum(
+                1 for f in os.listdir(_cache_dir) if f.endswith("-cache"))
+        if os.path.isdir(_cache_root):
+            for entry in os.listdir(_cache_root):
+                path = os.path.join(_cache_root, entry)
+                if entry.startswith("host-"):
+                    if path != _cache_dir:
+                        out["foreign_hosts"] += 1
+                elif entry.endswith("-cache"):
+                    # pre-scoping flat entries: provenance unknown, so
+                    # they are never loaded (the scoped dir shadows them)
+                    out["legacy_entries"] += 1
+    except OSError:
+        pass
+    return out
+
+
 try:  # pragma: no cover - depends on jax version/platform
     os.makedirs(_cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cache_dir)

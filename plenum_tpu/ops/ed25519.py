@@ -457,6 +457,20 @@ def _build_a_tables(qx, qy, qz, qt):
     return tx, ty, tz, t2d
 
 
+def stage_on(device, *arrays):
+    """Commit staged host arrays to ONE chip of a multi-device pipeline.
+
+    jax.jit executes where its (committed) inputs live, so pinning the
+    staged payload is the whole per-lane sharding entry point: lane k's
+    verifier stages onto devices[k] and the SAME compiled kernel runs
+    there, one executable per device. `device=None` keeps today's
+    uncommitted behavior (backend default device)."""
+    import jax.numpy as jnp
+    if device is None:
+        return tuple(jnp.asarray(a) for a in arrays)
+    return tuple(jax.device_put(a, device) for a in arrays)
+
+
 @jax.jit
 def verify_kernel_indexed(s_digits, h_digits, aq_unique, idx, ry, r_sign):
     """verify_kernel with the verkey-derived quarter-point rows DEDUPED:

@@ -216,16 +216,22 @@ def _pow2_at_least(n: int) -> int:
     return p
 
 
-def sha256_batch(msgs: Sequence[bytes], prefix: bytes = b"") -> list[bytes]:
+def sha256_batch(msgs: Sequence[bytes], prefix: bytes = b"",
+                 device=None) -> list[bytes]:
     """Hash a batch of byte strings on device.
 
     Messages are bucketed by their standard block count (padding is part of the
     hash, so block count can't be fudged); within a bucket the batch axis is
     padded to a power of two so XLA compiles O(log N) programs per bucket size,
     not one per batch size.
+
+    `device` commits the staged words to one chip (the multi-device
+    pipeline's per-lane sharding entry point — jit executes where its
+    committed inputs live); None keeps the backend default.
     """
     if not msgs:
         return []
+    from plenum_tpu.ops.ed25519 import stage_on
     buckets: dict[int, list[int]] = {}
     for i, m in enumerate(msgs):
         buckets.setdefault(n_blocks_for(len(prefix) + len(m)), []).append(i)
@@ -235,7 +241,7 @@ def sha256_batch(msgs: Sequence[bytes], prefix: bytes = b"") -> list[bytes]:
         words = np.zeros((n_pad, nb * 16), dtype=np.uint32)
         for j, i in enumerate(idxs):
             words[j] = pad_to_words(prefix + msgs[i])
-        dig = digests_to_bytes(sha256_words(jnp.asarray(words)))
+        dig = digests_to_bytes(sha256_words(*stage_on(device, words)))
         for j, i in enumerate(idxs):
             out[i] = dig[j]
     return out

@@ -40,10 +40,19 @@ class FaultPlan:
     """Seed-derived schedule of fault windows: [(start, end, mode), ...]
     evaluated against the injected clock. Windows may not overlap; gaps
     are healthy. Pure function of (seed, horizon, rates) — any failing
-    seed replays exactly."""
+    seed replays exactly.
 
-    def __init__(self, windows: Sequence[tuple[float, float, str]]):
+    `device` optionally TARGETS one lane of a multi-device crypto
+    pipeline: a verifier that identifies itself with a different
+    `device_index` reads the plan as permanently healthy, so wedging
+    chip k mid-consensus faults exactly lane k's breaker while every
+    other lane keeps dispatching (the `device_flap` fuzz kind's
+    per-device rung)."""
+
+    def __init__(self, windows: Sequence[tuple[float, float, str]],
+                 device: Optional[int] = None):
         self.windows = sorted(windows)
+        self.device = device
         for _, _, mode in self.windows:
             if mode not in MODES:
                 raise ValueError(f"unknown fault mode {mode!r}")
@@ -52,8 +61,14 @@ class FaultPlan:
     def from_seed(cls, seed: int, horizon: float = 30.0,
                   n_faults: Optional[int] = None,
                   modes: Sequence[str] = ("wedge", "drop", "corrupt"),
-                  min_len: float = 1.0, max_len: float = 5.0) -> "FaultPlan":
+                  min_len: float = 1.0, max_len: float = 5.0,
+                  device: Optional[int] = None,
+                  n_devices: Optional[int] = None) -> "FaultPlan":
         rng = random.Random(seed * 6364136223846793005 + 1442695040888963407)
+        if device is None and n_devices:
+            # the targeted chip is part of the seed's identity: a failing
+            # per-device seed replays against the same lane
+            device = rng.randrange(n_devices)
         n = n_faults if n_faults is not None else rng.randint(1, 3)
         windows = []
         t = rng.uniform(0.0, horizon / 4)
@@ -63,9 +78,12 @@ class FaultPlan:
                 break
             windows.append((t, t + length, modes[rng.randrange(len(modes))]))
             t = t + length + rng.uniform(min_len, max_len)
-        return cls(windows)
+        return cls(windows, device=device)
 
-    def mode_at(self, now: float) -> str:
+    def mode_at(self, now: float, device: Optional[int] = None) -> str:
+        if (self.device is not None and device is not None
+                and device != self.device):
+            return "ok"          # the fault targets a different chip
         for start, end, mode in self.windows:
             if start <= now < end:
                 return mode
@@ -86,9 +104,13 @@ class FaultyVerifier(Ed25519Verifier):
 
     def __init__(self, inner: Ed25519Verifier,
                  plan: Optional[FaultPlan] = None,
-                 now=None, delay_s: float = 0.5):
+                 now=None, delay_s: float = 0.5,
+                 device_index: Optional[int] = None):
         self._inner = inner
         self._plan = plan
+        # which pipeline lane this verifier backs: a device-targeted
+        # FaultPlan only fires when the indices match (None matches all)
+        self.device_index = device_index
         self._now = now or time.monotonic
         self._forced: Optional[str] = None   # manual override, wins
         self._wedge_epoch = 0                # bumped per wedge: loses tokens
@@ -126,7 +148,8 @@ class FaultyVerifier(Ed25519Verifier):
 
     def mode(self) -> str:
         mode = self._forced if self._forced is not None else (
-            self._plan.mode_at(self._now()) if self._plan else "ok")
+            self._plan.mode_at(self._now(), device=self.device_index)
+            if self._plan else "ok")
         # a plan-driven wedge transition invalidates in-flight work, same
         # as the manual wedge() control does
         if mode == "wedge" and self._last_mode != "wedge":

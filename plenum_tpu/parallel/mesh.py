@@ -34,6 +34,27 @@ def mesh_shape_for(n_devices: int) -> tuple[int, int]:
     return inst, n_devices // inst
 
 
+def lane_roster(n_lanes: Optional[int] = None,
+                devices: Optional[Sequence] = None) -> list:
+    """Device roster for the multi-device pipeline's per-chip lanes.
+
+    Unlike the SPMD mesh (one program spanning every chip), lanes are
+    INDEPENDENT single-device dispatch streams — one breakable backend
+    per chip — so the roster is just this process's local devices in
+    order, optionally truncated. n_lanes > available wraps (several
+    lanes share a chip: still correct, no scaling), so a bench config
+    asking for 8 lanes degrades gracefully on a 4-chip host. Only LOCAL
+    devices qualify: a lane must be able to device_put from this host
+    (multihost jobs run one pipeline per host over local chips; the
+    SPMD plane is the cross-host story)."""
+    devs = list(devices) if devices is not None else jax.local_devices()
+    if not devs:
+        return []
+    if n_lanes is None or n_lanes <= 0:
+        return devs
+    return [devs[i % len(devs)] for i in range(n_lanes)]
+
+
 def make_mesh(n_devices: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()

@@ -237,11 +237,16 @@ class _EdToken:
 
 class _Wave:
     """One Ed25519 device dispatch: the unique padded item batch plus the
-    spans the tracer's `device` stage reports."""
+    spans the tracer's `device` stage reports. The multi-device pipeline
+    additionally stamps the owning lane and, for threaded lanes, carries
+    the worker's result hand-off (result/done set ONLY by the lane
+    worker; the pump reads them — the GIL makes the pair safe without a
+    lock because `done` is written last)."""
 
     __slots__ = ("items", "keys", "bucket", "n_real", "inner_tok",
                  "verdicts", "coalesced", "t_first", "t_packed",
-                 "t_dispatched", "overflowed")
+                 "t_dispatched", "overflowed", "lane", "result", "done",
+                 "event")
 
     def __init__(self):
         self.items: list[VerifyItem] = []
@@ -255,6 +260,10 @@ class _Wave:
         self.t_packed = None
         self.t_dispatched = None
         self.overflowed = False
+        self.lane = None             # lane index (multi-device pipeline)
+        self.result = None           # threaded lane: worker's verdicts
+        self.done = False            # threaded lane: result is readable
+        self.event = None            # threaded lane: set after done
 
 
 class _SyncToken:
@@ -387,14 +396,17 @@ class CryptoPipeline:
             self.controller._floor_max = min(self.controller._floor_max,
                                              max(self._ed_buckets()))
 
-    def _ed_buckets(self) -> list[int]:
-        """Pad buckets with at least one compiled Ed25519 shape."""
-        return sorted({k[1] for k in self._shapes if k[0] == KIND_ED})
+    def _ed_buckets(self, shapes: Optional[set] = None) -> list[int]:
+        """Pad buckets with at least one compiled Ed25519 shape (in the
+        given shape set — a lane's own, or the single ring's)."""
+        shapes = self._shapes if shapes is None else shapes
+        return sorted({k[1] for k in shapes if k[0] == KIND_ED})
 
-    def _key_cap(self) -> int:
+    def _key_cap(self, shapes: Optional[set] = None) -> int:
         """Largest compiled key-table; waves packed past it would force a
         novel (bucket, full-key-table) shape."""
-        tabs = [k[2] for k in self._shapes if k[0] == KIND_ED]
+        shapes = self._shapes if shapes is None else shapes
+        tabs = [k[2] for k in shapes if k[0] == KIND_ED]
         return max(tabs) if tabs else 64
 
     def prewarm(self, buckets: Optional[Sequence[int]] = None) -> list[int]:
@@ -457,7 +469,10 @@ class CryptoPipeline:
 
     # --- Ed25519: the double-buffered wave path ----------------------------
 
-    def submit_verify(self, items: Sequence[VerifyItem]) -> _EdToken:
+    def submit_verify(self, items: Sequence[VerifyItem],
+                      lane: Optional[int] = None) -> _EdToken:
+        # `lane` is the multi-device placement hint; the single-ring
+        # pipeline has one implicit lane and ignores it
         now = self._now()
         tok = _EdToken(list(items), now)
         self.stats["submitted_items"] += len(tok.items)
@@ -465,6 +480,17 @@ class CryptoPipeline:
             self._ed_first_staged = now
         self._ed_staged.append(tok)
         return tok
+
+    def place(self, tag: int) -> Optional[int]:
+        """Placement policy seam: which lane should the sub-pool/shard
+        identified by `tag` pin its submissions to? Single-device ring:
+        no lanes, no pin."""
+        return None
+
+    def device_state(self) -> list[dict]:
+        """Per-device lane gauges for telemetry/console; the single-ring
+        pipeline has no per-device story."""
+        return []
 
     def _device_degraded(self) -> bool:
         """True when the supervised inner is routing to CPU (breaker not
@@ -474,29 +500,22 @@ class CryptoPipeline:
         state = getattr(breaker, "state", None)
         return state is not None and state != "closed"
 
-    def _pack_wave(self) -> Optional[_Wave]:
-        """Drain the ed ring into one wave: dedup against the verdict
-        cache and within the wave, stop at the bucket cap (leftovers stay
-        staged — the wave is marked overflowed so the controller can grow
-        the floor)."""
-        if not self._ed_staged:
-            return None
-        wave = _Wave()
-        wave.t_first = self._ed_first_staged
-        cap = self.config.PIPELINE_MAX_BUCKET
-        key_cap = cap
-        enforce = (self.pinned and self._bucketed
-                   and not self._device_degraded())
-        if enforce and self._ed_buckets():
-            # pinned: never pack past what can dispatch on a compiled
-            # shape — leftovers ride the next wave instead of forcing a
-            # novel mid-run XLA compile
-            cap = max(self._ed_buckets())
-            key_cap = self._key_cap()
+    def _plan_into_wave(self, staged: deque, wave: _Wave, cap: int,
+                        key_cap: int) -> set:
+        """THE packing inner loop, shared by the single ring and every
+        multi-device lane (a divergence here would fork verdict/compile
+        behavior between them): form-screen each item (the SAME checks
+        the device staging applies — crypto/ed25519._dispatch_bytes —
+        settled HERE so the dispatched shape always equals the padded
+        bucket), dedup against the shared verdict cache and within the
+        wave, stop at the bucket cap / compiled key-table cap (leftovers
+        stay staged; the wave is marked overflowed so the controller can
+        grow the floor). Mutates `staged` and `wave`; returns the wave's
+        distinct-verkey set (the bucket selector needs its size)."""
         in_wave: dict[bytes, int] = {}
         wave_vks: set[bytes] = set()
-        while self._ed_staged:
-            tok = self._ed_staged[0]
+        while staged:
+            tok = staged[0]
             i = tok.planned
             while i < len(tok.items):
                 if len(wave.items) >= cap:
@@ -511,12 +530,9 @@ class CryptoPipeline:
                     continue
                 if (len(s) != 64 or len(v) != 32
                         or int.from_bytes(s[32:], "little") >= _ED_L):
-                    # the SAME form screen the device staging applies
-                    # (crypto/ed25519._dispatch_bytes): settle malformed
-                    # lanes here so the dispatched shape is always
-                    # pow2(len(wave.items)) — items screened AFTER
-                    # padding would shrink the real device shape under
-                    # the one the guard recorded and pin() enforced
+                    # malformed/malleable: a False verdict, never a lane
+                    # — items screened AFTER padding would shrink the
+                    # real device shape under the recorded/pinned one
                     tok.plan[i] = ("k", False)
                     i += 1
                     continue
@@ -548,8 +564,37 @@ class CryptoPipeline:
             tok.planned = i
             if i < len(tok.items):
                 break                      # wave full mid-token
-            self._ed_staged.popleft()
-        self._ed_first_staged = (self._now() if self._ed_staged else None)
+            staged.popleft()
+        return wave_vks
+
+    def _select_bucket(self, wave: _Wave, n_vks: int, floor: int,
+                       enforce: bool, ladder: list[int],
+                       shapes: set) -> int:
+        """Shared pad-bucket policy: under enforcement, the smallest
+        COMPILED bucket that fits (respecting the floor when possible —
+        the pack cap guarantees the largest compiled bucket always
+        fits); otherwise the ladder bucket covering max(floor, size)."""
+        if enforce and ladder:
+            fits = [b for b in ladder
+                    if b >= wave.n_real
+                    and self._cache_bucket(n_vks, b) in shapes]
+            preferred = [b for b in fits if b >= floor]
+            if preferred:
+                return preferred[0]
+            if fits:
+                return fits[-1]
+        for b in self.buckets:
+            if b >= max(floor, wave.n_real):
+                return b
+        return self.buckets[-1]
+
+    def _finish_wave(self, wave: _Wave, n_vks: int, bucketed: bool,
+                     enforce: bool, ladder: list[int], shapes: set,
+                     lane_stats: Optional[dict] = None) -> _Wave:
+        """Shared wave-finishing tail (single ring and every lane): a
+        fully-cache-settled wave resolves with no dispatch; otherwise
+        pad to the selected bucket and mirror the pad/bucket-hit/
+        overflow accounting (plus the lane's own copy when given)."""
         wave.n_real = len(wave.items)
         if wave.n_real == 0:
             # everything rode the cache: resolve the plans, no dispatch
@@ -558,53 +603,98 @@ class CryptoPipeline:
             return wave
         if wave.overflowed:
             self.stats["overflow_waves"] += 1
-        # bucket pad: the controller's floor, then the smallest pinned
-        # bucket covering the wave (skipped while the breaker routes to
-        # CPU — pad lanes would be verified for real there)
-        if self._bucketed and not self._device_degraded():
+            if lane_stats is not None:
+                lane_stats["overflow_waves"] += 1
+        if bucketed:
             floor = (self.controller.bucket_floor
                      if self.controller is not None
                      else self.config.PIPELINE_MIN_BUCKET)
-            bucket = None
-            if enforce and self._ed_buckets():
-                # smallest COMPILED bucket that fits (respecting the
-                # floor when possible); the pack cap above guarantees at
-                # least the largest compiled bucket always fits
-                fits = [b for b in self._ed_buckets()
-                        if b >= wave.n_real and self._cache_bucket(
-                            len(wave_vks), b) in self._shapes]
-                preferred = [b for b in fits if b >= floor]
-                if preferred:
-                    bucket = preferred[0]
-                elif fits:
-                    bucket = fits[-1]
-            if bucket is None:
-                for b in self.buckets:
-                    if b >= max(floor, wave.n_real):
-                        bucket = b
-                        break
-                bucket = bucket or self.buckets[-1]
+            bucket = self._select_bucket(wave, n_vks, floor, enforce,
+                                         ladder, shapes)
             wave.bucket = bucket
             pad = bucket - wave.n_real
             if pad > 0:
                 wave.items.extend([wave.items[0]] * pad)
                 self.stats["pad_items"] += pad
+                if lane_stats is not None:
+                    lane_stats["pad_items"] += pad
             if bucket == max(floor, self.buckets[0]):
                 self.stats["bucket_hits"] += 1
+                if lane_stats is not None:
+                    lane_stats["bucket_hits"] += 1
         else:
             wave.bucket = wave.n_real
         wave.t_packed = self._now()
         return wave
 
-    def _dispatch_wave(self, wave: _Wave) -> None:
+    def _ring_flush_due(self, staged, first_staged) -> bool:
+        """Shared flush predicate: a full wave is ready, or the oldest
+        staged item has waited out the coalescing window."""
+        if not staged:
+            return False
+        floor = (self.controller.bucket_floor if self.controller is not None
+                 else self.config.PIPELINE_MIN_BUCKET)
+        if sum(len(t.items) - t.planned for t in staged) >= floor:
+            return True
+        wait = (self.controller.flush_wait if self.controller is not None
+                else self.config.PIPELINE_FLUSH_WAIT)
+        return (first_staged is not None
+                and self._now() - first_staged >= wait)
+
+    def _pack_wave(self) -> Optional[_Wave]:
+        """Drain the ed ring into one wave: dedup against the verdict
+        cache and within the wave, stop at the bucket cap (leftovers stay
+        staged — the wave is marked overflowed so the controller can grow
+        the floor)."""
+        if not self._ed_staged:
+            return None
+        wave = _Wave()
+        wave.t_first = self._ed_first_staged
+        cap = self.config.PIPELINE_MAX_BUCKET
+        key_cap = cap
+        enforce = (self.pinned and self._bucketed
+                   and not self._device_degraded())
+        if enforce and self._ed_buckets():
+            # pinned: never pack past what can dispatch on a compiled
+            # shape — leftovers ride the next wave instead of forcing a
+            # novel mid-run XLA compile
+            cap = max(self._ed_buckets())
+            key_cap = self._key_cap()
+        wave_vks = self._plan_into_wave(self._ed_staged, wave, cap,
+                                        key_cap)
+        self._ed_first_staged = (self._now() if self._ed_staged else None)
+        # bucket pad: the controller's floor, then the smallest pinned
+        # bucket covering the wave (skipped while the breaker routes to
+        # CPU — pad lanes would be verified for real there)
+        return self._finish_wave(
+            wave, len(wave_vks),
+            self._bucketed and not self._device_degraded(),
+            enforce, self._ed_buckets(), self._shapes)
+
+    def _dispatch_wave(self, wave: _Wave, lane=None) -> None:
+        """Dispatch a packed wave and account for it — shared by the
+        single ring (lane=None: the base inner, self._ed_inflight) and
+        every multi-device lane (the lane's own inner/shape-set/stats),
+        so dispatch accounting can never fork between them."""
         if wave.n_real:
             n_keys = len({it[2] for it in wave.items})
-            self.note_shape(self._cache_bucket(n_keys, len(wave.items)))
-        wave.inner_tok = self._ed_inner.submit_batch(wave.items)
+            shape = self._cache_bucket(n_keys, len(wave.items))
+            if lane is None:
+                self.note_shape(shape)
+            else:
+                self._note_lane_shape(lane, shape)
+        if lane is None:
+            wave.inner_tok = self._ed_inner.submit_batch(wave.items)
+        else:
+            lane.dispatch(wave)
         wave.t_dispatched = self._now()
         self.stats["dispatches"] += 1
         self.stats["dispatched_items"] += wave.n_real
         self.stats["coalesced_items"] += wave.coalesced
+        if lane is not None:
+            lane.stats["dispatches"] += 1
+            lane.stats["dispatched_items"] += wave.n_real
+            lane.stats["coalesced_items"] += wave.coalesced
         if self.metrics is not None:
             self.metrics.add_event(MetricsName.PIPELINE_ITEMS_PER_DISPATCH,
                                    wave.coalesced)
@@ -614,7 +704,8 @@ class CryptoPipeline:
                 self.metrics.add_event(
                     MetricsName.PIPELINE_PAD_WASTE,
                     (wave.bucket - wave.n_real) / wave.bucket)
-        self._ed_inflight = wave
+        if lane is None:
+            self._ed_inflight = wave
 
     def _resolve_wave(self, wave: _Wave, ok) -> None:
         ok = np.asarray(ok, dtype=bool)
@@ -641,17 +732,8 @@ class CryptoPipeline:
             })
 
     def _flush_due(self) -> bool:
-        if not self._ed_staged:
-            return False
-        floor = (self.controller.bucket_floor if self.controller is not None
-                 else self.config.PIPELINE_MIN_BUCKET)
-        staged = sum(len(t.items) - t.planned for t in self._ed_staged)
-        if staged >= floor:
-            return True                  # a full wave is ready
-        wait = (self.controller.flush_wait if self.controller is not None
-                else self.config.PIPELINE_FLUSH_WAIT)
-        return (self._ed_first_staged is not None
-                and self._now() - self._ed_first_staged >= wait)
+        return self._ring_flush_due(self._ed_staged,
+                                    self._ed_first_staged)
 
     def service(self, force: bool = False) -> bool:
         """The pump: poll the in-flight wave, promote the packed one, pack
@@ -694,19 +776,27 @@ class CryptoPipeline:
         per prod cycle after every node staged its batches)."""
         self.service(force=True)
 
+    @staticmethod
+    def _try_settle_token(token: _EdToken) -> bool:
+        """Assemble the token's verdicts once every plan entry resolved
+        (shared by the single ring and the multi-device pump — verdict
+        assembly must never fork between them). -> settled?"""
+        if token.planned < len(token.items):
+            return False
+        if not all(e is not None and (e[0] == "k"
+                                      or e[1].verdicts is not None)
+                   for e in token.plan):
+            return False
+        out = np.zeros(len(token.plan), dtype=bool)
+        for i, e in enumerate(token.plan):
+            out[i] = e[1] if e[0] == "k" else bool(e[1].verdicts[e[2]])
+        token.verdicts = out
+        return True
+
     def collect_verify(self, token: _EdToken,
                        wait: bool = True) -> Optional[np.ndarray]:
         while token.verdicts is None:
-            ready = (token.planned >= len(token.items)
-                     and all(e is not None and (
-                         e[0] == "k" or e[1].verdicts is not None)
-                         for e in token.plan))
-            if ready:
-                out = np.zeros(len(token.plan), dtype=bool)
-                for i, e in enumerate(token.plan):
-                    out[i] = e[1] if e[0] == "k" else \
-                        bool(e[1].verdicts[e[2]])
-                token.verdicts = out
+            if self._try_settle_token(token):
                 break
             if self._ed_inflight is not None:
                 if wait:
@@ -955,8 +1045,8 @@ class CryptoPipeline:
 
     # --- adapters ----------------------------------------------------------
 
-    def verifier(self) -> "PipelineVerifier":
-        return PipelineVerifier(self)
+    def verifier(self, lane: Optional[int] = None) -> "PipelineVerifier":
+        return PipelineVerifier(self, lane=lane)
 
     def bls_verifier(self):
         return PipelineBlsVerifier(self)
@@ -1015,15 +1105,496 @@ class CryptoPipeline:
         return out
 
 
+class _DeviceLane:
+    """One chip of the multi-device ring: its own wave queue, its own
+    pinned-bucket/compiled-shape set, its own (supervised) verifier —
+    and therefore its own breaker. Threaded lanes dispatch from a worker
+    because same-thread async dispatch SERIALIZES executions across
+    devices (measured on XLA:CPU: 4 async waves cost 4x one wave; 4
+    threaded waves cost 1x)."""
+
+    __slots__ = ("idx", "inner", "bucketed", "threaded", "staged",
+                 "first_staged", "packed", "inflight", "shapes", "stats",
+                 "_q", "_worker")
+
+    def __init__(self, idx: int, inner, threaded: Optional[bool] = None):
+        self.idx = idx
+        self.inner = inner
+        self.bucketed = _device_backed(inner)
+        if threaded is None:
+            # auto: only lanes PINNED to a real device need a dispatch
+            # thread; unpinned (test/sim/CPU) lanes stay inline so the
+            # deterministic fuzz harness replays exactly
+            threaded = getattr(inner, "device", None) is not None
+        self.threaded = bool(threaded)
+        self.staged: deque[_EdToken] = deque()
+        self.first_staged: Optional[float] = None
+        self.packed: Optional[_Wave] = None
+        self.inflight: Optional[_Wave] = None
+        self.shapes: set = set()
+        self.stats = {"dispatches": 0, "dispatched_items": 0,
+                      "coalesced_items": 0, "bucket_hits": 0,
+                      "pad_items": 0, "overflow_waves": 0,
+                      "unpinned_shapes": 0}
+        self._q = None
+        self._worker = None
+
+    # --- threaded dispatch hand-off ------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None:
+            return
+        import queue
+        import threading
+        self._q = queue.Queue()
+        self._worker = threading.Thread(
+            target=self._run_worker, name=f"pipeline-lane{self.idx}",
+            daemon=True)
+        self._worker.start()
+
+    def _run_worker(self) -> None:
+        while True:
+            wave = self._q.get()
+            if wave is None:
+                return
+            try:
+                tok = self.inner.submit_batch(wave.items)
+                wave.result = self.inner.collect_batch(tok, wait=True)
+            except Exception:
+                wave.result = None       # pump degrades to CPU re-verify
+            wave.done = True             # written before the event fires
+            wave.event.set()
+
+    def dispatch(self, wave: _Wave) -> None:
+        if self.threaded:
+            import threading
+            self._ensure_worker()
+            wave.event = threading.Event()
+            self._q.put(wave)
+        else:
+            wave.inner_tok = self.inner.submit_batch(wave.items)
+        self.inflight = wave
+
+    def poll(self, wait: bool = False):
+        """-> verdicts of the in-flight wave, or None if still flying.
+        Device errors degrade to a host re-verify (the same contract as
+        the single-ring pump: semantics never change, never a crash)."""
+        wave = self.inflight
+        if wave is None:
+            return None
+        if self.threaded:
+            if not wave.done:
+                if not wait:
+                    return None
+                # worker always terminates (the supervised inner hedges
+                # a wedged device at its deadline), so this wait ends
+                wave.event.wait()
+            got = wave.result
+            if got is None:
+                got = CpuEd25519Verifier().verify_batch(wave.items)
+            return got
+        try:
+            got = self.inner.collect_batch(wave.inner_tok, wait=wait)
+        except Exception:
+            got = CpuEd25519Verifier().verify_batch(wave.items)
+        return got
+
+    def degraded(self) -> bool:
+        breaker = getattr(self.inner, "breaker", None)
+        state = getattr(breaker, "state", None)
+        return state is not None and state != "closed"
+
+    def breaker_state(self) -> Optional[str]:
+        breaker = getattr(self.inner, "breaker", None)
+        return getattr(breaker, "state", None)
+
+    def occupancy(self) -> int:
+        n = sum(len(t.items) - t.planned for t in self.staged)
+        if self.packed is not None:
+            n += self.packed.n_real
+        if self.inflight is not None:
+            n += self.inflight.n_real
+        return n
+
+    def close(self) -> None:
+        if self._worker is not None:
+            self._q.put(None)
+            self._worker.join(timeout=5.0)
+            self._worker = None
+
+
+class MultiDeviceCryptoPipeline(CryptoPipeline):
+    """The PR 8 submission ring sharded across N chips.
+
+    Each device gets an independent LANE: its own wave queue fed by the
+    same shape-bucket ladder (per-lane pinned-bucket set — prewarm/pin
+    compile each chip's own executables), its own double-buffered
+    dispatch, and its own supervised verifier, so each chip is an
+    INDEPENDENTLY BREAKABLE backend: a wedged chip opens that lane's
+    breaker and degrades that lane's waves to host fallback while every
+    other lane keeps dispatching. Ed25519 key tables live per lane
+    (each verifier's staged-row cache fills with the keys its traffic
+    carries — placement-pinned submitters therefore PARTITION the key
+    space; unhinted traffic replicates hot keys); the BLS table stays
+    host-shared (the pairing check is host-side).
+
+    Placement: `place(tag)` pins co-hosted sub-pool shards to distinct
+    chips (tag % n_lanes) so shard count scales crypto throughput
+    instead of queueing on one device; unhinted submissions go to the
+    least-backlogged HEALTHY lane (an open-breaker lane only receives
+    its pinned traffic — which its supervisor serves at host speed).
+
+    The verdict/digest caches, the BLS/SHA/commitment lanes, and the
+    AIMD controller are inherited shared state: content keys are pure
+    functions of bytes, so cross-lane sharing can never change a
+    verdict, and the controller steers the one flush-hold/bucket-floor
+    pair for the whole ring.
+    """
+
+    def __init__(self, ed_inners: Sequence, config=None, now=None,
+                 threaded: Optional[bool] = None, **kw):
+        if not ed_inners:
+            raise ValueError("MultiDeviceCryptoPipeline needs >= 1 lane")
+        super().__init__(ed_inner=ed_inners[0], config=config, now=now,
+                         **kw)
+        if threaded is None:
+            threaded = getattr(self.config, "PIPELINE_LANE_THREADS", None)
+        self.lanes = [_DeviceLane(i, inner, threaded=threaded)
+                      for i, inner in enumerate(ed_inners)]
+        self._rr = 0                     # round-robin cursor (unhinted)
+        self._bucketed = any(l.bucketed for l in self.lanes)
+
+    # --- clock / key plumbing across lanes ------------------------------
+
+    def set_clock(self, now) -> None:
+        super().set_clock(now)
+        for lane in self.lanes[1:]:
+            set_inner = getattr(lane.inner, "set_clock", None)
+            if callable(set_inner):
+                set_inner(now)
+
+    def evict_key(self, key) -> None:
+        super().evict_key(key)           # lane 0's ed inner + bls
+        for lane in self.lanes[1:]:
+            evict = getattr(lane.inner, "evict_key", None)
+            if callable(evict):
+                evict(key)
+
+    def close(self) -> None:
+        for lane in self.lanes:
+            lane.close()
+
+    # --- placement ------------------------------------------------------
+
+    def place(self, tag: int) -> Optional[int]:
+        return tag % len(self.lanes)
+
+    def _pick_lane(self, hint: Optional[int]) -> _DeviceLane:
+        if hint is not None:
+            # pinned submitters STAY pinned: a degraded lane serves its
+            # pinned traffic at host-fallback speed (one lane degrades,
+            # the ring does not reshuffle under it)
+            return self.lanes[hint % len(self.lanes)]
+        healthy = [l for l in self.lanes if not l.degraded()]
+        pool = healthy or self.lanes
+        best = min(pool, key=lambda l: (l.occupancy(),
+                                        (l.idx - self._rr)
+                                        % len(self.lanes)))
+        self._rr = (best.idx + 1) % len(self.lanes)
+        return best
+
+    # --- the ed lane, per device ----------------------------------------
+
+    def submit_verify(self, items: Sequence[VerifyItem],
+                      lane: Optional[int] = None) -> _EdToken:
+        now = self._now()
+        tok = _EdToken(list(items), now)
+        self.stats["submitted_items"] += len(tok.items)
+        target = self._pick_lane(lane)
+        if not target.staged:
+            target.first_staged = now
+        target.staged.append(tok)
+        return tok
+
+    def _lane_buckets(self, lane: _DeviceLane) -> list[int]:
+        return self._ed_buckets(lane.shapes)
+
+    def _lane_key_cap(self, lane: _DeviceLane) -> int:
+        return self._key_cap(lane.shapes)
+
+    def _note_lane_shape(self, lane: _DeviceLane, key) -> None:
+        if key not in lane.shapes:
+            lane.shapes.add(key)
+            if self.pinned:
+                lane.stats["unpinned_shapes"] += 1
+                self.stats["unpinned_shapes"] += 1
+
+    @property
+    def compiled_shapes(self) -> int:
+        # per-lane ed shapes (each chip compiles its own executables)
+        # plus the shared sha/cmt shape notes in the base set
+        return (sum(len(l.shapes) for l in self.lanes)
+                + len(self._shapes))
+
+    def _pack_lane(self, lane: _DeviceLane) -> Optional[_Wave]:
+        """The single-ring `_pack_wave`, parameterized by lane: the SAME
+        shared inner loop (`_plan_into_wave` — dedup against the SHARED
+        verdict cache) and bucket policy (`_select_bucket`), enforcing
+        THIS lane's compiled-bucket ladder after pin()."""
+        if not lane.staged:
+            return None
+        wave = _Wave()
+        wave.lane = lane.idx
+        wave.t_first = lane.first_staged
+        cap = self.config.PIPELINE_MAX_BUCKET
+        key_cap = cap
+        enforce = (self.pinned and lane.bucketed and not lane.degraded())
+        lane_buckets = self._lane_buckets(lane)
+        if enforce and lane_buckets:
+            cap = max(lane_buckets)
+            key_cap = self._lane_key_cap(lane)
+        wave_vks = self._plan_into_wave(lane.staged, wave, cap, key_cap)
+        lane.first_staged = self._now() if lane.staged else None
+        return self._finish_wave(
+            wave, len(wave_vks),
+            lane.bucketed and not lane.degraded(),
+            enforce, lane_buckets, lane.shapes, lane_stats=lane.stats)
+
+    def _dispatch_lane(self, lane: _DeviceLane, wave: _Wave) -> None:
+        self._dispatch_wave(wave, lane=lane)
+
+    def _lane_flush_due(self, lane: _DeviceLane) -> bool:
+        return self._ring_flush_due(lane.staged, lane.first_staged)
+
+    def _poll_lane(self, lane: _DeviceLane, wait: bool = False) -> bool:
+        if lane.inflight is None:
+            return False
+        got = lane.poll(wait=wait)
+        if got is None:
+            return False
+        self._resolve_wave(lane.inflight, got)
+        lane.inflight = None
+        return True
+
+    def service(self, force: bool = False) -> bool:
+        """The pump, N lanes wide: every lane polls its in-flight wave,
+        packs a due wave from ITS queue, and promotes packed -> in-flight
+        the moment the chip is free — N double-buffered streams."""
+        progressed = False
+        for lane in self.lanes:
+            progressed |= self._poll_lane(lane)
+            if lane.packed is None and (force or self._lane_flush_due(lane)):
+                packed = self._pack_lane(lane)
+                if packed is not None:
+                    if packed.n_real == 0:
+                        progressed = True     # fully cache-settled
+                    else:
+                        lane.packed = packed
+            if lane.inflight is None and lane.packed is not None:
+                self._dispatch_lane(lane, lane.packed)
+                lane.packed = None
+                progressed = True
+        if force:
+            progressed |= self._flush_bls()
+            progressed |= self._flush_sha()
+            progressed |= self._flush_cmt()
+        return progressed
+
+    def collect_verify(self, token: _EdToken,
+                       wait: bool = True) -> Optional[np.ndarray]:
+        while token.verdicts is None:
+            if self._try_settle_token(token):
+                break
+            if wait:
+                if self.service(force=True):
+                    # the pump progressed (possibly resolving THIS
+                    # token's waves): re-check readiness before blocking
+                    # anywhere — otherwise a sick chip's hedge deadline
+                    # head-of-line-blocks every healthy-lane collect
+                    continue
+                # no progress: block on a lane carrying one of THIS
+                # token's waves first; only fall back to any in-flight
+                # lane when the token is waiting on a still-queued wave
+                # behind it. Every poll terminates (threaded workers
+                # hedge via the supervised inner; inline lanes
+                # blocking-collect the same way).
+                target = None
+                for e in token.plan:
+                    if (e is not None and e[0] == "w"
+                            and e[1].verdicts is None
+                            and e[1].lane is not None
+                            and self.lanes[e[1].lane].inflight is e[1]):
+                        target = self.lanes[e[1].lane]
+                        break
+                if target is None:
+                    target = next((l for l in self.lanes
+                                   if l.inflight is not None), None)
+                if target is not None:
+                    self._poll_lane(target, wait=True)
+            else:
+                if not self.service():
+                    # non-blocking and nothing progressed: the caller
+                    # polls again later (threaded waves resolve on their
+                    # workers; inline waves on the next service)
+                    return None
+        return token.verdicts
+
+    # --- warmup / pinning across lanes ----------------------------------
+
+    def prewarm(self, buckets: Optional[Sequence[int]] = None) -> list[int]:
+        """Compile the pad buckets on EVERY lane — each chip owns its
+        executables. Threaded lanes warm CONCURRENTLY (N compiles cost
+        ~max, not sum; on jax-cpu one cold verify-kernel compile is
+        60-130 s, so sequential warmup of 8 lanes would be minutes).
+        A lane's shape is noted only AFTER its warm dispatch succeeds,
+        and a failed warm (bare lane, wedged chip) RAISES after the
+        join — silently reporting it warmed would let pin() enforce a
+        bucket that never compiled (the mid-run-retrace stall pin()
+        exists to prevent)."""
+        want = [b for b in sorted(set(
+            buckets if buckets is not None else self.buckets[:1]))
+            if b in set(self.buckets)]
+        warmed: list[int] = []
+        errors: list[tuple[int, Exception]] = []
+
+        def warm_lane(lane: _DeviceLane) -> None:
+            for b in want:
+                items = [(b"pipeline-prewarm", b"\x00" * 64,
+                          b"\x00" * 32)] * b
+                tok = lane.inner.submit_batch(items)
+                lane.inner.collect_batch(tok, wait=True)
+                self._note_lane_shape(lane, self._cache_bucket(1, b))
+
+        def warm_guarded(lane: _DeviceLane) -> None:
+            try:
+                warm_lane(lane)
+            except Exception as e:
+                errors.append((lane.idx, e))
+
+        threads = []
+        for lane in self.lanes:
+            if not lane.bucketed:
+                continue
+            if lane.threaded:
+                import threading
+                t = threading.Thread(target=warm_guarded, args=(lane,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+            else:
+                warm_lane(lane)     # inline: propagate like the base
+            warmed = want
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(
+                "lane prewarm failed: "
+                + "; ".join(f"lane{i}: {e!r}" for i, e in errors))
+        return warmed
+
+    def pin(self) -> None:
+        self.pinned = True
+        ladders = [self._lane_buckets(l) for l in self.lanes if l.bucketed]
+        tops = [max(lad) for lad in ladders if lad]
+        if self.controller is not None and tops:
+            # the floor must be dispatchable on EVERY lane's ladder
+            self.controller._floor_max = min(self.controller._floor_max,
+                                             min(tops))
+
+    # --- reporting ------------------------------------------------------
+
+    def occupancy(self) -> int:
+        n = sum(lane.occupancy() for lane in self.lanes)
+        n += sum(len(t.items) for t in self._bls_staged)
+        n += sum(len(t.items) for t in self._sha_staged)
+        n += sum(len(t.items) for t in self._cmt_staged)
+        return n
+
+    def device_state(self) -> list[dict]:
+        """Per-chip gauges: the telemetry state section + fleet console
+        read these to show WHICH chip is sick."""
+        out = []
+        for lane in self.lanes:
+            d = lane.stats["dispatches"]
+            dev = getattr(lane.inner, "device", None)
+            out.append({
+                "lane": lane.idx,
+                **({"device": str(dev)} if dev is not None else {}),
+                "breaker": lane.breaker_state() or "none",
+                "occupancy": lane.occupancy(),
+                "dispatches": d,
+                "dispatched_items": lane.stats["dispatched_items"],
+                "bucket_hit_rate": round(lane.stats["bucket_hits"] / d, 3)
+                if d else None,
+            })
+        return out
+
+    def sample_metrics(self, metrics) -> None:
+        super().sample_metrics(metrics)
+        states = [lane.breaker_state() for lane in self.lanes]
+        metrics.add_event(MetricsName.PIPELINE_DEVICE_LANES,
+                          len(self.lanes))
+        metrics.add_event(
+            MetricsName.PIPELINE_DEVICE_BREAKERS_OPEN,
+            sum(1 for s in states if s not in (None, "closed")))
+        occs = [lane.occupancy() for lane in self.lanes]
+        metrics.add_event(MetricsName.PIPELINE_DEVICE_OCCUPANCY_MAX,
+                          max(occs) if occs else 0)
+        disp = [lane.stats["dispatches"] for lane in self.lanes]
+        if disp and sum(disp):
+            mean = sum(disp) / len(disp)
+            metrics.add_event(MetricsName.PIPELINE_DEVICE_DISPATCH_SPREAD,
+                              max(disp) / mean if mean else 0.0)
+
+    def summary(self) -> dict:
+        out = super().summary()
+        out["devices"] = self.device_state()
+        out["lanes"] = len(self.lanes)
+        return out
+
+
+def make_multidevice_pipeline(config, n_devices: int,
+                              min_batch: int = 1,
+                              supervised: bool = True,
+                              **kw) -> "MultiDeviceCryptoPipeline":
+    """N independent chip lanes over this host's local devices: one
+    device-pinned JaxEd25519Verifier per lane, each wrapped in ITS OWN
+    plane supervisor (independent breaker/deadline state — the whole
+    point: chip k wedging opens lane k, not the ring)."""
+    from plenum_tpu.crypto.ed25519 import JaxEd25519Verifier
+
+    from .mesh import lane_roster
+    devs = lane_roster(n_devices if n_devices > 0 else None)
+    if not devs:
+        raise RuntimeError("no local devices for the multi-device pipeline")
+    inners = []
+    for i, dev in enumerate(devs):
+        v = JaxEd25519Verifier(min_batch=min_batch, device=dev)
+        if supervised:
+            from .supervisor import supervise
+            v = supervise(v, label=f"lane{i}")
+        inners.append(v)
+    return MultiDeviceCryptoPipeline(
+        ed_inners=inners, config=config,
+        sha_device=kw.pop("sha_device", True),
+        sha_min_device=kw.pop("sha_min_device", getattr(
+            config, "PIPELINE_SHA_MIN_BATCH", 1024)), **kw)
+
+
 class PipelineVerifier(Ed25519Verifier):
     """`Ed25519Verifier` face of the pipeline ring: client-auth batches
     (node/client_authn.py) stage into the shared ring instead of
     dispatching alone. `_inner` points at the pipeline's device verifier
     so `find_supervisor` and the node's metric/anomaly wiring see the
-    breaker exactly as before."""
+    breaker exactly as before (multi-device rings expose lane 0 there;
+    the per-lane story rides `device_state()`/the pipeline_dev gauges).
+    `lane` is the placement pin: a sub-pool shard's nodes submit with
+    their shard's lane so co-hosted shards land on distinct chips."""
 
-    def __init__(self, pipeline: CryptoPipeline):
+    def __init__(self, pipeline: CryptoPipeline,
+                 lane: Optional[int] = None):
         self._pipeline = pipeline
+        self._lane = lane
         self._inner = pipeline._ed_inner
 
     # last-attached node collector seam (node/__init__ assigns .metrics on
@@ -1041,7 +1612,7 @@ class PipelineVerifier(Ed25519Verifier):
         return self._pipeline.dispatches
 
     def submit_batch(self, items: Sequence[VerifyItem]):
-        tok = self._pipeline.submit_verify(items)
+        tok = self._pipeline.submit_verify(items, lane=self._lane)
         # pump so a due wave dispatches without waiting for a collect
         self._pipeline.service()
         return tok
@@ -1124,16 +1695,28 @@ class PipelinedTreeHasher(_TreeHasherBase):
 def make_crypto_pipeline(config, backend: str,
                          min_batch: int = 128,
                          supervised: bool = True,
-                         ed_inner: Optional[Ed25519Verifier] = None
+                         ed_inner: Optional[Ed25519Verifier] = None,
+                         n_devices: Optional[int] = None
                          ) -> Optional[CryptoPipeline]:
     """Config-gated construction seam: `CRYPTO_PIPELINE=False` (or a
     non-device backend) -> None, and every consumer keeps its per-call
     dispatch path — the disabled cost is one `is None` check at wiring
-    time (pinned by the microbenchmark in tests/test_pipeline.py)."""
+    time (pinned by the microbenchmark in tests/test_pipeline.py).
+
+    `n_devices` (default: config.PIPELINE_DEVICES) selects the scale-out
+    shape: 1 -> the single-ring PR 8 pipeline EXACTLY (no lane
+    indirection on the hot path); >1 -> per-chip lanes with independent
+    breakers; 0 -> every local device."""
     if not getattr(config, "CRYPTO_PIPELINE", True):
         return None
     if backend not in ("jax", "jax-sharded") and ed_inner is None:
         return None
+    if n_devices is None:
+        n_devices = getattr(config, "PIPELINE_DEVICES", 1)
+    if ed_inner is None and backend == "jax" and n_devices != 1:
+        return make_multidevice_pipeline(config, n_devices,
+                                         min_batch=min_batch,
+                                         supervised=supervised)
     if ed_inner is None:
         from plenum_tpu.crypto.ed25519 import make_verifier
         ed_inner = make_verifier(backend, min_batch=min_batch,

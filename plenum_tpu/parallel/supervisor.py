@@ -255,8 +255,12 @@ class SupervisedVerifier(Ed25519Verifier):
                  breaker: Optional[CircuitBreaker] = None,
                  budget: Optional[DeadlineBudget] = None,
                  max_outstanding_bytes: int = 8 * 1024 * 1024,
-                 now=None):
+                 now=None, label: str = ""):
         self._device = device
+        # which backend this supervisor guards — the multi-device
+        # pipeline labels one supervisor per chip lane ("lane0", ...)
+        # so breaker stories in stats/telemetry name the sick chip
+        self.label = label
         self._fallback = fallback or CpuEd25519Verifier()
         self._now = now or time.monotonic
         self.breaker = breaker or CircuitBreaker(now=self._now)
@@ -528,6 +532,7 @@ class SupervisedVerifier(Ed25519Verifier):
 
     def supervisor_stats(self) -> dict:
         return dict(self.stats,
+                    **({"label": self.label} if self.label else {}),
                     breaker_state=self.breaker.state,
                     breaker_state_code=self.breaker.state_code,
                     breaker_opens=self.breaker.opens,

@@ -53,7 +53,7 @@ class SimShard:
 
     def __init__(self, shard_id: int, names: Sequence[str], timer, seed: int,
                  config, pipeline=None, tracing: bool = False,
-                 verifier=None):
+                 verifier=None, pipeline_lane=None):
         from plenum_tpu.network import SimNetwork, SimRandom
         from plenum_tpu.node import Node, NodeBootstrap
         from plenum_tpu.tools.local_pool import build_genesis
@@ -72,6 +72,7 @@ class SimShard:
                 crypto_backend=config.crypto_backend,
                 verifier=verifier,
                 pipeline=pipeline,
+                pipeline_lane=pipeline_lane,
                 state_commitment=config.STATE_COMMITMENT,
                 state_commitment_per_ledger=(
                     config.STATE_COMMITMENT_PER_LEDGER),
@@ -133,7 +134,8 @@ class ShardedSimFabric:
                  seed: int = 1, config=None, timer=None,
                  share_pipeline: bool = False, tracing: bool = False,
                  latency: Optional[tuple[float, float]] = None,
-                 shard_verifiers: Optional[dict] = None):
+                 shard_verifiers: Optional[dict] = None,
+                 pipeline=None):
         from plenum_tpu.config import Config
 
         self.timer = timer if timer is not None else MockTimer()
@@ -146,8 +148,8 @@ class ShardedSimFabric:
         self.latency = latency
         self.tracing = tracing
         self.retired: dict[int, SimShard] = {}
-        self.pipeline = None
-        if share_pipeline:
+        self.pipeline = pipeline
+        if share_pipeline and self.pipeline is None:
             # ONE submission ring for every co-hosted shard: client-auth
             # Ed25519, BLS batch checks, and Merkle hashing coalesce and
             # dedup ACROSS shard boundaries (PR 8's pipeline, wider)
@@ -167,7 +169,8 @@ class ShardedSimFabric:
                              self.timer, seed * 1009 + sid * 7919 + 3,
                              self.config, pipeline=self.pipeline,
                              tracing=tracing,
-                             verifier=self.shard_verifiers.get(sid))
+                             verifier=self.shard_verifiers.get(sid),
+                             pipeline_lane=self._shard_lane(sid))
             if latency is not None:
                 shard.net.set_latency(*latency)
             self.shards[sid] = shard
@@ -292,6 +295,15 @@ class ShardedSimFabric:
 
     # --- elastic membership (reshard.py drives these) -----------------------
 
+    def _shard_lane(self, sid: int):
+        """Placement policy: co-hosted sub-pool shards pin to DISTINCT
+        chips of a multi-device pipeline (shard count then scales crypto
+        throughput instead of queueing every shard's waves on one
+        device). Single-device/absent pipelines place nothing."""
+        if self.pipeline is None:
+            return None
+        return self.pipeline.place(sid)
+
     def _wire_shard_telemetry(self, sid: int, shard: "SimShard") -> None:
         for node in shard.nodes.values():
             if node.telemetry.enabled:
@@ -319,7 +331,8 @@ class ShardedSimFabric:
                          self.seed * 1009 + sid * 7919 + 3, self.config,
                          pipeline=self.pipeline, tracing=self.tracing,
                          verifier=verifier
-                         or self.shard_verifiers.get(sid))
+                         or self.shard_verifiers.get(sid),
+                         pipeline_lane=self._shard_lane(sid))
         if self.latency is not None:
             shard.net.set_latency(*self.latency)
         self.shards[sid] = shard

@@ -994,6 +994,142 @@ def config8_pipeline_ab(n_txns: int = 150,
     return {"error": (out.stderr or "no output").strip()[-300:]}
 
 
+def _multichip_ab_inproc(seconds: float = 6.0, bucket: int = 16,
+                         n_devices: int = 8, repeat: int = 3) -> dict:
+    """The multi-device crypto-pipeline A/B, run INSIDE a forced-8-CPU-
+    device subprocess (config14_multichip spawns it): the SAME pipelined
+    crypto-wave flood (PR 8's 256-deep shape: unique well-formed content,
+    double-buffered, every wave padded to the pinned bucket) through
+
+      (a) ONE device  — the PR 8 single-ring pipeline pinned to chip 0;
+      (b) N devices   — the ring sharded into per-chip lanes, one
+                        breakable supervised verifier per device.
+
+    WARMED and INTERLEAVED per the PR 6/PR 8 methodology, medians of
+    `repeat`. The figure is aggregate crypto-wave throughput (caller
+    items settled per second) — the thing lane scale-out buys; per-lane
+    dispatch counts ride along as placement provenance. Honesty note:
+    on forced-host CPU devices each lane's kernel execution runs on the
+    host's shared cores, so the measured scaling is the RING's ability
+    to keep N execution streams busy (dispatch concurrency + double
+    buffering), the same property that scales on real chips."""
+    import random
+    import time as _time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        pass
+
+    from plenum_tpu.config import Config
+    from plenum_tpu.crypto.ed25519 import JaxEd25519Verifier
+    from plenum_tpu.parallel.mesh import lane_roster
+    from plenum_tpu.parallel.pipeline import (CryptoPipeline,
+                                              make_multidevice_pipeline)
+    from plenum_tpu.parallel.supervisor import supervise
+
+    cfg = Config(PIPELINE_MIN_BUCKET=bucket, PIPELINE_MAX_BUCKET=bucket,
+                 PIPELINE_FLUSH_WAIT=0.0)
+    devs = lane_roster(n_devices)
+    one = CryptoPipeline(
+        ed_inner=supervise(JaxEd25519Verifier(min_batch=1,
+                                              device=devs[0]),
+                           label="lane0"),
+        config=cfg)
+    multi = make_multidevice_pipeline(cfg, n_devices, min_batch=1)
+    for pipe in (one, multi):           # cold pass: compiles + warmup
+        pipe.prewarm([bucket])
+        pipe.pin()
+
+    rng = random.Random(17)
+
+    def junk(k):
+        return [(rng.randbytes(16), rng.randbytes(63) + b"\x00",
+                 rng.randbytes(32)) for _ in range(k)]
+
+    def flood(pipe, lanes: int) -> float:
+        settled = 0
+        toks = []
+        t0 = _time.perf_counter()
+        deadline = t0 + seconds
+        while _time.perf_counter() < deadline:
+            toks.append(pipe.submit_verify(junk(bucket)))
+            pipe.service()
+            while len(toks) > 2 * lanes:
+                if pipe.collect_verify(toks.pop(0), wait=True) is not None:
+                    settled += bucket
+        for tok in toks:
+            if pipe.collect_verify(tok, wait=True) is not None:
+                settled += bucket
+        return settled / (_time.perf_counter() - t0)
+
+    flood(one, 1)                       # warm the drive loop itself
+    flood(multi, n_devices)
+    ones, multis = [], []
+    for _ in range(repeat):             # interleaved
+        ones.append(flood(one, 1))
+        multis.append(flood(multi, n_devices))
+    ones.sort()
+    multis.sort()
+    one_med = ones[len(ones) // 2]
+    multi_med = multis[len(multis) // 2]
+    out = {
+        "n_devices": n_devices, "bucket": bucket, "repeat": repeat,
+        "one_device_items_per_s": round(one_med, 1),
+        "multi_device_items_per_s": round(multi_med, 1),
+        "scaling": round(multi_med / one_med, 2) if one_med else None,
+        "per_device_dispatches": {
+            "lane%d" % d["lane"]: d["dispatches"]
+            for d in multi.device_state()},
+        "one_device_dispatches": one.stats["dispatches"],
+        "unpinned_shapes": (one.stats["unpinned_shapes"]
+                            + multi.stats["unpinned_shapes"]),
+    }
+    multi.close()
+    return out
+
+
+def config14_multichip(seconds: float = 6.0,
+                       timeout: float = 1500.0) -> dict:
+    """N-device pipelined-flood A/B on JAX-ON-CPU (8 forced host
+    devices), in a subprocess so the bench process never reconfigures
+    its own jax backend. Published with `jax_source` provenance and the
+    per-device dispatch counts — the multi-chip scale-out headline's
+    measured stand-in (the TPU runs the same lane code)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "flags = os.environ.get('XLA_FLAGS', '')\n"
+        "if 'xla_force_host_platform_device_count' not in flags:\n"
+        "    os.environ['XLA_FLAGS'] = (flags +"
+        " ' --xla_force_host_platform_device_count=8').strip()\n"
+        "import json\n"
+        "from plenum_tpu.tools.bench_configs import _multichip_ab_inproc\n"
+        f"print(json.dumps(_multichip_ab_inproc(seconds={seconds})))\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"error": "multichip A/B timed out"}
+    for line in reversed(out.stdout.strip().splitlines() or [""]):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            parsed["jax_source"] = "jax-on-cpu"
+            return parsed
+    return {"error": (out.stderr or "no output").strip()[-300:]}
+
+
 def config1b_distinct_signers(n_txns: int = 200,
                               timeout: float = 120.0) -> dict:
     """Diverse-client honesty datum: every write signed by a DIFFERENT

@@ -117,6 +117,18 @@ def render(agg, incidents, last_n: int = 5) -> str:
         worst = max(s["staleness"].items(), key=lambda kv: kv[1])
         lines.append(f"  anchor staleness (worst): {worst[0]}="
                      f"{worst[1]:.1f}s")
+    # multi-device crypto ring: name the sick chip(s) — a lane whose
+    # breaker is not closed is serving its pinned traffic on host
+    # fallback while the rest of the ring keeps dispatching
+    sick_lanes = []
+    for name, snap in sorted(getattr(agg, "latest", {}).items()):
+        pipe_state = snap.get("state", {}).get("pipeline", {})
+        for dev in pipe_state.get("devices", []) or []:
+            if dev.get("breaker") not in ("closed", "none"):
+                sick_lanes.append(
+                    f"{name}:lane{dev.get('lane')}={dev.get('breaker')}")
+    if sick_lanes:
+        lines.append("  SICK CHIPS: " + ", ".join(sick_lanes))
     for kind, per_node in s["burn"].items():
         burning = {n: b for n, b in per_node.items()
                    if b["fast"] > 0 or b["slow"] > 0}
@@ -217,6 +229,30 @@ def self_check() -> int:
     if not any(a.severity == "clear" and a.kind == "health.node"
                for a in agg3.alerts):
         problems.append("health alert never cleared")
+
+    # 3b) multi-device ring: ONE sick chip lane degrades the node
+    # lightly (lane penalty, not the full plane-breaker one) and the
+    # console names the chip — the operator must see WHICH lane is sick
+    agg3b = FleetAggregator(config=config)
+    laney = healthy("N1", 0, 0.0)
+    laney["state"]["pipeline"] = {
+        "occupancy": 0, "dispatches": 10, "breakers_open": 1,
+        "devices": [
+            {"lane": 0, "breaker": "closed", "occupancy": 0,
+             "dispatches": 5},
+            {"lane": 2, "breaker": "open", "occupancy": 3,
+             "dispatches": 5}]}
+    agg3b.ingest(laney)
+    h_lane = agg3b.node_health("N1")
+    if h_lane is None or not (0.5 < h_lane < 1.0):
+        problems.append(f"one sick lane health {h_lane}: expected a "
+                        f"light ding, not full-plane or healthy")
+    text = render(agg3b, [])
+    if "SICK CHIPS" not in text or "N1:lane2=open" not in text:
+        problems.append("console did not name the sick chip lane")
+    agg3b.ingest(healthy("N1", 1, 1.0))
+    if agg3b.node_health("N1") != 1.0:
+        problems.append("lane health did not recover after re-admission")
 
     # 4) hot shard: skewed ordered rates flag shard 0
     agg4 = FleetAggregator(config=config)

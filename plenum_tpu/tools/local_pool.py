@@ -120,15 +120,24 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1,
         # blanking the run — the bench line then reports backend_state
         from plenum_tpu.parallel.supervisor import supervise
         if config.CRYPTO_PIPELINE:
-            from plenum_tpu.parallel.pipeline import CryptoPipeline
-            # the pipeline owns the shape policy: its pinned bucket
-            # ladder covers the coalesced steady state
-            pipeline = CryptoPipeline(
-                ed_inner=supervise(JaxEd25519Verifier(min_batch=1)),
-                config=config.replace(PIPELINE_MAX_BUCKET=max(
-                    bucket, config.PIPELINE_MAX_BUCKET)),
-                sha_device=True,
-                sha_min_device=config.PIPELINE_SHA_MIN_BATCH)
+            pipe_config = config.replace(PIPELINE_MAX_BUCKET=max(
+                bucket, config.PIPELINE_MAX_BUCKET))
+            if config.PIPELINE_DEVICES != 1:
+                # multi-chip scale-out: one breakable lane per local
+                # device, each with its own supervised pinned verifier
+                from plenum_tpu.parallel.pipeline import \
+                    make_multidevice_pipeline
+                pipeline = make_multidevice_pipeline(
+                    pipe_config, config.PIPELINE_DEVICES, min_batch=1)
+            else:
+                from plenum_tpu.parallel.pipeline import CryptoPipeline
+                # the pipeline owns the shape policy: its pinned bucket
+                # ladder covers the coalesced steady state
+                pipeline = CryptoPipeline(
+                    ed_inner=supervise(JaxEd25519Verifier(min_batch=1)),
+                    config=pipe_config,
+                    sha_device=True,
+                    sha_min_device=config.PIPELINE_SHA_MIN_BATCH)
             plane = pipeline.verifier()
         else:
             plane = CoalescingVerifier(supervise(

@@ -343,6 +343,22 @@ def derive_summary(folds: dict[str, dict], span_s: float,
                     "pipeline_ctl.bucket_floor", {}).get("last") or 0),
                 "decisions": int(cum("pipeline_ctl.decisions") or 0),
             }
+        # multi-device ring (docs/performance.md "Multi-device crypto
+        # pipeline"): lane count, how many chip breakers are open RIGHT
+        # NOW, worst lane backlog, and the dispatch spread (max/mean
+        # per-lane dispatches — 1.0 = perfectly even placement; a
+        # rising spread means traffic is queueing on one chip)
+        lanes = folds.get("pipeline_dev.lanes", {})
+        if lanes.get("last"):
+            section["devices"] = {
+                "lanes": int(lanes["last"]),
+                "breakers_open": int(folds.get(
+                    "pipeline_dev.breakers_open", {}).get("last") or 0),
+                "occupancy_max": folds.get(
+                    "pipeline_dev.occupancy_max", {}).get("max"),
+                "dispatch_spread": folds.get(
+                    "pipeline_dev.dispatch_spread", {}).get("last"),
+            }
         out["crypto_pipeline"] = {k: v for k, v in section.items()
                                   if v is not None}
     # closed-loop batch controller (docs/performance.md "Pipelined

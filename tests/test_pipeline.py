@@ -421,3 +421,182 @@ def test_make_crypto_pipeline_constructs_for_device_backends():
     from plenum_tpu.parallel.supervisor import find_supervisor
     assert find_supervisor(pipe.verifier()) is not None, \
         "pipeline verifier chain hides the supervisor from node wiring"
+
+
+# --- multi-device lanes (ISSUE 14 tentpole) --------------------------------
+
+def _multi_pipe(n_lanes=4, **over):
+    from plenum_tpu.parallel.pipeline import MultiDeviceCryptoPipeline
+    inners = [FakeDeviceVerifier() for _ in range(n_lanes)]
+    pipe = MultiDeviceCryptoPipeline(ed_inners=inners,
+                                     config=_fast_config(**over),
+                                     threaded=False)
+    return pipe, inners
+
+
+def test_multidevice_placement_and_dispatch_spread():
+    """Co-hosted shard tags pin to distinct chips (tag % lanes); the
+    unhinted path spreads waves over every healthy lane; per-lane
+    dispatch counts and device_state tell the story."""
+    rng = random.Random(53)
+    pipe, inners = _multi_pipe(4)
+    assert [pipe.place(t) for t in range(6)] == [0, 1, 2, 3, 0, 1]
+    # hinted: lane 2 gets the wave, nobody else
+    tok = pipe.submit_verify(_junk_items(rng, 8), lane=2)
+    out = pipe.collect_verify(tok, wait=True)
+    assert out is not None and len(out) == 8
+    assert pipe.lanes[2].stats["dispatches"] == 1
+    assert all(pipe.lanes[k].stats["dispatches"] == 0 for k in (0, 1, 3))
+    # unhinted: waves spread across all lanes
+    toks = [pipe.submit_verify(_junk_items(rng, 8)) for _ in range(8)]
+    for t in toks:
+        assert pipe.collect_verify(t, wait=True) is not None
+    spread = [l.stats["dispatches"] for l in pipe.lanes]
+    assert all(d >= 1 for d in spread), spread
+    state = pipe.device_state()
+    assert [d["lane"] for d in state] == [0, 1, 2, 3]
+    assert sum(d["dispatches"] for d in state) == sum(spread)
+    assert pipe.summary()["lanes"] == 4
+
+
+def test_multidevice_per_lane_prewarm_pin_enforcement():
+    """prewarm compiles each chip's OWN ladder; after pin() every lane
+    enforces ITS compiled shapes (pad up / split), so steady state never
+    recompiles on ANY chip — the per-lane twin of the PR 8 guard."""
+    rng = random.Random(59)
+    pipe, inners = _multi_pipe(3)
+    assert pipe.prewarm([16, 32]) == [16, 32]
+    for inner in inners:
+        assert set(inner.shapes) == {16, 32}
+    warm = pipe.compiled_shapes
+    pipe.pin()
+    for _ in range(60):
+        tok = pipe.submit_verify(_junk_items(rng, rng.randint(1, 60)),
+                                 lane=rng.randint(0, 5))
+        assert pipe.collect_verify(tok, wait=True) is not None
+    assert pipe.compiled_shapes == warm, \
+        "steady state met a novel dispatch shape on some lane"
+    assert pipe.stats["unpinned_shapes"] == 0
+    for inner in inners:
+        assert set(inner.shapes) <= {16, 32}
+
+
+def test_multidevice_one_lane_breaker_isolation():
+    """A wedged chip opens THAT lane's breaker only: its pinned waves
+    degrade to host fallback, the other lanes keep dispatching to their
+    devices, and unhinted traffic routes around the sick chip."""
+    from plenum_tpu.parallel.faults import FaultyVerifier
+    from plenum_tpu.parallel.pipeline import MultiDeviceCryptoPipeline
+    from plenum_tpu.parallel.supervisor import (CLOSED, CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    faulties, sups = [], []
+    for k in range(3):
+        f = FaultyVerifier(CpuEd25519Verifier(), device_index=k)
+        s = SupervisedVerifier(
+            f, fallback=CpuEd25519Verifier(),
+            breaker=CircuitBreaker(fail_threshold=1, cooldown=60.0),
+            budget=DeadlineBudget(base=0.2, min_s=0.1, warm_max=0.3,
+                                  cold_max=0.3),
+            label=f"lane{k}")
+        faulties.append(f)
+        sups.append(s)
+    pipe = MultiDeviceCryptoPipeline(ed_inners=sups,
+                                     config=_fast_config(),
+                                     threaded=False)
+    signer = Ed25519Signer(seed=b"lane-iso".ljust(32, b"\0"))
+    mk = lambda tag, n=3: [
+        (b"%s-%d" % (tag, i), signer.sign(b"%s-%d" % (tag, i)),
+         signer.verkey) for i in range(n)]
+    faulties[1].wedge()
+    got = pipe.collect_verify(pipe.submit_verify(mk(b"w"), lane=1),
+                              wait=True)
+    assert list(got) == [True] * 3          # host fallback, correct
+    assert sups[1].breaker.state != CLOSED
+    assert sups[0].breaker.state == CLOSED
+    assert sups[2].breaker.state == CLOSED
+    # unhinted traffic avoids the open lane entirely
+    for i in range(4):
+        pipe.collect_verify(pipe.submit_verify(mk(b"u%d" % i)), wait=True)
+    assert pipe.lanes[1].stats["dispatches"] == 1   # only its pinned wave
+    assert (pipe.lanes[0].stats["dispatches"]
+            + pipe.lanes[2].stats["dispatches"]) >= 4
+    # telemetry story: device_state names the sick chip
+    state = {d["lane"]: d["breaker"] for d in pipe.device_state()}
+    assert state[1] == "open" and state[0] == "closed"
+
+
+def test_multidevice_threaded_lanes_concurrent_dispatch():
+    """Threaded lanes (the device-pinned production shape) resolve waves
+    from worker threads: N in-flight waves make progress without the
+    pump blocking on any one of them."""
+    import threading
+
+    class SlowDev(FakeDeviceVerifier):
+        def __init__(self):
+            super().__init__()
+            self.gate = threading.Event()
+
+        def submit_batch(self, items):
+            self.shapes.append(len(items))
+            return np.ones(len(items), dtype=bool)
+
+        def collect_batch(self, token, wait=True):
+            self.gate.wait(timeout=5.0)
+            return token
+
+    from plenum_tpu.parallel.pipeline import MultiDeviceCryptoPipeline
+    rng = random.Random(61)
+    inners = [SlowDev() for _ in range(3)]
+    pipe = MultiDeviceCryptoPipeline(ed_inners=inners,
+                                     config=_fast_config(),
+                                     threaded=True)
+    toks = [pipe.submit_verify(_junk_items(rng, 8), lane=k)
+            for k in range(3)]
+    pipe.service(force=True)
+    assert all(l.inflight is not None for l in pipe.lanes), \
+        "three waves must fly CONCURRENTLY, one per lane"
+    for inner in inners:
+        inner.gate.set()
+    for t in toks:
+        out = pipe.collect_verify(t, wait=True)
+        assert out is not None and len(out) == 8
+    pipe.close()
+
+
+def test_single_device_path_is_pr8_pipeline_exactly():
+    """n_devices == 1 pools pay NO sharding overhead: the construction
+    seam returns the PR 8 CryptoPipeline CLASS itself (no lane
+    indirection on the hot path), and per-op submit/collect cost stays
+    within noise of a directly-built PR 8 ring."""
+    from plenum_tpu.parallel.pipeline import MultiDeviceCryptoPipeline
+    p1 = make_crypto_pipeline(Config(PIPELINE_DEVICES=1), "jax")
+    assert type(p1) is CryptoPipeline, \
+        "single-device pool got the multi-device class"
+    assert not isinstance(p1, MultiDeviceCryptoPipeline)
+    # default config IS the single-device config
+    assert Config().PIPELINE_DEVICES == 1
+
+    def drive(pipe, n_ops=60):
+        rng = random.Random(67)
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            tok = pipe.submit_verify(_junk_items(rng, 8))
+            pipe.collect_verify(tok, wait=True)
+        return (time.perf_counter() - t0) / n_ops
+
+    baseline = CryptoPipeline(ed_inner=FakeDeviceVerifier(),
+                              config=_fast_config())
+    seamed = make_crypto_pipeline(
+        Config(PIPELINE_MIN_BUCKET=16, PIPELINE_MAX_BUCKET=64,
+               PIPELINE_FLUSH_WAIT=0.0, PIPELINE_DEVICES=1),
+        "jax", ed_inner=FakeDeviceVerifier())
+    drive(baseline, 10)                     # warm both paths
+    drive(seamed, 10)
+    per_base = drive(baseline)
+    per_seam = drive(seamed)
+    # same class, same code: anything past 3x is a real regression, not
+    # host noise (the loop is pure-python ring work, ~tens of us/op)
+    assert per_seam < per_base * 3 + 1e-3, \
+        f"single-device seam {per_seam * 1e6:.0f}us/op vs PR 8 " \
+        f"{per_base * 1e6:.0f}us/op"

@@ -491,6 +491,143 @@ def run_device_flap_with_pipeline(seed: int) -> None:
     assert_safety(pool)
 
 
+def run_device_flap_multidevice(seed: int) -> None:
+    """device_flap with a PER-DEVICE fault target: the pool's crypto
+    pipeline is sharded into 4 chip lanes (one supervised verifier +
+    breaker each), and the seed-derived FaultPlan names ONE device index
+    — every lane carries the same plan, but only the lane whose
+    `device_index` matches reads the fault windows. Mid-consensus the
+    targeted chip wedges; EXACTLY that lane's breaker may open (no
+    ring-wide breaker), every other lane's dispatch count keeps
+    advancing, aggregate ordering continues, and after the window ends
+    the lane re-warms and rejoins (fresh pinned waves hit its device
+    again)."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.faults import FaultPlan, FaultyVerifier
+    from plenum_tpu.parallel.pipeline import MultiDeviceCryptoPipeline
+    from plenum_tpu.parallel.supervisor import (CLOSED, CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    rng = SimRandom(seed * 48271 + 11)
+    n_lanes = 4
+    # ONE plan, device-targeted by the seed; a fixed window keeps the
+    # scenario's phases (healthy / faulted / healed) deterministic while
+    # the targeted chip and fault mode stay seed-driven
+    kind = ("wedge", "drop", "corrupt")[rng.integer(0, 2)]
+    plan = FaultPlan.from_seed(seed, n_devices=n_lanes, n_faults=0)
+    target = plan.device
+    assert target is not None and 0 <= target < n_lanes
+    # the window opens mid-consensus below (windows set then; an open
+    # end means the fault holds until the explicit heal)
+
+    faulties, sups = [], []
+    for k in range(n_lanes):
+        faulty = FaultyVerifier(CpuEd25519Verifier(), plan=plan,
+                                device_index=k)
+        sup = SupervisedVerifier(
+            faulty, fallback=CpuEd25519Verifier(),
+            breaker=CircuitBreaker(fail_threshold=2,
+                                   cooldown=rng.float(0.5, 1.5)),
+            budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
+                                  warm_max=1.0, cold_max=1.0),
+            label=f"lane{k}")
+        faulties.append(faulty)
+        sups.append(sup)
+    pipeline = MultiDeviceCryptoPipeline(
+        ed_inners=sups, config=Config(**FAST), threaded=False)
+    pool = _track(Pool(seed=seed, config=Config(**FAST),
+                       pipeline=pipeline))
+    for obj in (*sups, *faulties):
+        obj.set_clock(pool.timer.get_current_time)
+
+    users = [Ed25519Signer(seed=(b"mdflap%d-%d" % (seed, i))
+                           .ljust(32, b"\0")[:32]) for i in range(4)]
+    reqs = [signed_nym(pool.trustee, u, i + 1) for i, u in enumerate(users)]
+
+    def junk(tag: bytes, n: int = 3):
+        return [(b"%s-%d-%d" % (tag, seed, i), b"\x01" * 63 + b"\x00",
+                 bytes([i + 1]) * 32) for i in range(n)]
+
+    # pre-fault: every lane dispatches
+    pre = _order_and_time(pool, reqs[0], 2)
+    assert pre is not None, f"seed {seed}: healthy multi-lane pool stalled"
+    for k in range(n_lanes):
+        pipeline.verifier(lane=k).verify_batch(junk(b"pre%d" % k))
+    disp_pre = [l.stats["dispatches"] for l in pipeline.lanes]
+    assert all(d >= 1 for d in disp_pre), \
+        f"seed {seed}: lane never dispatched pre-fault: {disp_pre}"
+    assert all(s.breaker.state == CLOSED for s in sups)
+
+    # open the fault window MID-consensus: a request is in flight when
+    # the targeted chip starts failing (every lane carries this plan;
+    # only device_index == target reads the window)
+    pool.submit(reqs[1])
+    pool.run(rng.float(0.0, 0.3))
+    plan.windows = [(pool.timer.get_current_time(), 1e9, kind)]
+    pool.run(0.2)
+    # pinned traffic drives the targeted lane until ITS breaker opens
+    nudges = 0
+    while sups[target].breaker.state == CLOSED and nudges < 30:
+        nudges += 1
+        pool.run(0.2)
+        pipeline.verifier(lane=target).verify_batch(
+            junk(b"fault%d" % nudges))
+    assert sups[target].breaker.state != CLOSED, \
+        f"seed {seed}: targeted lane {target} breaker never opened " \
+        f"under {kind}"
+    # EXACTLY one lane degrades: no ring-wide breaker open
+    others = [k for k in range(n_lanes) if k != target]
+    for k in others:
+        assert sups[k].breaker.state == CLOSED, \
+            f"seed {seed}: lane {k} breaker opened for lane " \
+            f"{target}'s fault ({kind})"
+    # other lanes' dispatch counts keep advancing while lane k is down
+    before = [pipeline.lanes[k].stats["dispatches"] for k in others]
+    for k in others:
+        pipeline.verifier(lane=k).verify_batch(junk(b"during%d" % k))
+    after = [pipeline.lanes[k].stats["dispatches"] for k in others]
+    assert all(b > a for a, b in zip(before, after)), \
+        f"seed {seed}: healthy lanes stopped dispatching: " \
+        f"{before} -> {after}"
+    for k in others:
+        assert sups[k].stats["device_batches"] >= 1
+
+    # aggregate ordering continues above the single-lane floor: the
+    # pool keeps ordering within the healthy-ordering deadline even
+    # with one chip dark (its pinned waves ride host fallback)
+    during = _order_and_time(pool, reqs[2], 4)
+    assert during is not None, \
+        f"seed {seed}: pool stopped ordering with lane {target} dark"
+    st = sups[target].supervisor_stats()
+    assert st["fallback_batches"] >= 1, \
+        f"seed {seed}: no host fallback on the dark lane"
+    assert st["max_stall_s"] <= st["max_budget_s"] + 0.3
+
+    # heal: the targeted verifier recovers, traffic drives the probe ->
+    # re-warm -> re-admission of that ONE lane
+    faulties[target].heal()
+    waited = 0.0
+    while sups[target].breaker.state != CLOSED and waited < 30.0:
+        pool.run(1.0)
+        waited += 1.0
+        pipeline.verifier(lane=target).verify_batch(
+            junk(b"heal%f" % waited))
+    assert sups[target].breaker.state == CLOSED, \
+        f"seed {seed}: lane {target} never re-closed after heal ({kind})"
+    assert faulties[target].rewarms >= 1, \
+        "lane re-admission skipped the re-warm"
+    assert all(s.stats["verdict_forks"] == 0 for s in sups)
+
+    # the healed lane REJOINS: a fresh pinned wave hits its device
+    dev_before = sups[target].stats["device_batches"]
+    pipeline.verifier(lane=target).verify_batch(junk(b"rejoin"))
+    assert sups[target].stats["device_batches"] > dev_before, \
+        f"seed {seed}: healed lane {target} never re-admitted traffic"
+    post = _order_and_time(pool, reqs[3], 5)
+    assert post is not None, f"seed {seed}: pool dead after lane heal"
+    assert_safety(pool)
+
+
 def run_lying_reader_scenario(seed: int) -> None:
     """A Byzantine node forges read replies; the verifying read client
     must reject every forgery kind and fail over to an honest node
@@ -1020,6 +1157,20 @@ def test_sim_device_flap_pipeline_smoke():
     """One pipelined device_flap scenario always runs in the default
     suite: breaker -> CPU fallback -> re-warm re-admits the pipeline."""
     _run_with_artifacts(run_device_flap_with_pipeline, 1)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_device_flap_multidevice_fuzz(bucket):
+    for seed in range(bucket * 3, bucket * 3 + 3):
+        _run_with_artifacts(run_device_flap_multidevice, seed)
+
+
+def test_sim_device_flap_multidevice_smoke():
+    """One per-device device_flap scenario always runs in the default
+    suite: the seed-targeted chip's lane breaker opens ALONE, the other
+    lanes keep dispatching, and the lane re-warms and rejoins."""
+    _run_with_artifacts(run_device_flap_multidevice, 2)
 
 
 # 100 seeds, bucketed so failures show their seed range and xdist can split
