@@ -67,6 +67,9 @@ class MetricsName:
     COMMIT_APPLY_TIME = "commit_path.apply_time"
     COMMIT_DURABLE_TIME = "commit_path.durable_time"
     COMMIT_REPLY_TIME = "commit_path.reply_time"
+    # fused commit-wave drain (parallel/commit_wave.py): wall time of the
+    # two-phase triple-root wave per ordered batch (sampled -> p50/p95)
+    COMMIT_WAVE_TIME = "commit_path.commit_wave_time"
     # ordered batches riding ONE durable flush (group commit coalescing)
     GROUP_COMMIT_BATCHES = "node.group_commit_batches"
     # verified read plane (reads/plane.py): one event per tick's query
@@ -223,6 +226,13 @@ class MetricsName:
     PIPELINE_DEVICE_BREAKERS_OPEN = "pipeline_dev.breakers_open"
     PIPELINE_DEVICE_OCCUPANCY_MAX = "pipeline_dev.occupancy_max"
     PIPELINE_DEVICE_DISPATCH_SPREAD = "pipeline_dev.dispatch_spread"
+    # commit-wave lane (cumulative gauges off CryptoPipeline.stats):
+    # full triple-root drains, caller items, per-level dispatches, and
+    # levels a wedged engine degraded to the host recommit path
+    PIPELINE_CMT_WAVES = "pipeline_cmt.waves"
+    PIPELINE_CMT_ITEMS = "pipeline_cmt.items"
+    PIPELINE_CMT_LEVELS = "pipeline_cmt.levels"
+    PIPELINE_CMT_HOST_FALLBACKS = "pipeline_cmt.host_fallbacks"
     # transport
     NODE_MSGS_IN = "transport.node_msgs_in"
     NODE_FRAMES_OUT = "transport.node_frames_out"
@@ -338,6 +348,7 @@ def sample_process_gauges(collector: "MetricsCollector") -> None:
 # batches, and SAMPLE_CAP per flush keeps rows small.
 SAMPLED_NAMES = frozenset({
     MetricsName.COMMIT_BLS_VERIFY_TIME, MetricsName.COMMIT_APPLY_TIME,
+    MetricsName.COMMIT_WAVE_TIME,
     MetricsName.COMMIT_DURABLE_TIME, MetricsName.COMMIT_REPLY_TIME,
     MetricsName.BLS_PAIRINGS_PER_BATCH,
     MetricsName.CRYPTO_DISPATCH_BUDGET,

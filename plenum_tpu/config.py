@@ -359,6 +359,13 @@ class Config:
     # only for lanes pinned to a real device); False forces inline
     # dispatch (deterministic sims/fuzz).
     PIPELINE_LANE_THREADS: Optional[bool] = None
+    # fused commit wave (parallel/commit_wave.py): the ordered path
+    # drains state-apply + triple-root recommit as level-synchronized
+    # KIND_CMT dispatches whenever a pipeline is wired onto the
+    # DatabaseManager. False keeps every root producer on its inline
+    # host path (byte-identical roots either way — the flag is a
+    # perf/debug switch, never a consensus-visible one).
+    COMMIT_WAVE: bool = True
 
     # --- state commitment seam (state/commitment/) ---
     # scheme every ledger's state uses: 'mpt' (default; wire format

@@ -28,6 +28,10 @@ class DatabaseManager:
         self._ledgers: dict[int, Ledger] = {}
         self._states: dict[int, Optional[PruningState]] = {}
         self._stores: dict[str, object] = {}
+        # crypto pipeline this node's commit drain rides (set by the
+        # bootstrap when one exists): the write manager builds its fused
+        # commit wave on it; None keeps every root producer inline
+        self.pipeline = None
 
     # --- ledgers / states -------------------------------------------------
 

@@ -14,7 +14,10 @@ re-ordering after a view change (SURVEY.md §7 hard part 4).
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, NamedTuple, Optional, Sequence
+
+from plenum_tpu.common.metrics import MetricsName
 
 from plenum_tpu.common.node_messages import (AUDIT_LEDGER_ID,
                                              CONFIG_LEDGER_ID,
@@ -68,6 +71,8 @@ class WriteRequestManager:
         self._node_reg_provider = node_reg_provider or (lambda: [])
         self._taa_window = taa_acceptance_window
         self.on_batch_committed: list[Callable[[ThreePcBatch, list[dict]], None]] = []
+        # node wiring (node/node.py): commit_wave_time samples land here
+        self.metrics = None
 
     # --- registry ---------------------------------------------------------
     #
@@ -234,7 +239,30 @@ class WriteRequestManager:
             # raises instead of silently forking the ledger
             txns.append(canonicalize(txn))
             valid.append(req)
-        ledger.append_txns_to_uncommitted(txns)
+
+        # fused commit wave (parallel/commit_wave.py): resolve every
+        # state head + the batch ledger's append as ONE level-synchronized
+        # cmt dispatch cadence instead of per-tree inline hashing. Two
+        # phases because the audit txn can only be BUILT from the roots
+        # phase A mints; phase B drains the audit append on the same
+        # wave. Any failure degrades to the lazy host properties below,
+        # which recompute the identical roots (byte-identity is the
+        # golden-vector contract, so the degrade can never fork state).
+        wave = self._commit_wave()
+        t_wave = time.perf_counter() if wave is not None else None
+        if wave is None:
+            ledger.append_txns_to_uncommitted(txns)
+        else:
+            ledger.append_txns_to_uncommitted(txns, defer_hash=True)
+            try:
+                for lid in self.db.ledger_ids:
+                    st = self.db.get_state(lid)
+                    if st is not None and hasattr(st, "recommit_staged"):
+                        wave.add("state:%d" % lid, st.recommit_staged())
+                wave.add("txn", ledger.uncommitted_root_staged())
+                wave.run()
+            except Exception:
+                wave = None
 
         audit_ledger = self.db.get_ledger(AUDIT_LEDGER_ID)
         if audit_ledger is not None:
@@ -245,19 +273,54 @@ class WriteRequestManager:
                 else self._resolve_primaries(view_no),
                 self._node_reg_provider(), last)
             txn_lib.set_seq_no(audit_txn, audit_ledger.uncommitted_size + 1)
-            audit_ledger.append_txns_to_uncommitted([canonicalize(audit_txn)])
+            audit_row = [canonicalize(audit_txn)]
+            if wave is None:
+                audit_ledger.append_txns_to_uncommitted(audit_row)
+            else:
+                audit_ledger.append_txns_to_uncommitted(audit_row,
+                                                        defer_hash=True)
+                try:
+                    wave.add("audit",
+                             audit_ledger.uncommitted_root_staged())
+                    wave.run()
+                except Exception:
+                    wave = None
 
         self._batches.append(_Undo(ledger_id, len(txns), prev_roots, pp_seq_no))
         pool_state = self.db.get_state(POOL_LEDGER_ID)
+        wroots = wave.roots if wave is not None else {}
+
+        def _st_root(lid, st):
+            got = wroots.get("state:%d" % lid)
+            return got if got is not None else st.head_hash
+
         roots = {
-            "state_root": (state.head_hash.hex() if state is not None else ""),
-            "txn_root": ledger.uncommitted_root_hash.hex(),
-            "pool_state_root": (pool_state.head_hash.hex()
+            "state_root": (_st_root(ledger_id, state).hex()
+                           if state is not None else ""),
+            "txn_root": (wroots.get("txn")
+                         or ledger.uncommitted_root_hash).hex(),
+            "pool_state_root": (_st_root(POOL_LEDGER_ID, pool_state).hex()
                                 if pool_state is not None else ""),
-            "audit_txn_root": (audit_ledger.uncommitted_root_hash.hex()
+            "audit_txn_root": ((wroots.get("audit")
+                                or audit_ledger.uncommitted_root_hash).hex()
                                if audit_ledger is not None else ""),
         }
+        if t_wave is not None and self.metrics is not None:
+            self.metrics.add_event(MetricsName.COMMIT_WAVE_TIME,
+                                   time.perf_counter() - t_wave)
         return valid, rejected, roots
+
+    def _commit_wave(self):
+        """A CommitWave for this drain, or None when the fused path is
+        off — no pipeline wired onto the DatabaseManager, or the
+        COMMIT_WAVE flag disabled on the pipeline's config."""
+        pipe = getattr(self.db, "pipeline", None)
+        if pipe is None or not hasattr(pipe, "submit_commitment"):
+            return None
+        if not getattr(getattr(pipe, "config", None), "COMMIT_WAVE", True):
+            return None
+        from plenum_tpu.parallel.commit_wave import CommitWave
+        return CommitWave(pipe)
 
     def _resolve_primaries(self, view_no: int) -> list:
         """Primaries the audit txn must snapshot for a batch ORIGINATING in

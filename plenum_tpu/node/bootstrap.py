@@ -182,6 +182,9 @@ class NodeBootstrap:
 
     def build(self) -> NodeComponents:
         db = DatabaseManager()
+        # the commit drain's fused wave seam (execution/write_manager.py
+        # `_commit_wave`): same pipeline the states commit through
+        db.pipeline = self.pipeline
         # catchup order: audit, pool, config, domain (ref node.py:142)
         db.register_ledger(AUDIT_LEDGER_ID, self._ledger(AUDIT_LEDGER_ID, "audit"))
         db.register_ledger(POOL_LEDGER_ID, self._ledger(POOL_LEDGER_ID, "pool"),

@@ -197,6 +197,9 @@ class Node:
             components.pipeline.set_clock(timer.get_current_time)
             if self.tracer.enabled:
                 components.pipeline.tracer = self.tracer
+        # commit-wave stage timer (execution/write_manager.py): the
+        # drain's wave duration feeds commit_wave_ms_p50/p95
+        components.write_manager.metrics = self.metrics
 
         self.pool_manager = components.pool_manager
         self.pool_manager._on_changed = self._on_pool_changed

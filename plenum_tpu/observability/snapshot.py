@@ -74,6 +74,7 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
     "commit_path": frozenset({
         MetricsName.COMMIT_BLS_VERIFY_TIME, MetricsName.COMMIT_APPLY_TIME,
         MetricsName.COMMIT_DURABLE_TIME, MetricsName.COMMIT_REPLY_TIME,
+        MetricsName.COMMIT_WAVE_TIME,
     }),
     "crypto": frozenset({
         MetricsName.SIG_BATCH_SIZE, MetricsName.SIG_BATCH_TIME,
@@ -103,6 +104,9 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
         MetricsName.PIPELINE_DEVICE_BREAKERS_OPEN,
         MetricsName.PIPELINE_DEVICE_OCCUPANCY_MAX,
         MetricsName.PIPELINE_DEVICE_DISPATCH_SPREAD,
+        MetricsName.PIPELINE_CMT_WAVES, MetricsName.PIPELINE_CMT_ITEMS,
+        MetricsName.PIPELINE_CMT_LEVELS,
+        MetricsName.PIPELINE_CMT_HOST_FALLBACKS,
     }),
     "reads": frozenset({
         MetricsName.READ_QUERIES, MetricsName.READ_PROOF_GEN_TIME,

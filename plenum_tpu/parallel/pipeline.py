@@ -357,6 +357,11 @@ class CryptoPipeline:
             "bls_batches": 0, "bls_items": 0, "bls_unique": 0,
             "sha_batches": 0, "sha_items": 0, "sha_unique": 0,
             "cmt_batches": 0, "cmt_items": 0, "cmt_unique": 0,
+            # commit-wave figures (parallel/commit_wave.py drives these):
+            # waves = full triple-root drains, levels = per-level cmt
+            # dispatches inside them, host_fallbacks = levels a wedged
+            # engine degraded to the host recommit path
+            "cmt_waves": 0, "cmt_levels": 0, "cmt_host_fallbacks": 0,
             "unpinned_shapes": 0,
         }
 
@@ -402,6 +407,12 @@ class CryptoPipeline:
         shapes = self._shapes if shapes is None else shapes
         return sorted({k[1] for k in shapes if k[0] == KIND_ED})
 
+    def _cmt_buckets(self, shapes: Optional[set] = None) -> list[int]:
+        """Pad buckets with at least one compiled commitment shape —
+        the cmt lane's pin ladder, enforced by `_cmt_plan` after pin()."""
+        shapes = self._shapes if shapes is None else shapes
+        return sorted({k[1] for k in shapes if k[0] == KIND_CMT})
+
     def _key_cap(self, shapes: Optional[set] = None) -> int:
         """Largest compiled key-table; waves packed past it would force a
         novel (bucket, full-key-table) shape."""
@@ -428,6 +439,30 @@ class CryptoPipeline:
             items = [(b"pipeline-prewarm", b"\x00" * 64, b"\x00" * 32)] * b
             tok = self._ed_inner.submit_batch(items)
             self._ed_inner.collect_batch(tok, wait=True)
+            warmed.append(b)
+        return warmed
+
+    def prewarm_cmt(self, buckets: Sequence[int]) -> list[int]:
+        """Compile the given cmt pad buckets NOW — the commit-wave
+        counterpart of `prewarm()`. With a device engine each bucket runs
+        one all-pad wave (a failure raises, like the multi-device ed
+        prewarm: a lane that cannot compile must fail loudly in warmup,
+        not degrade silently under load); with the host engine there is
+        nothing to compile, so the shapes are just noted onto the ladder
+        `_cmt_plan` enforces after pin(). Returns the buckets warmed."""
+        warmed = []
+        for b in sorted(set(buckets)):
+            if b < 1 or b & (b - 1):
+                raise ValueError(f"cmt prewarm bucket {b} is not a "
+                                 f"power of two")
+            if self._cmt_inner is not None:
+                wave = [self._CMT_PAD_JOB] * b
+                res = list(self._cmt_inner.run_jobs(wave))
+                if len(res) != b:
+                    raise RuntimeError(
+                        f"cmt prewarm wave of {b} returned "
+                        f"{len(res)} results")
+            self.note_shape((KIND_CMT, b))
             warmed.append(b)
         return warmed
 
@@ -935,6 +970,12 @@ class CryptoPipeline:
         """jobs (hashable content, produced by the Verkle backend):
           ("commit", width, ((slot, scalar), ...))        -> (f_tau, c_enc)
           ("multiproof", ((c_enc, f_tau, z, y), ...))     -> (d_enc, pi_enc)
+          ("hlev", alg, (msg, ...))                       -> (digest, ...)
+        The "hlev" kind is ONE LEVEL of a commit wave (parallel/
+        commit_wave.py): every staged node encoding of one tree level,
+        hashed with the level's algorithm ("sha3" = MPT nodes, "sha256"
+        = ledger leaves) in a single job so co-hosted replicas staging
+        the same ordered batch dedup whole levels at once.
         Co-hosted nodes committing the SAME ordered batch to the same
         state stage IDENTICAL jobs — content dedup makes the recommit
         cost per wave one per distinct node vector, not one per replica
@@ -961,7 +1002,9 @@ class CryptoPipeline:
         out = []
         for job in jobs:
             try:
-                if job[0] == "commit":
+                if job[0] == "hlev":
+                    out.append(self._hash_level(job[1], job[2]))
+                elif job[0] == "commit":
                     out.append(kzg.engine_for(job[1])
                                .commit(dict(job[2])))
                 elif job[0] == "multiproof":
@@ -971,6 +1014,22 @@ class CryptoPipeline:
             except Exception:
                 out.append(None)
         return out
+
+    def _hash_level(self, alg: str, msgs: Sequence[bytes]) -> tuple:
+        """One "hlev" job: hash a whole tree level. sha256 levels ride
+        the device batch kernel past the same threshold as the sha lane;
+        sha3 (MPT node hashing) has no device kernel yet, so its win is
+        cross-replica dedup + one coalesced flush, computed on host."""
+        if alg == "sha256":
+            if self._sha_device and len(msgs) >= self._sha_min_device:
+                from plenum_tpu.ops.sha256 import n_blocks_for, sha256_batch
+                for m in msgs:
+                    self.note_shape((KIND_SHA, n_blocks_for(len(m))))
+                return tuple(sha256_batch(list(msgs)))
+            return tuple(hashlib.sha256(m).digest() for m in msgs)
+        if alg == "sha3":
+            return tuple(hashlib.sha3_256(m).digest() for m in msgs)
+        raise ValueError(f"unknown hlev algorithm {alg!r}")
 
     def _flush_cmt(self) -> bool:
         if not self._cmt_staged:
@@ -1004,27 +1063,13 @@ class CryptoPipeline:
             # same pinned-shape discipline as the ed lane: the wave is
             # PADDED to the pow2 bucket the guard records, so what a
             # device MSM engine behind cmt_inner compiles is exactly the
-            # noted shape (a noted-but-unpadded bucket would let ragged
-            # lengths recompile in steady state with unpinned_shapes=0)
-            bucket = 1
-            while bucket < len(todo):
-                bucket *= 2
-            self.note_shape((KIND_CMT, bucket))
-            engine = self._cmt_inner
-            if engine is None:
-                # host engine: no compiled shapes, so no pad lanes
-                results = self._cmt_run(todo)
-            else:
-                wave = todo + [self._CMT_PAD_JOB] * (bucket - len(todo))
-                try:
-                    results = list(engine.run_jobs(wave))[:len(todo)]
-                    if len(results) != len(todo):
-                        raise ValueError("engine returned a short wave")
-                except Exception:
-                    # breaker-style degrade: re-run on the default host
-                    # engine (per-job isolated — a still-failing job is
-                    # None and its submitter's inline path recomputes)
-                    results = self._cmt_run(todo)
+            # noted shape — and after pin() the ladder is ENFORCED:
+            # `_cmt_plan` pads up to the smallest compiled bucket that
+            # fits or splits at the largest, so a novel mid-run cmt
+            # shape costs a pad/split, never a fresh XLA compile
+            for chunk, bucket in self._cmt_plan(todo):
+                self.note_shape((KIND_CMT, bucket))
+                results.extend(self._cmt_dispatch(chunk, bucket))
             by_key = dict(zip(unique.keys(), results))
             for key, res in by_key.items():
                 if res is not None:
@@ -1036,6 +1081,57 @@ class CryptoPipeline:
             tok.results = [e[1] if e[0] == "k" else by_key.get(e[1])
                            for e in tok.plan]
         return True
+
+    def _cmt_plan(self, todo: list) -> list:
+        """(chunk, bucket) dispatch plan for one cmt flush. During warmup
+        a wave pads to the next pow2 and the guard OBSERVES the shape;
+        after pin() the compiled ladder is ENFORCED — pad up to the
+        smallest compiled bucket that fits, or split at the largest and
+        pad the tail — so steady state never dispatches a novel shape."""
+        bucket = 1
+        while bucket < len(todo):
+            bucket *= 2
+        ladder = self._cmt_buckets() if self.pinned else []
+        if not ladder:
+            return [(todo, bucket)]
+        cap, plan, i = ladder[-1], [], 0
+        while len(todo) - i > cap:
+            plan.append((todo[i:i + cap], cap))
+            i += cap
+        tail = todo[i:]
+        plan.append((tail, next(b for b in ladder if b >= len(tail))))
+        return plan
+
+    def _cmt_dispatch(self, chunk: list, bucket: int) -> list:
+        """One cmt wave. "hlev" levels always run `_cmt_run` (hashing
+        has no MSM engine; sha256 levels ride the device sha kernel
+        inside it); commit/multiproof jobs go through the injected
+        engine when present, padded to the bucket, degrading to the
+        default host engine on failure — breaker-style, per-job
+        isolated: a still-failing job resolves to None and its
+        submitter's inline path recomputes."""
+        engine = self._cmt_inner
+        results: list = [None] * len(chunk)
+        eng_idx = ([] if engine is None
+                   else [i for i, j in enumerate(chunk) if j[0] != "hlev"])
+        host_idx = sorted(set(range(len(chunk))) - set(eng_idx))
+        if host_idx:
+            for i, res in zip(host_idx,
+                              self._cmt_run([chunk[i] for i in host_idx])):
+                results[i] = res
+        if eng_idx:
+            jobs = [chunk[i] for i in eng_idx]
+            wave = jobs + [self._CMT_PAD_JOB] * (bucket - len(jobs))
+            try:
+                done = list(engine.run_jobs(wave))[:len(jobs)]
+                if len(done) != len(jobs):
+                    raise ValueError("engine returned a short wave")
+            except Exception:
+                self.stats["cmt_host_fallbacks"] += 1
+                done = self._cmt_run(jobs)
+            for i, res in zip(eng_idx, done):
+                results[i] = res
+        return results
 
     def collect_commitment(self, token: _SyncToken, wait: bool = True):
         if token.results is None:
@@ -1075,6 +1171,18 @@ class CryptoPipeline:
             metrics.add_event(
                 MetricsName.PIPELINE_BUCKET_HIT_RATE,
                 self.stats["bucket_hits"] / self.stats["dispatches"])
+        if self.stats["cmt_waves"]:
+            # commit-wave lane (cumulative gauges, like the rest): only
+            # emitted once the ordered path actually drains waves, so a
+            # pipeline that never runs commit waves stays silent
+            metrics.add_event(MetricsName.PIPELINE_CMT_WAVES,
+                              self.stats["cmt_waves"])
+            metrics.add_event(MetricsName.PIPELINE_CMT_ITEMS,
+                              self.stats["cmt_items"])
+            metrics.add_event(MetricsName.PIPELINE_CMT_LEVELS,
+                              self.stats["cmt_levels"])
+            metrics.add_event(MetricsName.PIPELINE_CMT_HOST_FALLBACKS,
+                              self.stats["cmt_host_fallbacks"])
 
     def summary(self) -> dict:
         d = self.stats["dispatches"]
@@ -1098,7 +1206,8 @@ class CryptoPipeline:
             "sha": {k: self.stats[f"sha_{k}"]
                     for k in ("batches", "items", "unique")},
             "cmt": {k: self.stats[f"cmt_{k}"]
-                    for k in ("batches", "items", "unique")},
+                    for k in ("batches", "items", "unique", "waves",
+                              "levels", "host_fallbacks")},
         }
         if self.controller is not None:
             out["controller"] = self.controller.trajectory()

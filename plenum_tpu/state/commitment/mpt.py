@@ -56,7 +56,7 @@ if not hasattr(PruningState, "batch_open"):
 
 
 def _factory(db=None, width=None, pipeline=None):
-    return PruningState(db)
+    return PruningState(db, pipeline=pipeline)
 
 
 _factory._cls = PruningState

@@ -388,6 +388,34 @@ class VerkleState(StateCommitment):
                 pass                      # inline fallback below
         return [self._engine.commit(e) for e in jobs]
 
+    def recommit_staged(self):
+        """Commit-wave family (parallel/commit_wave.py): the staged
+        twin of `head_hash` — yields one list of ("commit", width,
+        evals) cmt jobs per dirty level (deepest first), receives the
+        aligned (f_tau, c_enc) results back, and returns the persisted
+        root anchor via StopIteration.value. A per-job None result
+        falls back to the inline engine commit, the same degrade
+        contract as `_commit_batch`. Byte-identical to `head_hash`
+        (golden-vector pinned): same scalar derivation, same per-level
+        order, same persist walk."""
+        if not self._root.children:
+            return self.blank_root
+        if self._root.c_enc is None:
+            by_level: dict[int, list] = {}
+            self._collect_dirty(self._root, 0, by_level)
+            for level in sorted(by_level, reverse=True):
+                nodes = by_level[level]
+                jobs = [self._evals_of(node) for node in nodes]
+                results = yield [("commit", self.width,
+                                  tuple(sorted(e.items())))
+                                 for e in jobs]
+                for node, evals, res in zip(nodes, jobs, results):
+                    if res is None:
+                        res = self._engine.commit(evals)
+                    node.f_tau, node.c_enc = res
+                    self.stats["recommitted_nodes"] += 1
+        return self._persist_tree(self._root)
+
     def _persist_tree(self, node: _VNode) -> bytes:
         """Persist post-order, demoting each persisted child to a
         ("ref", anchor) entry: without the demotion every materialized

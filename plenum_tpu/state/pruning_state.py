@@ -20,7 +20,8 @@ from .trie import Trie, BLANK_ROOT
 
 
 class PruningState:
-    def __init__(self, db: Optional[KeyValueStorage] = None):
+    def __init__(self, db: Optional[KeyValueStorage] = None,
+                 pipeline=None):
         self._db = db if db is not None else KvMemory()
         root = self._db.try_get(b"__committed_head__") or BLANK_ROOT
         # one decoded-node cache shared by the head trie AND every
@@ -29,6 +30,10 @@ class PruningState:
         self._node_cache: dict = {}
         self._trie = Trie(self._db, root, cache=self._node_cache)
         self._committed_root = root
+        # commit-wave seam (parity with the Verkle backend's signature):
+        # MPT recommits need no MSM engine, only the pipeline's "hlev"
+        # hashing lane driven through `recommit_staged`
+        self._pipeline = pipeline
 
     @property
     def kv(self) -> KeyValueStorage:
@@ -70,6 +75,22 @@ class PruningState:
     @property
     def committed_head_hash(self) -> bytes:
         return self._committed_root
+
+    def recommit_staged(self):
+        """Commit-wave family (parallel/commit_wave.py): resolve the
+        uncommitted head by staging one ("hlev", "sha3", <level>) cmt
+        job per dirty trie level instead of hashing inline — yields
+        lists of cmt jobs, receives the aligned result lists back, and
+        returns the new head hash via StopIteration.value.
+        Byte-identical to `head_hash` (golden-vector pinned)."""
+        gen = self._trie.resolve_root_staged()
+        try:
+            msgs = next(gen)
+            while True:
+                res = yield [("hlev", "sha3", tuple(msgs))]
+                msgs = gen.send(list(res[0]))
+        except StopIteration as e:
+            return e.value
 
     def commit(self, root_hash: Optional[bytes] = None) -> None:
         """Promote the committed pointer to the given root (default: head).

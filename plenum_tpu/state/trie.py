@@ -99,6 +99,24 @@ def _substitute(lst, ref_of: dict) -> None:
             lst[i] = ref_of[id(x)]
 
 
+def _collect_dirty_by_height(lst, out: dict) -> int:
+    """Bucket the _Dirty tree by height — dirty-set leaves at 0 — and
+    return the height of `lst`'s own position. This is the level
+    structure the staged commit wave dispatches: everything in bucket h
+    references only buckets < h, so one hash wave per bucket (ascending)
+    resolves parents strictly after their children, exactly like the
+    post-order walk. DFS append order keeps each bucket deterministic,
+    so co-hosted replicas staging the same ordered batch emit
+    byte-identical level jobs (the cross-submitter dedup contract)."""
+    h = 0
+    for x in lst:
+        if type(x) is _Dirty:
+            ch = _collect_dirty_by_height(x.node, out)
+            out.setdefault(ch, []).append(x)
+            h = max(h, ch + 1)
+    return h
+
+
 class Trie:
     # hashed refs are content-addressed, so a decoded node can be cached
     # forever; the upper levels of the trie repeat on every key's path and
@@ -228,6 +246,46 @@ class Trie:
                     self._cache_put(h, x.node)
                     ref_of[id(x)] = h
         _substitute(root, ref_of)
+
+    def resolve_root_staged(self):
+        """Generator twin of `_resolve_dirty` + `root_hash` for the
+        fused commit wave (parallel/commit_wave.py): yields one list of
+        full sha3 preimages per trie LEVEL (deepest dirty bucket first),
+        receives the 32-byte digests back from the wave, and returns
+        the new root hash via StopIteration.value. Byte-identical to
+        the host path by construction — same RLP encodings, same
+        inline-vs-hash (<32 bytes) rule, same db writes/cache fills —
+        the property the golden drift vectors pin."""
+        root = self.root_node
+        if root == BLANK_NODE:
+            return BLANK_ROOT
+        by_height: dict[int, list[_Dirty]] = {}
+        if type(root) is list:
+            _collect_dirty_by_height(root, by_height)
+        ref_of: dict[int, object] = {}
+        for height in sorted(by_height):
+            level = by_height[height]
+            encs = []
+            for x in level:
+                _substitute(x.node, ref_of)
+                encs.append(rlp.encode(x.node))
+            to_hash = [(i, e) for i, e in enumerate(encs) if len(e) >= 32]
+            digests = (yield [e for _, e in to_hash]) if to_hash else []
+            for (i, enc), h in zip(to_hash, digests):
+                x = level[i]
+                self.db.put(h, enc)
+                self._cache_put(h, x.node)
+                ref_of[id(x)] = h
+            for i, enc in enumerate(encs):
+                if len(enc) < 32:
+                    ref_of[id(level[i])] = level[i].node
+        if type(root) is list:
+            _substitute(root, ref_of)
+        enc = rlp.encode(root)
+        digests = yield [enc]
+        h = digests[0]
+        self.db.put(h, enc)     # root is always persisted by hash
+        return h
 
     @root_hash.setter
     def root_hash(self, value: bytes) -> None:

@@ -1130,6 +1130,117 @@ def config14_multichip(seconds: float = 6.0,
     return {"error": (out.stderr or "no output").strip()[-300:]}
 
 
+def _ordered_path_ab_inproc(n_txns: int = 100, repeat: int = 3,
+                            n_devices: int = 4) -> dict:
+    """Fused-commit-wave vs host-recommit A/B on the FULL write path
+    (config16_ordered_path spawns it inside a forced-N-CPU-device
+    subprocess): the SAME 4-node NYM write load through
+
+      (a) fused — COMMIT_WAVE on: each ordered batch's triple-root
+          recommit (state head + ledger append + audit append) rides
+          the shared ring's cmt lane, level sweeps deduped across the
+          co-hosted replicas and flushed as pinned pow-2 waves;
+      (b) host  — COMMIT_WAVE off: every replica resolves every root
+          inline (per-node sha3/RLP and shadow-tree loops), the
+          pre-wave path.
+
+    WARMED and INTERLEAVED per the PR 6/PR 8 methodology, medians of
+    `repeat`. The figure is ordered-path TPS (client submit -> first
+    REPLY), NOT crypto items/s — VaultxGPU's per-phase attribution
+    point; the commit_stage percentiles (apply vs commit_wave) ride
+    along so the delta localizes to the recommit stage, and the pinned
+    ladder must close the run with 0 unpinned cmt shapes."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from plenum_tpu.tools.local_pool import run_load
+
+    arms = {"fused": {"COMMIT_WAVE": True},
+            "host": {"COMMIT_WAVE": False}}
+    base = {"PIPELINE_DEVICES": n_devices}
+    for ov in arms.values():             # cold pass: compiles + warmup
+        run_load(n_nodes=4, n_txns=30, backend="jax", timeout=180.0,
+                 config_overrides=dict(base, **ov))
+    runs: dict[str, list] = {k: [] for k in arms}
+    for _ in range(repeat):
+        for k, ov in arms.items():       # interleaved
+            runs[k].append(run_load(n_nodes=4, n_txns=n_txns,
+                                    backend="jax", timeout=240.0,
+                                    config_overrides=dict(base, **ov)))
+
+    def med(rs):
+        good = sorted((r for r in rs if r.get("txns_ordered")),
+                      key=lambda r: r["tps"])
+        return good[len(good) // 2] if good else None
+
+    fused, host = med(runs["fused"]), med(runs["host"])
+    out: dict = {"n_txns": n_txns, "repeat": repeat,
+                 "n_devices": n_devices}
+    if fused is not None:
+        out["fused_tps"] = fused["tps"]
+        out["fused_p50_ms"] = fused.get("p50_latency_ms")
+        ps = fused.get("pipeline") or {}
+        cmt = ps.get("cmt") or {}
+        out["commit_waves"] = cmt.get("waves")
+        out["commit_wave_levels"] = cmt.get("levels")
+        out["commit_wave_host_fallbacks"] = cmt.get("host_fallbacks")
+        out["fused_unpinned_shapes"] = ps.get("unpinned_shapes")
+        out["per_device_dispatches"] = {
+            "lane%d" % d["lane"]: d["dispatches"]
+            for d in ps.get("devices", [])}
+        stage = fused.get("commit_stage") or {}
+        out["fused_commit_wave_ms_p50"] = stage.get("commit_wave_ms_p50")
+        out["fused_apply_ms_p50"] = stage.get("apply_ms_p50")
+    if host is not None:
+        out["host_tps"] = host["tps"]
+        out["host_p50_ms"] = host.get("p50_latency_ms")
+        stage = host.get("commit_stage") or {}
+        out["host_apply_ms_p50"] = stage.get("apply_ms_p50")
+    if out.get("fused_tps") and out.get("host_tps"):
+        out["ordered_path_speedup"] = round(
+            out["fused_tps"] / out["host_tps"], 2)
+    return out
+
+
+def config16_ordered_path(n_txns: int = 100,
+                          timeout: float = 1800.0) -> dict:
+    """Ordered-path fused-vs-host recommit A/B on JAX-ON-CPU (4 forced
+    host devices, the multichip harness pattern), in a subprocess so
+    the bench process never reconfigures its own jax backend. Published
+    with `jax_source` provenance and the per-device dispatch counts —
+    the device-resident-ordering headline's measured stand-in (the TPU
+    runs the same wave code)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "flags = os.environ.get('XLA_FLAGS', '')\n"
+        "if 'xla_force_host_platform_device_count' not in flags:\n"
+        "    os.environ['XLA_FLAGS'] = (flags +"
+        " ' --xla_force_host_platform_device_count=4').strip()\n"
+        "import json\n"
+        "from plenum_tpu.tools.bench_configs import _ordered_path_ab_inproc\n"
+        f"print(json.dumps(_ordered_path_ab_inproc(n_txns={n_txns})))\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"error": "ordered-path A/B timed out"}
+    for line in reversed(out.stdout.strip().splitlines() or [""]):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            parsed["jax_source"] = "jax-on-cpu"
+            return parsed
+    return {"error": (out.stderr or "no output").strip()[-300:]}
+
+
 def config1b_distinct_signers(n_txns: int = 200,
                               timeout: float = 120.0) -> dict:
     """Diverse-client honesty datum: every write signed by a DIFFERENT
@@ -1550,7 +1661,8 @@ def main():
                      ("config10", config10_shards),
                      ("config11", config11_telemetry),
                      ("config12", config12_reshard),
-                     ("config13", config13_commitment)):
+                     ("config13", config13_commitment),
+                     ("config16", config16_ordered_path)):
         print(name, json.dumps(fn()), flush=True)
 
 

@@ -177,6 +177,7 @@ def commit_stage_stats(metrics) -> dict:
     out = {}
     for key, label in ((MetricsName.COMMIT_BLS_VERIFY_TIME, "bls_verify_ms"),
                        (MetricsName.COMMIT_APPLY_TIME, "apply_ms"),
+                       (MetricsName.COMMIT_WAVE_TIME, "commit_wave_ms"),
                        (MetricsName.COMMIT_DURABLE_TIME, "durable_ms"),
                        (MetricsName.COMMIT_REPLY_TIME, "reply_ms")):
         a = acc.get(key)
@@ -249,6 +250,10 @@ def run_load(n_nodes: int = 4, n_txns: int = 200, backend: str = "cpu",
     pipe = getattr(plane, "_pipeline", None) if plane is not None else None
     if pipe is not None:
         pipe.prewarm(pipe.buckets[:2])
+        # cmt ladder for the fused commit wave: level flushes across the
+        # co-hosted replicas dedup to small job counts, so a short pow-2
+        # ladder covers steady state (bigger levels split at the cap)
+        pipe.prewarm_cmt([1, 2, 4, 8])
         pipe.pin()
 
     n_txns = len(requests)

@@ -248,7 +248,7 @@ def derive_summary(folds: dict[str, dict], span_s: float,
     # the commit-path timers flush (bls-verify / apply / durable / reply) —
     # a latency regression must localize to a stage, not hide in a mean
     from plenum_tpu.common.metrics import percentile
-    for stage in ("bls_verify", "apply", "durable", "reply"):
+    for stage in ("bls_verify", "apply", "commit_wave", "durable", "reply"):
         f = folds.get(f"commit_path.{stage}_time", {})
         samples = f.get("samples")
         if samples:
@@ -358,6 +358,19 @@ def derive_summary(folds: dict[str, dict], span_s: float,
                     "pipeline_dev.occupancy_max", {}).get("max"),
                 "dispatch_spread": folds.get(
                     "pipeline_dev.dispatch_spread", {}).get("last"),
+            }
+        # commit-wave (cmt) lane (docs/performance.md "Device-resident
+        # ordering"): fused triple-root recommit waves, items and tree
+        # levels per run, and how many waves degraded to host recommit —
+        # a rising host_fallbacks is the commit-path breaker alarm
+        cw = folds.get("pipeline_cmt.waves", {})
+        if cw.get("max"):
+            section["commit_wave"] = {
+                "waves": int(cum("pipeline_cmt.waves") or 0),
+                "items": int(cum("pipeline_cmt.items") or 0),
+                "levels": int(cum("pipeline_cmt.levels") or 0),
+                "host_fallbacks": int(
+                    cum("pipeline_cmt.host_fallbacks") or 0),
             }
         out["crypto_pipeline"] = {k: v for k, v in section.items()
                                   if v is not None}

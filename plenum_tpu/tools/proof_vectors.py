@@ -36,6 +36,16 @@ FIXTURE_VALUES = [b"record-%04d" % i for i in range(24)]
 MISSING_KEY = b"did:sov:missing"
 PAGE = FIXTURE_KEYS[:7] + [MISSING_KEY]
 
+# second write-set for the RECOMMIT vectors: applied on top of the
+# committed fixture state, resolved once on the host path and once
+# through the fused commit wave — the two roots must be byte-identical
+# to each other AND to the checked-in vector, so a kernel or staging
+# change can never silently fork the state root
+RECOMMIT_KEYS = [b"did:sov:wave:%04d" % i for i in range(17)]
+RECOMMIT_VALUES = [b"wave-%04d" % i for i in range(17)]
+RECOMMIT_TXNS = [{"seq": i, "v": v.hex()}
+                 for i, v in enumerate(RECOMMIT_VALUES)]
+
 
 def _hex(b: bytes) -> str:
     return b.hex()
@@ -50,6 +60,52 @@ def _build_state(backend: str):
     return st
 
 
+def _wave_root(add_family) -> bytes:
+    """Resolve one staged family through a real CommitWave on a fresh
+    host-engine pipeline (the same trampoline the ordered path runs)."""
+    from plenum_tpu.parallel.commit_wave import CommitWave
+    from plenum_tpu.parallel.pipeline import CryptoPipeline
+    wave = CommitWave(CryptoPipeline())
+    add_family(wave)
+    return wave.run()["root"]
+
+
+def recommit_roots(backend: str) -> dict:
+    """{"host": hex, "fused": hex}: the second write-set's state root,
+    resolved inline vs through the commit wave."""
+    from plenum_tpu.state.commitment import make_state
+
+    def build():
+        st = make_state(backend)
+        for k, v in zip(FIXTURE_KEYS, FIXTURE_VALUES):
+            st.set(k, v)
+        st.commit(st.head_hash)
+        for k, v in zip(RECOMMIT_KEYS, RECOMMIT_VALUES):
+            st.set(k, v)
+        return st
+
+    host = build().head_hash
+    fused = _wave_root(lambda w: w.add("root", build().recommit_staged()))
+    return {"host": _hex(host), "fused": _hex(fused)}
+
+
+def ledger_recommit_roots() -> dict:
+    """{"host": hex, "fused": hex}: the staged-ledger shadow root, leaf
+    hashing inline vs deferred to the commit wave."""
+    from plenum_tpu.ledger.ledger import Ledger
+
+    def build(defer):
+        lg = Ledger()
+        lg.append_txns_to_uncommitted(list(RECOMMIT_TXNS),
+                                      defer_hash=defer)
+        return lg
+
+    host = build(False).uncommitted_root_hash
+    fused = _wave_root(
+        lambda w: w.add("root", build(True).uncommitted_root_staged()))
+    return {"host": _hex(host), "fused": _hex(fused)}
+
+
 def generate() -> dict:
     out: dict = {"version": 1, "keys": [_hex(k) for k in FIXTURE_KEYS],
                  "values": [_hex(v) for v in FIXTURE_VALUES],
@@ -61,12 +117,26 @@ def generate() -> dict:
         single = st.generate_state_proof(FIXTURE_KEYS[0], serialize=True)
         absent = st.generate_state_proof(MISSING_KEY, serialize=True)
         page = st.batch_open(PAGE)
+        rec = recommit_roots(backend)
+        if rec["fused"] != rec["host"]:
+            # NEVER write a forked vector: a fused/host divergence is
+            # the exact drift these vectors exist to catch
+            raise RuntimeError(
+                f"{backend}: fused recommit root {rec['fused']} != "
+                f"host root {rec['host']}")
         out["backends"][backend] = {
             "root": _hex(root),
             "single_proof": _hex(bytes(single)),
             "absence_proof": _hex(bytes(absent)),
             "page_proof": _hex(pack(page)),
+            "recommit_root": rec["host"],
         }
+    lrec = ledger_recommit_roots()
+    if lrec["fused"] != lrec["host"]:
+        raise RuntimeError(
+            f"ledger: fused recommit root {lrec['fused']} != "
+            f"host root {lrec['host']}")
+    out["ledger_recommit_root"] = lrec["host"]
     return out
 
 
@@ -86,7 +156,7 @@ def check_vectors(doc: dict) -> list[str]:
             problems.append(f"{backend}: missing from vector file")
             continue
         for field in ("root", "single_proof", "absence_proof",
-                      "page_proof"):
+                      "page_proof", "recommit_root"):
             if want.get(field) != got[field]:
                 problems.append(
                     f"{backend}.{field}: regenerated bytes differ from "
@@ -115,6 +185,11 @@ def check_vectors(doc: dict) -> list[str]:
         except Exception as e:
             problems.append(f"{backend}: verification raised "
                             f"{type(e).__name__}: {e}")
+    if doc.get("ledger_recommit_root") != fresh["ledger_recommit_root"]:
+        problems.append(
+            "ledger_recommit_root: regenerated root differs from the "
+            "checked-in vector — the staged ledger append no longer "
+            "matches the host shadow tree")
     return problems
 
 

@@ -600,3 +600,97 @@ def test_single_device_path_is_pr8_pipeline_exactly():
     assert per_seam < per_base * 3 + 1e-3, \
         f"single-device seam {per_seam * 1e6:.0f}us/op vs PR 8 " \
         f"{per_base * 1e6:.0f}us/op"
+
+
+# --- commit-wave (cmt) lane pin ladder ---------------------------------------
+
+class RecordingCmtEngine:
+    """Device-style commitment engine fake: records dispatched wave
+    sizes (the compiled-shape story is all these tests care about) and
+    answers each job with a distinct marker — commitment semantics are
+    covered by the state-commitment suite."""
+
+    def __init__(self):
+        self.shapes: list[int] = []
+
+    def run_jobs(self, jobs):
+        self.shapes.append(len(jobs))
+        return [("res", job) for job in jobs]
+
+
+def _cmt_jobs(tag, n):
+    """n unique well-formed commit jobs (content irrelevant: the fake
+    engine answers markers; uniqueness defeats the ring's dedup)."""
+    return [("commit", 16, ((i, tag * 1000 + i),)) for i in range(n)]
+
+
+def test_prewarm_cmt_compiles_ladder_and_rejects_non_pow2():
+    """prewarm_cmt runs one all-pad wave per bucket through the engine
+    (a lane that cannot compile must fail loudly in warmup, never
+    degrade silently under load) and notes the shapes onto the cmt pin
+    ladder; non-pow2 buckets are rejected before touching the device."""
+    eng = RecordingCmtEngine()
+    pipe = CryptoPipeline(cmt_inner=eng, config=_fast_config())
+    assert pipe.prewarm_cmt([8, 4]) == [4, 8]
+    assert eng.shapes == [4, 8]
+    assert pipe._cmt_buckets() == [4, 8]
+    with pytest.raises(ValueError):
+        pipe.prewarm_cmt([6])
+    # a short prewarm wave is a loud failure, not a silent degrade
+    class Short:
+        def run_jobs(self, jobs):
+            return []
+    with pytest.raises(RuntimeError):
+        CryptoPipeline(cmt_inner=Short(),
+                       config=_fast_config()).prewarm_cmt([4])
+    # engine-less (host) pipelines still note the enforcement ladder
+    host = CryptoPipeline(config=_fast_config())
+    assert host.prewarm_cmt([16]) == [16]
+    assert host._cmt_buckets() == [16]
+
+
+def test_pinned_cmt_novel_shape_pads_and_splits_not_recompiles():
+    """The cmt twin of the ed pin guard: after prewarm_cmt + pin(), a
+    novel mid-run cmt wave size pads up to the smallest compiled bucket
+    that fits or splits at the largest — never a fresh compile (the
+    same XLA retrace a novel ed shape costs on a device MSM engine)."""
+    eng = RecordingCmtEngine()
+    pipe = CryptoPipeline(cmt_inner=eng, config=_fast_config())
+    assert pipe.prewarm_cmt([4, 8]) == [4, 8]
+    warm = pipe.compiled_shapes
+    pipe.pin()
+    eng.shapes.clear()
+    # 5 unique jobs: pads up to bucket 8 (smallest compiled that fits)
+    jobs = _cmt_jobs(1, 5)
+    out = pipe.collect_commitment(pipe.submit_commitment(jobs))
+    assert out == [("res", j) for j in jobs]
+    assert eng.shapes == [8]
+    # 21 unique jobs: split 8 + 8 at the ladder cap, tail padded to 8
+    jobs = _cmt_jobs(2, 21)
+    out = pipe.collect_commitment(pipe.submit_commitment(jobs))
+    assert out == [("res", j) for j in jobs]
+    assert set(eng.shapes) == {8}
+    assert pipe.compiled_shapes == warm, \
+        "steady state met a novel cmt dispatch shape"
+    assert pipe.stats["unpinned_shapes"] == 0
+
+
+def test_cmt_hlev_levels_bypass_engine_but_ride_the_fused_flush():
+    """"hlev" hashing levels never reach the MSM engine (no engine
+    implements them): a mixed flush dispatches the commit jobs to the
+    engine at a pinned bucket while the hash level resolves in the same
+    wave — and the flush still lands on zero unpinned shapes."""
+    import hashlib
+    eng = RecordingCmtEngine()
+    pipe = CryptoPipeline(cmt_inner=eng, config=_fast_config())
+    pipe.prewarm_cmt([4])
+    pipe.pin()
+    eng.shapes.clear()
+    lev = ("hlev", "sha3", (b"node-a", b"node-b"))
+    jobs = _cmt_jobs(3, 2) + [lev]
+    out = pipe.collect_commitment(pipe.submit_commitment(jobs))
+    assert out[:2] == [("res", j) for j in jobs[:2]]
+    assert out[2] == tuple(hashlib.sha3_256(m).digest()
+                           for m in (b"node-a", b"node-b"))
+    assert eng.shapes == [4]          # 2 commit jobs padded to bucket 4
+    assert pipe.stats["unpinned_shapes"] == 0

@@ -37,6 +37,26 @@ def test_vectors_cover_both_backends(checked_in):
             assert vec.get(field), f"{backend}.{field} empty"
 
 
+def test_recommit_roots_fused_matches_host(checked_in):
+    """The commit-wave drift pin: the fused recommit root must equal the
+    host-resolved root AND the checked-in vector for both commitment
+    backends — a staging or kernel change that forks the state root
+    breaks here in tier-1, never silently on a running pool."""
+    for backend in ("mpt", "verkle"):
+        rec = pv.recommit_roots(backend)
+        assert rec["fused"] == rec["host"], \
+            f"{backend}: fused recommit root drifted from host"
+        assert rec["host"] == \
+            checked_in["backends"][backend]["recommit_root"], \
+            f"{backend}: recommit root drifted from the checked-in vector"
+
+
+def test_ledger_recommit_root_fused_matches_host(checked_in):
+    rec = pv.ledger_recommit_roots()
+    assert rec["fused"] == rec["host"]
+    assert rec["host"] == checked_in["ledger_recommit_root"]
+
+
 def test_tampered_vector_fails_closed(checked_in):
     """A flipped byte anywhere in a checked-in proof must verify False —
     the vectors double as a canonical tamper fixture for client code."""

@@ -628,6 +628,92 @@ def run_device_flap_multidevice(seed: int) -> None:
     assert_safety(pool)
 
 
+def run_device_flap_with_commit_wave(seed: int) -> None:
+    """device_flap with the fault aimed at the COMMIT-WAVE lane: the
+    pool's triple-root recommit (verkle state + ledger + audit) rides a
+    wedgeable device MSM engine behind the shared ring's cmt lane.
+    Mid-run the engine wedges; the wave degrades exactly that traffic to
+    host recommit (breaker-style, inside `_cmt_dispatch`) so roots keep
+    advancing and ordering continues, the ed lane stays isolated (its
+    waves keep dispatching — a cmt wedge is never ring-wide), and after
+    the heal fresh cmt waves hit the engine again."""
+    from plenum_tpu.common.node_messages import DOMAIN_LEDGER_ID
+    from plenum_tpu.parallel.pipeline import CryptoPipeline
+    from plenum_tpu.state.commitment import kzg
+
+    class WedgeableCmtEngine:
+        """Answers like the host KZG engine until wedged, then raises —
+        the device-MSM failure mode `_cmt_dispatch` must absorb."""
+
+        def __init__(self):
+            self.wedged = False
+            self.waves = 0
+
+        def run_jobs(self, jobs):
+            if self.wedged:
+                raise RuntimeError("cmt device wedged")
+            self.waves += 1
+            out = []
+            for job in jobs:
+                if job[0] == "commit":
+                    out.append(kzg.engine_for(job[1]).commit(dict(job[2])))
+                elif job[0] == "multiproof":
+                    out.append(kzg.prove_multi(list(job[1])))
+                else:
+                    out.append(None)
+            return out
+
+    rng = SimRandom(seed * 75503 + 29)
+    eng = WedgeableCmtEngine()
+    cfg = dict(FAST, STATE_COMMITMENT="verkle")
+    pipeline = CryptoPipeline(cmt_inner=eng, config=Config(**cfg))
+    pool = _track(Pool(seed=seed, config=Config(**cfg),
+                       pipeline=pipeline))
+    users = [Ed25519Signer(seed=(b"cwflap%d-%d" % (seed, i))
+                           .ljust(32, b"\0")[:32]) for i in range(4)]
+    reqs = [signed_nym(pool.trustee, u, i + 1) for i, u in enumerate(users)]
+
+    # pre-fault: the fused ordered path engages and rides the engine
+    pre = _order_and_time(pool, reqs[0], 2)
+    assert pre is not None, f"seed {seed}: healthy commit-wave pool stalled"
+    assert pipeline.stats["cmt_waves"] >= 1, \
+        f"seed {seed}: ordered batches never built a commit wave"
+    assert eng.waves >= 1, \
+        f"seed {seed}: recommit jobs never reached the cmt engine"
+    node = pool.nodes[pool.names[0]]
+    root_pre = node.c.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash
+
+    # wedge the engine MID-consensus: a request is in flight when every
+    # subsequent cmt wave starts dying on the device
+    pool.submit(reqs[1])
+    pool.run(rng.float(0.0, 0.3))
+    eng.wedged = True
+    ed_before = pipeline.stats["dispatches"]
+    during = _order_and_time(pool, reqs[2], 4)
+    assert during is not None, \
+        f"seed {seed}: pool stopped ordering under cmt engine wedge"
+    assert pipeline.stats["cmt_host_fallbacks"] >= 1, \
+        f"seed {seed}: wedged cmt wave never degraded to host recommit"
+    # roots ADVANCE through the degrade: the batch lands on host-resolved
+    # roots, never wedges the commit drain
+    root_during = node.c.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash
+    assert root_during != root_pre, \
+        f"seed {seed}: state root froze under cmt engine wedge"
+    # lane isolation: the ed lane kept dispatching (no ring-wide failure)
+    assert pipeline.stats["dispatches"] > ed_before, \
+        f"seed {seed}: ed lane starved by the cmt wedge"
+
+    # heal: fresh cmt waves must hit the engine again (re-admission is
+    # per-wave — the degrade never blacklists the engine)
+    eng.wedged = False
+    waves_before = eng.waves
+    post = _order_and_time(pool, reqs[3], 5)
+    assert post is not None, f"seed {seed}: pool dead after cmt heal"
+    assert eng.waves > waves_before, \
+        f"seed {seed}: healed cmt engine never re-admitted waves"
+    assert_safety(pool)
+
+
 def run_lying_reader_scenario(seed: int) -> None:
     """A Byzantine node forges read replies; the verifying read client
     must reject every forgery kind and fail over to an honest node
@@ -1171,6 +1257,21 @@ def test_sim_device_flap_multidevice_smoke():
     suite: the seed-targeted chip's lane breaker opens ALONE, the other
     lanes keep dispatching, and the lane re-warms and rejoins."""
     _run_with_artifacts(run_device_flap_multidevice, 2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_device_flap_commit_wave_fuzz(bucket):
+    for seed in range(bucket * 3, bucket * 3 + 3):
+        _run_with_artifacts(run_device_flap_with_commit_wave, seed)
+
+
+def test_sim_device_flap_commit_wave_smoke():
+    """One commit-wave device_flap scenario always runs in the default
+    suite: the wedged cmt engine degrades that batch to host recommit,
+    roots keep advancing, the ed lane stays isolated, and the healed
+    engine re-admits fresh waves."""
+    _run_with_artifacts(run_device_flap_with_commit_wave, 1)
 
 
 # 100 seeds, bucketed so failures show their seed range and xdist can split
