@@ -233,6 +233,15 @@ class MetricsName:
     PIPELINE_CMT_ITEMS = "pipeline_cmt.items"
     PIPELINE_CMT_LEVELS = "pipeline_cmt.levels"
     PIPELINE_CMT_HOST_FALLBACKS = "pipeline_cmt.host_fallbacks"
+    # cross-host federation (parallel/federation.py): rostered remote
+    # crypto hosts as extra lanes — how many, how much work migrated
+    # between backlogged lanes, which remote breakers are open, and the
+    # dispatch->verdict ship latency of the remote leg
+    PIPELINE_FED_REMOTE_LANES = "pipeline_fed.remote_lanes"
+    PIPELINE_FED_STEALS = "pipeline_fed.steals"
+    PIPELINE_FED_STOLEN_ITEMS = "pipeline_fed.stolen_items"
+    PIPELINE_FED_REMOTE_BREAKERS_OPEN = "pipeline_fed.remote_breakers_open"
+    PIPELINE_FED_SHIP_MS_P95 = "pipeline_fed.ship_ms_p95"
     # transport
     NODE_MSGS_IN = "transport.node_msgs_in"
     NODE_FRAMES_OUT = "transport.node_frames_out"

@@ -359,6 +359,21 @@ class Config:
     # only for lanes pinned to a real device); False forces inline
     # dispatch (deterministic sims/fuzz).
     PIPELINE_LANE_THREADS: Optional[bool] = None
+    # cross-host crypto federation (parallel/federation.py): comma-
+    # separated crypto-service socket paths; each remote host appears as
+    # one extra lane in the submission ring (its own wave queue, pinned
+    # ladder negotiated over the wire, supervised breaker). "" (the
+    # default) constructs the PR 14 single-host classes EXACTLY —
+    # byte-identical behavior, pinned by microbenchmark.
+    PIPELINE_REMOTE_HOSTS: str = ""
+    # work-stealing between backlogged lanes: a lane whose staged
+    # backlog exceeds the least-backlogged healthy lane's occupancy by
+    # at least STEAL_THRESHOLD items donates half the delta; the
+    # per-lane-pair COOLDOWN is the anti-flap hysteresis (a recent steal
+    # in either direction blocks the reverse). A lane whose breaker is
+    # open evacuates unconditionally — back to host-local lanes only.
+    PIPELINE_STEAL_THRESHOLD: int = 32
+    PIPELINE_STEAL_COOLDOWN: float = 0.25
     # fused commit wave (parallel/commit_wave.py): the ordered path
     # drains state-apply + triple-root recommit as level-synchronized
     # KIND_CMT dispatches whenever a pipeline is wired onto the

@@ -107,6 +107,11 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
         MetricsName.PIPELINE_CMT_WAVES, MetricsName.PIPELINE_CMT_ITEMS,
         MetricsName.PIPELINE_CMT_LEVELS,
         MetricsName.PIPELINE_CMT_HOST_FALLBACKS,
+        MetricsName.PIPELINE_FED_REMOTE_LANES,
+        MetricsName.PIPELINE_FED_STEALS,
+        MetricsName.PIPELINE_FED_STOLEN_ITEMS,
+        MetricsName.PIPELINE_FED_REMOTE_BREAKERS_OPEN,
+        MetricsName.PIPELINE_FED_SHIP_MS_P95,
     }),
     "reads": frozenset({
         MetricsName.READ_QUERIES, MetricsName.READ_PROOF_GEN_TIME,

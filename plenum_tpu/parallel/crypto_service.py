@@ -23,6 +23,15 @@ Wire: 4-byte big-endian length frames, msgpack maps.
   request  {"id": u64, "items": [[msg, sig, vk], ...]}
   reply    {"id": u64, "verdicts": [0|1, ...]}
   request  {"op": "stats"} -> server counters (ops tooling).
+  request  {"id": u64, "items": [...], "wave": 1} -> verdicts; the batch
+           dispatches VERBATIM as its own wave (no dedup/coalescing, pad
+           items preserved) so a federated lane's pinned bucket is
+           exactly the shape the remote inner sees (parallel/federation.py).
+  request  {"id": u64, "op": "prewarm", "buckets": [...]} -> {"id",
+           "warmed", "bucketed"}: compile the pad buckets now; bucketed
+           says whether the inner is device-backed (a host inner would
+           verify pad lanes for real, so the lane ships bare waves).
+  request  {"id": u64, "op": "pin"} -> {"id", "pinned"}: warmup over.
 
 Server:  python -m plenum_tpu.parallel.crypto_service --socket PATH \
              [--backend cpu|jax|jax-sharded] [--min-batch N]
@@ -107,6 +116,14 @@ class CryptoPlaneServer:
             except Exception:
                 pass
 
+    def _bucketed(self) -> bool:
+        """Is the inner chain device-backed? Federated lanes pad their
+        waves only when the answer is yes — a host inner would verify
+        every pad lane for real (the same rule as CryptoPipeline's own
+        `_bucketed`, answered server-side during prewarm negotiation)."""
+        from plenum_tpu.parallel.pipeline import _device_backed
+        return _device_backed(self._inner)
+
     def _drain(self, first) -> list:
         jobs = [first]
         while True:
@@ -181,7 +198,10 @@ class CryptoPlaneServer:
                 recent[wave["seq"]] = verdicts
             else:
                 self.stats["dispatches"] += 1
-                self.stats["dispatched_items"] += len(wave["todo"])
+                # wave frames dispatch verbatim (pads included), so their
+                # honest width is the batch, not the distinct digests
+                self.stats["dispatched_items"] += wave.get(
+                    "width", len(wave["todo"]))
                 new = {d: bool(verdicts[i])
                        for d, i in wave["todo"].items()}
                 recent[wave["seq"]] = new
@@ -203,6 +223,40 @@ class CryptoPlaneServer:
                     del self._cache[k]
             return True
 
+        def _dispatch_raw(done, batch, digests) -> None:
+            """One wave-frame job: the batch dispatches VERBATIM as its
+            own wave — no dedup, no coalescing, pad items preserved — so
+            the shape the inner sees is exactly the bucket the federated
+            lane packed (its pinned-ladder guarantee crosses the wire
+            intact). Verdicts still land in the shared digest cache."""
+            nonlocal next_seq
+            seq = next_seq
+            next_seq += 1
+            self.stats["wave_frames"] = self.stats.get("wave_frames", 0) + 1
+            self.stats["items"] += len(batch)
+            todo: dict[bytes, int] = {}
+            plan: list = []
+            for i, d in enumerate(digests):
+                if d not in todo:
+                    todo[d] = i
+                plan.append(("w", seq, d))
+            try:
+                token = self._inner.submit_batch(batch)
+            except Exception as e:
+                recent[seq] = f"{type(e).__name__}: {e}"
+                self._plane_fault("submit_errors")
+                _finish(done, plan)
+                for s in [s for s in recent if s <= seq - 4]:
+                    del recent[s]
+                return
+            if waves:
+                self.stats["overlapped"] = self.stats.get(
+                    "overlapped", 0) + 1
+            waves.append({"seq": seq, "token": token, "todo": todo,
+                          "width": len(batch), "jobs": [(done, plan)]})
+            while len(waves) > self._MAX_IN_FLIGHT:
+                _land(block=True)
+
         def _cycle() -> None:
             while waves and _land(block=False):
                 pass
@@ -212,11 +266,17 @@ class CryptoPlaneServer:
                 return
             nonlocal next_seq
             jobs = self._drain(first)   # coalesce everything queued
+            for j in jobs:
+                if j[3]:
+                    _dispatch_raw(j[0], j[1], j[2])
+            jobs = [j for j in jobs if not j[3]]
+            if not jobs:
+                return
             seq = next_seq
             todo: dict[bytes, int] = {}
             items: list[VerifyItem] = []
             wave_jobs: list = []
-            for done, batch, digests in jobs:
+            for done, batch, digests, _ in jobs:
                 self.stats["items"] += len(batch)
                 plan: list = []
                 dep = 0
@@ -352,6 +412,41 @@ class CryptoPlaneServer:
                     # supervised device plane, readable over the socket
                     out["plane"] = sup()
                 payload = pack(out)
+            elif req.get("op") == "prewarm":
+                # federated-lane ladder negotiation: compile each pad
+                # bucket NOW with one verbatim all-pad wave (the raw path
+                # bypasses dedup, so the dispatched shape IS the bucket).
+                # Sequential per bucket — simultaneous enqueues would
+                # coalesce in _drain and shrink the compiled shape.
+                rid = req["id"]
+                warmed: list = []
+                payload = None
+                for b in [int(x) for x in req.get("buckets", []) if x]:
+                    items = [(b"pipeline-prewarm", b"\x00" * 64,
+                              b"\x00" * 32)] * b
+                    digests = [_digest(*items[0])] * b
+                    fut = loop.create_future()
+                    self._q.put((lambda result, f=fut:
+                                 loop.call_soon_threadsafe(_resolve, f,
+                                                           result),
+                                 items, digests, True))
+                    result = await fut
+                    if isinstance(result, str):    # compile/dispatch died
+                        payload = pack({"id": rid, "error":
+                                        f"prewarm bucket {b}: {result}"})
+                        break
+                    warmed.append(b)
+                if payload is None:
+                    self.stats["prewarms"] = \
+                        self.stats.get("prewarms", 0) + 1
+                    payload = pack({"id": rid, "warmed": warmed,
+                                    "bucketed": self._bucketed()})
+            elif req.get("op") == "pin":
+                rid = req["id"]
+                # warmup-over marker; ladder enforcement lives in the
+                # federated lane's shape set on the client side
+                self.stats["pinned"] = 1
+                payload = pack({"id": rid, "pinned": True})
             elif "bls" in req:
                 # [[sig_b58, msg_bytes, [verkey_b58...]], ...] -> bools.
                 # Pairings run in the default executor (the BN254 ctypes
@@ -374,7 +469,7 @@ class CryptoPlaneServer:
                 fut = loop.create_future()
                 self._q.put((lambda result, f=fut:
                              loop.call_soon_threadsafe(_resolve, f, result),
-                             batch, digests))
+                             batch, digests, bool(req.get("wave"))))
                 result = await fut
                 if isinstance(result, str):      # backend failure
                     payload = pack({"id": rid, "error": result})
@@ -686,6 +781,66 @@ class ServiceEd25519Verifier(Ed25519Verifier):
                     self._stash_reply(reply)
                     continue
                 return reply
+
+
+class FederatedEd25519Client(ServiceEd25519Verifier):
+    """Remote-lane client of the federated pipeline (parallel/
+    federation.py): verify batches ship as WAVE FRAMES (`"wave": 1`) the
+    server dispatches verbatim — no server-side dedup or coalescing, so
+    the padded bucket the lane packed is EXACTLY the shape the remote
+    inner compiles, and the lane's pinned-ladder guarantee crosses the
+    wire intact — plus the prewarm/pin RPCs the pipeline negotiates a
+    remote host's pad ladder with before pinning."""
+
+    def submit_batch(self, items: Sequence[VerifyItem]):
+        items = [(bytes(m), bytes(s), bytes(v)) for m, s, v in items]
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._submit_send(rid, {"id": rid, "items": items, "wave": 1},
+                              max(1, len(items)))
+        return (rid, len(items))
+
+    def _rpc(self, req: dict, n_items: int = 1,
+             timeout: Optional[float] = None) -> dict:
+        """Blocking control round-trip (prewarm/pin): submit and hold
+        the lock through the reply — control ops run during warmup only
+        and must not interleave with verify replies. `timeout` overrides
+        the adaptive per-item budget: a prewarm sits behind the remote's
+        XLA compiles, which the item-count formula knows nothing about."""
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._submit_send(rid, dict(req, id=rid), n_items)
+            deadline = (time.monotonic() + timeout if timeout is not None
+                        else self._deadline_for(rid))
+            while rid not in self._replies:
+                reply = self._recv(block=True, deadline=deadline)
+                self._stash_reply(reply)
+            reply = self._replies.pop(rid)
+            self._meta.pop(rid, None)
+        if "error" in reply:
+            # a remote that cannot compile its ladder must fail warmup
+            # LOUDLY (the same contract as the local lane prewarm)
+            raise RuntimeError(f"crypto service: {reply['error']}")
+        return reply
+
+    def prewarm(self, buckets: Sequence[int]) -> dict:
+        """Compile the remote's pad buckets NOW. -> {"warmed": [...],
+        "bucketed": bool}; bucketed False means the remote inner is a
+        host verifier (padding would burn real verifies there), so the
+        lane ships bare waves instead."""
+        want = sorted({int(b) for b in buckets if int(b) >= 1})
+        # the cold ceiling, not the per-item budget: this request IS the
+        # multi-minute first-compile the budget's cold_max exists for
+        return self._rpc({"op": "prewarm", "buckets": want},
+                         n_items=max(1, sum(want)),
+                         timeout=self._request_timeout)
+
+    def pin(self) -> dict:
+        """Declare warmup over on the remote (stats marker; the lane's
+        own compiled-shape set enforces the ladder on this side)."""
+        return self._rpc({"op": "pin"})
 
 
 class ServiceBlsVerifier:

@@ -46,7 +46,9 @@ def lane_roster(n_lanes: Optional[int] = None,
     asking for 8 lanes degrades gracefully on a 4-chip host. Only LOCAL
     devices qualify: a lane must be able to device_put from this host
     (multihost jobs run one pipeline per host over local chips; the
-    SPMD plane is the cross-host story)."""
+    SPMD plane — and the federated pipeline's rented remote-host lanes,
+    parallel/federation.py, appended AFTER this local roster — are the
+    cross-host stories)."""
     devs = list(devices) if devices is not None else jax.local_devices()
     if not devs:
         return []

@@ -66,7 +66,23 @@ def init_multihost(coordinator: Optional[str] = None,
 # this process, so a multi-host job runs one N-lane pipeline PER HOST
 # over `parallel.mesh.lane_roster()` (local devices only), while the
 # SPMD plane (ShardedCryptoPlane over `global_mesh()`) remains the
-# one-program-spans-all-hosts story.
+# one-program-spans-all-hosts story. The THIRD cross-host shape is the
+# federated pipeline (parallel/federation.py): remote crypto-service
+# hosts rostered below join THIS host's ring as extra lanes — rented
+# verification capacity over the service wire rather than one SPMD
+# program — with work-stealing between backlogged lanes.
+
+
+def crypto_host_roster(config=None,
+                       hosts: Optional[str] = None) -> list[str]:
+    """Remote crypto-host roster for the federated pipeline: the
+    comma-separated crypto_service socket paths of rostered hosts
+    (config.PIPELINE_REMOTE_HOSTS, or an explicit override string).
+    Empty roster -> empty list -> the single-host classes construct
+    exactly (the federation gate in pipeline.make_crypto_pipeline)."""
+    raw = hosts if hosts is not None else str(
+        getattr(config, "PIPELINE_REMOTE_HOSTS", "") or "")
+    return [h.strip() for h in raw.split(",") if h.strip()]
 
 
 def global_mesh(n_devices: Optional[int] = None) -> Mesh:

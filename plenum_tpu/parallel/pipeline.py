@@ -225,7 +225,8 @@ class _EdToken:
     ("k", verdict) for cache/malformed verdicts or ("w", wave, idx) for
     items riding a device wave."""
 
-    __slots__ = ("items", "plan", "planned", "verdicts", "t_submit")
+    __slots__ = ("items", "plan", "planned", "verdicts", "t_submit",
+                 "lane_hint")
 
     def __init__(self, items, t_submit):
         self.items = items
@@ -233,6 +234,9 @@ class _EdToken:
         self.planned = 0             # items assigned to a wave/cache so far
         self.verdicts = None
         self.t_submit = t_submit
+        # placement pin recorded at submit (federation work-stealing
+        # eligibility: pinned tokens never migrate off their chip)
+        self.lane_hint = None
 
 
 class _Wave:
@@ -1822,6 +1826,17 @@ def make_crypto_pipeline(config, backend: str,
         return None
     if n_devices is None:
         n_devices = getattr(config, "PIPELINE_DEVICES", 1)
+    hosts = [h.strip() for h in
+             str(getattr(config, "PIPELINE_REMOTE_HOSTS", "") or "")
+             .split(",") if h.strip()]
+    if ed_inner is None and backend == "jax" and hosts:
+        # cross-host federation: rostered remote crypto hosts join the
+        # ring as extra lanes. Gated STRICTLY on the roster knob — unset
+        # keeps every path below byte-identical (the PR 14 contract)
+        from .federation import make_federated_pipeline
+        return make_federated_pipeline(config, min_batch=min_batch,
+                                       supervised=supervised,
+                                       n_devices=n_devices)
     if ed_inner is None and backend == "jax" and n_devices != 1:
         return make_multidevice_pipeline(config, n_devices,
                                          min_batch=min_batch,

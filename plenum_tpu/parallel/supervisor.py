@@ -246,7 +246,11 @@ class SupervisedVerifier(Ed25519Verifier):
     """Breaker + adaptive-deadline + hedged-fallback wrapper around a
     device-backed verifier. Implements the same submit/collect token
     protocol, so node pipelining and the CoalescingVerifier work
-    unchanged on top of it."""
+    unchanged on top of it. "Device" includes REMOTE backends: the
+    federated pipeline (parallel/federation.py) wraps each rostered
+    crypto host's service client in its own supervisor, so a dead host
+    opens exactly that lane's breaker and the probe's `rewarm()` hook —
+    the client's reconnect — re-admits the host when it returns."""
 
     _PROBE_SEED = b"plane-probe-signer".ljust(32, b"\0")
 
@@ -363,6 +367,16 @@ class SupervisedVerifier(Ed25519Verifier):
         else:
             self.stats["probe_failures"] += 1
             self.breaker.reopen()
+
+    def pump_recovery(self) -> None:
+        """Drive breaker recovery WITHOUT traffic. `_service_probe` runs
+        on the submit/collect path, which assumes a degraded verifier
+        still sees batches — true for pinned lanes, false for a dead
+        federated host the pipeline's placement routes around entirely.
+        The ring pump calls this on idle open lanes so such a host can
+        rejoin on its own."""
+        if self.breaker.state != CLOSED:
+            self._service_probe()
 
     # --- zombie reaping (late device results after a hedge) -------------
 

@@ -129,6 +129,28 @@ def render(agg, incidents, last_n: int = 5) -> str:
                     f"{name}:lane{dev.get('lane')}={dev.get('breaker')}")
     if sick_lanes:
         lines.append("  SICK CHIPS: " + ", ".join(sick_lanes))
+    # cross-host federation: rented remote crypto-host lanes — roster
+    # size, steal traffic, ship latency, and any remote whose breaker is
+    # open (that host's capacity is dark; its queue stole back local)
+    remote_lines = []
+    for name, snap in sorted(getattr(agg, "latest", {}).items()):
+        pipe_state = snap.get("state", {}).get("pipeline", {})
+        fed = pipe_state.get("federation") or {}
+        remotes = [d for d in pipe_state.get("devices", []) or []
+                   if d.get("remote")]
+        if not fed and not remotes:
+            continue
+        dark = [f"{d.get('host', 'lane%s' % d.get('lane'))}="
+                f"{d.get('breaker')}" for d in remotes
+                if d.get("breaker") not in ("closed", "none")]
+        remote_lines.append(
+            f"{name}: {fed.get('remote_lanes', len(remotes))} remote, "
+            f"steals={fed.get('steals', 0)}"
+            f"/{fed.get('stolen_items', 0)} items, "
+            f"ship_p95={fed.get('ship_ms_p95', '-')}ms"
+            + (f", DARK: {', '.join(dark)}" if dark else ""))
+    if remote_lines:
+        lines.append("  REMOTE LANES: " + "; ".join(remote_lines))
     for kind, per_node in s["burn"].items():
         burning = {n: b for n, b in per_node.items()
                    if b["fast"] > 0 or b["slow"] > 0}
@@ -253,6 +275,30 @@ def self_check() -> int:
     agg3b.ingest(healthy("N1", 1, 1.0))
     if agg3b.node_health("N1") != 1.0:
         problems.append("lane health did not recover after re-admission")
+
+    # 3c) cross-host federation: the console shows the rented remote
+    # lanes (roster, steal traffic, ship latency) and names a remote
+    # host whose breaker is open — dark rented capacity must be visible
+    agg3c = FleetAggregator(config=config)
+    feddy = healthy("N1", 0, 0.0)
+    feddy["state"]["pipeline"] = {
+        "occupancy": 0, "dispatches": 20, "breakers_open": 1,
+        "devices": [
+            {"lane": 0, "breaker": "closed", "occupancy": 0,
+             "dispatches": 12},
+            {"lane": 1, "breaker": "open", "occupancy": 0,
+             "dispatches": 8, "remote": True, "host": "/run/ch0.sock",
+             "steals_in": 2, "steals_out": 1}],
+        "federation": {"remote_lanes": 1, "steals": 3,
+                       "stolen_items": 96, "remote_breakers_open": 1,
+                       "ship_ms_p95": 4.2}}
+    agg3c.ingest(feddy)
+    text = render(agg3c, [])
+    if "REMOTE LANES" not in text:
+        problems.append("console did not show the federated remote lanes")
+    elif "/run/ch0.sock=open" not in text or "steals=3" not in text:
+        problems.append("console did not name the dark remote host "
+                        "or its steal traffic")
 
     # 4) hot shard: skewed ordered rates flag shard 0
     agg4 = FleetAggregator(config=config)

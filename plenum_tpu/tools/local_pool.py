@@ -122,7 +122,16 @@ def build_pool(n_nodes: int, backend: str, seed: int = 1,
         if config.CRYPTO_PIPELINE:
             pipe_config = config.replace(PIPELINE_MAX_BUCKET=max(
                 bucket, config.PIPELINE_MAX_BUCKET))
-            if config.PIPELINE_DEVICES != 1:
+            if config.PIPELINE_REMOTE_HOSTS:
+                # cross-host federation: rostered remote crypto hosts
+                # join the ring as extra lanes with work-stealing
+                # (parallel/federation.py); gated strictly on the
+                # roster knob so unset keeps the arms below exact
+                from plenum_tpu.parallel.federation import \
+                    make_federated_pipeline
+                pipeline = make_federated_pipeline(pipe_config,
+                                                   min_batch=1)
+            elif config.PIPELINE_DEVICES != 1:
                 # multi-chip scale-out: one breakable lane per local
                 # device, each with its own supervised pinned verifier
                 from plenum_tpu.parallel.pipeline import \

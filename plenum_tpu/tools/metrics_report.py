@@ -372,6 +372,26 @@ def derive_summary(folds: dict[str, dict], span_s: float,
                 "host_fallbacks": int(
                     cum("pipeline_cmt.host_fallbacks") or 0),
             }
+        # cross-host federation (docs/performance.md "Cross-host crypto
+        # federation"): rented remote-host lanes, how much work migrated
+        # between backlogged lanes, open remote breakers RIGHT NOW, and
+        # the remote dispatch->verdict ship latency — a rising
+        # remote_breakers_open means rented capacity is dark and the
+        # ring is running host-local
+        fl = folds.get("pipeline_fed.remote_lanes", {})
+        if fl.get("last"):
+            section["federation"] = {
+                "remote_lanes": int(fl["last"]),
+                "steals": int(folds.get(
+                    "pipeline_fed.steals", {}).get("last") or 0),
+                "stolen_items": int(folds.get(
+                    "pipeline_fed.stolen_items", {}).get("last") or 0),
+                "remote_breakers_open": int(folds.get(
+                    "pipeline_fed.remote_breakers_open",
+                    {}).get("last") or 0),
+                "ship_ms_p95": folds.get(
+                    "pipeline_fed.ship_ms_p95", {}).get("last"),
+            }
         out["crypto_pipeline"] = {k: v for k, v in section.items()
                                   if v is not None}
     # closed-loop batch controller (docs/performance.md "Pipelined

@@ -65,6 +65,7 @@ cfg = Config(PIPELINE_MIN_BUCKET=%(bucket)d, PIPELINE_MAX_BUCKET=%(bucket)d,
 pipe = make_multidevice_pipeline(cfg, %(n)d, min_batch=1)
 t0 = time.perf_counter()
 pipe.prewarm([%(bucket)d])
+pipe.prewarm_cmt([4])        # the cmt ladder the commit wave below rides
 pipe.pin()
 out["warmup_s"] = round(time.perf_counter() - t0, 1)
 
@@ -113,8 +114,43 @@ elapsed = %(seconds)f + (time.perf_counter() - t_flood0)
 out["flood_items_per_s"] = round(settled / elapsed, 1)
 out["per_device_dispatches"] = {
     "lane%%d" %% d["lane"]: d["dispatches"] for d in pipe.device_state()}
+
+# commitment lane: a two-level commit wave (hlev sha3 jobs — the MPT
+# node-hash levels a state recommit stages) rides the SAME ring. Roots
+# are checked against a host-computed reference, and the verdict folds
+# in the pipeline_cmt.* wave stats — a run whose cmt lane went dark or
+# degraded to the host engine mid-wave fails the row, not just one
+# whose ed lanes misbehaved
+import hashlib
+from plenum_tpu.parallel.commit_wave import CommitWave
+def _cmt_family(tag):
+    def gen():
+        msgs = tuple(b"mc-cmt-%%d-%%d" %% (tag, j) for j in range(8))
+        (lvl1,) = yield [("hlev", "sha3", msgs)]
+        (root,) = yield [("hlev", "sha3", (b"".join(lvl1),))]
+        return root[0]
+    return gen()
+def _cmt_expect(tag):
+    msgs = [b"mc-cmt-%%d-%%d" %% (tag, j) for j in range(8)]
+    lvl1 = b"".join(hashlib.sha3_256(m).digest() for m in msgs)
+    return hashlib.sha3_256(lvl1).digest()
+cwave = CommitWave(pipe)
+for fam in range(3):
+    cwave.add("fam%%d" %% fam, _cmt_family(fam))
+roots = cwave.run()
+out["cmt"] = {"waves": pipe.stats["cmt_waves"],
+              "levels": pipe.stats["cmt_levels"],
+              "items": pipe.stats["cmt_items"],
+              "host_fallbacks": pipe.stats["cmt_host_fallbacks"]}
+cmt_ok = (all(roots.get("fam%%d" %% f) == _cmt_expect(f)
+              for f in range(3))
+          and pipe.stats["cmt_waves"] >= 1
+          and pipe.stats["cmt_levels"] >= 2
+          and pipe.stats["cmt_host_fallbacks"] == 0)
+out["cmt_ok"] = cmt_ok
+
 out["unpinned_shapes"] = pipe.stats["unpinned_shapes"]
-out["ok"] = bool(lanes_ok and settled > 0
+out["ok"] = bool(lanes_ok and settled > 0 and cmt_ok
                  and pipe.stats["unpinned_shapes"] == 0)
 pipe.close()
 print(json.dumps(out))

@@ -694,3 +694,250 @@ def test_cmt_hlev_levels_bypass_engine_but_ride_the_fused_flush():
                            for m in (b"node-a", b"node-b"))
     assert eng.shapes == [4]          # 2 commit jobs padded to bucket 4
     assert pipe.stats["unpinned_shapes"] == 0
+
+
+# --- cross-host federation (parallel/federation.py) --------------------------
+
+def _fed_pipe(n_local=2, n_remote=1, **over):
+    from plenum_tpu.parallel.federation import FederatedCryptoPipeline
+    locals_ = [FakeDeviceVerifier() for _ in range(n_local)]
+    remotes = [FakeDeviceVerifier() for _ in range(n_remote)]
+    pipe = FederatedCryptoPipeline(
+        ed_inners=locals_, remote_inners=remotes,
+        hosts=[f"/tmp/fake{j}.sock" for j in range(n_remote)],
+        config=_fast_config(**over), threaded=False)
+    return pipe, locals_, remotes
+
+
+def test_federated_stolen_items_never_double_verified():
+    """Work-stealing moves whole, fully-unplanned tokens, so each
+    distinct item reaches exactly ONE device exactly once even while
+    waves migrate between backlogged lanes: dispatched_items (which
+    counts unique reals, not pads) equals the distinct items submitted."""
+    rng = random.Random(71)
+    pipe, locals_, remotes = _fed_pipe(
+        2, 1, PIPELINE_STEAL_THRESHOLD=4, PIPELINE_STEAL_COOLDOWN=0.0)
+    n_items = 0
+    toks = []
+    for i in range(30):
+        t = pipe.submit_verify(_junk_items(rng, 5), lane=0)
+        t.lane_hint = None          # eligible: only the PIN blocks a steal
+        toks.append(t)
+        n_items += 5
+    pipe._balance()
+    assert pipe.stats["steals"] >= 1, "backlog never migrated"
+    for t in toks:
+        out = pipe.collect_verify(t, wait=True)
+        assert out is not None and len(out) == 5
+    assert pipe.stats["dispatched_items"] == n_items, \
+        "a stolen item was dispatched more (or less) than once"
+    assert pipe.stats["stolen_items"] >= 1
+    # the remote lane really absorbed work
+    assert pipe.lanes[2].stats["dispatched_items"] >= 1
+
+
+def test_federated_pinned_placement_honored():
+    """place() maps pinned shard tags onto LOCAL chips only, and a
+    pinned token never migrates off its chip — a backlogged pinned lane
+    keeps its own queue (its fallback chain is its own supervisor)."""
+    rng = random.Random(73)
+    pipe, _, _ = _fed_pipe(2, 2, PIPELINE_STEAL_THRESHOLD=1,
+                           PIPELINE_STEAL_COOLDOWN=0.0)
+    assert [pipe.place(t) for t in range(5)] == [0, 1, 0, 1, 0]
+    toks = [pipe.submit_verify(_junk_items(rng, 2), lane=0)
+            for _ in range(40)]
+    pre = pipe._lane_backlog(pipe.lanes[0])
+    pipe._balance()
+    assert pipe.stats["steals"] == 0, "a pinned token migrated"
+    assert pipe._lane_backlog(pipe.lanes[0]) == pre
+    for t in toks:
+        assert pipe.collect_verify(t, wait=True) is not None
+    assert pipe.lanes[0].stats["dispatched_items"] == 80
+    assert all(l.stats["dispatched_items"] == 0 for l in pipe.lanes[1:])
+
+
+def test_federated_steal_hysteresis_never_oscillates():
+    """Symmetric load on two lanes: neither clears the occupancy-delta
+    threshold, so zero steals — and after a genuine steal the per-pair
+    cooldown blocks the immediate reverse flow (anti-flap)."""
+    rng = random.Random(79)
+    pipe, _, _ = _fed_pipe(2, 0, PIPELINE_STEAL_THRESHOLD=8,
+                           PIPELINE_STEAL_COOLDOWN=60.0)
+    for i in range(20):                     # 20 items each, symmetric
+        for lane in (0, 1):
+            t = pipe.submit_verify(_junk_items(rng, 1), lane=lane)
+            t.lane_hint = None
+    for _ in range(50):
+        pipe._balance()
+    assert pipe.stats["steals"] == 0, "symmetric load oscillated"
+    # now a real imbalance: one steal fires, the echo is suppressed
+    for i in range(30):
+        t = pipe.submit_verify(_junk_items(rng, 1), lane=0)
+        t.lane_hint = None
+    pipe._balance()
+    assert pipe.stats["steals"] == 1
+    # tilt the load the OTHER way: the delta now clears the threshold in
+    # reverse, but the per-pair cooldown must hold the echo (anti-flap)
+    for i in range(30):
+        t = pipe.submit_verify(_junk_items(rng, 1), lane=1)
+        t.lane_hint = None
+    for _ in range(50):
+        pipe._balance()
+    assert pipe.stats["steals"] == 1, "steal echoed back within cooldown"
+
+
+def test_federated_breaker_evacuates_to_local_lanes():
+    """An open remote breaker evacuates that lane's queue back to
+    HOST-LOCAL lanes unconditionally (no threshold, no cooldown) — the
+    crypto_host_down steal-back contract."""
+    import types
+    rng = random.Random(83)
+    pipe, locals_, remotes = _fed_pipe(2, 1, PIPELINE_STEAL_THRESHOLD=10 ** 6,
+                                       PIPELINE_STEAL_COOLDOWN=60.0)
+    # queue unhinted work onto the remote lane directly
+    for i in range(10):
+        t = pipe.submit_verify(_junk_items(rng, 2))
+        t.lane_hint = None
+    # drain whatever landed locally so only the remote queue remains
+    remote = pipe.lanes[2]
+    for lane in pipe.lanes[:2]:
+        lane.staged.clear()
+        lane.first_staged = None
+    if not remote.staged:                   # ensure the remote has work
+        t = pipe.submit_verify(_junk_items(rng, 2))
+        t.lane_hint = None
+        remote.staged.append(t)
+    remotes[0].breaker = types.SimpleNamespace(state="open")
+    pre = pipe._lane_backlog(remote)
+    assert pre > 0
+    pipe._balance()
+    assert pipe._lane_backlog(remote) == 0, "open lane kept its queue"
+    assert sum(pipe._lane_backlog(l) for l in pipe.lanes[:2]) == pre
+    assert pipe.stats["steals"] >= 1
+
+
+def test_federated_idle_dead_host_rejoins_via_pump():
+    """Placement routes AROUND an open lane and evacuation empties its
+    queue, so a dead host's supervisor sees no traffic at all — nothing
+    on the submit/collect path would ever run its probe. The ring pump
+    must drive recovery itself (service() -> supervisor.pump_recovery):
+    after the host heals, pumping ALONE re-closes the breaker (re-warm
+    included) and fresh waves reach the host again."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.federation import FederatedCryptoPipeline
+    from plenum_tpu.parallel.supervisor import (CLOSED, CircuitBreaker,
+                                                SupervisedVerifier)
+
+    class DyingHost(CpuEd25519Verifier):
+        def __init__(self):
+            super().__init__()
+            self.dead = False
+            self.rewarms = 0
+
+        def rewarm(self):
+            if self.dead:
+                raise ConnectionError("host down")
+            self.rewarms += 1
+
+        def submit_batch(self, items):
+            if self.dead:
+                raise ConnectionError("host down")
+            return super().submit_batch(items)
+
+    clock = [0.0]
+    host = DyingHost()
+    sup = SupervisedVerifier(
+        host, fallback=CpuEd25519Verifier(),
+        breaker=CircuitBreaker(fail_threshold=1, cooldown=1.0,
+                               now=lambda: clock[0]),
+        now=lambda: clock[0], label="remote0")
+    pipe = FederatedCryptoPipeline(
+        ed_inners=[FakeDeviceVerifier() for _ in range(2)],
+        remote_inners=[sup], hosts=["/tmp/fake0.sock"],
+        config=_fast_config(PIPELINE_STEAL_THRESHOLD=10 ** 6,
+                            PIPELINE_STEAL_COOLDOWN=60.0),
+        threaded=False)
+    remote = pipe.lanes[2]
+    rng = random.Random(97)
+
+    def through_remote(n):
+        t = pipe.submit_verify(_junk_items(rng, n))
+        t.lane_hint = None
+        for lane in pipe.lanes:
+            if t in lane.staged:
+                lane.staged.remove(t)
+                if not lane.staged:
+                    lane.first_staged = None
+        remote.staged.append(t)
+        if remote.first_staged is None:
+            remote.first_staged = clock[0]
+        return t
+
+    # the host dies with one wave headed its way: the supervisor falls
+    # back (the wave still settles) and the breaker opens
+    host.dead = True
+    tok = through_remote(2)
+    pipe.service(force=True)
+    assert pipe.collect_verify(tok, wait=True) is not None
+    assert sup.breaker.state != CLOSED
+    assert remote.degraded()
+
+    # heal, then pump service() with ZERO traffic anywhere: recovery
+    # must come from the pump, not from batches the lane never gets
+    host.dead = False
+    clock[0] += 2.0                       # past the cooldown
+    for _ in range(4):
+        pipe.service()
+    assert sup.breaker.state == CLOSED, \
+        "idle open lane never probed: pump_recovery not driven"
+    assert host.rewarms >= 1, "re-admission skipped the re-warm"
+    assert not remote.degraded()
+
+    # rejoin is real: a fresh wave through the lane hits the device path
+    dev_before = sup.stats["device_batches"]
+    tok = through_remote(2)
+    pipe.service(force=True)
+    assert pipe.collect_verify(tok, wait=True) is not None
+    assert sup.stats["device_batches"] > dev_before
+
+
+def test_federated_zero_remote_constructs_pr14_class_exactly():
+    """PIPELINE_REMOTE_HOSTS unset -> the construction seam returns the
+    PR 14 classes THEMSELVES (no federation subclass anywhere on the
+    hot path), and the federated subclass's pump overhead with zero
+    remotes stays within noise of the PR 14 ring (microbench pin)."""
+    from plenum_tpu.parallel.federation import FederatedCryptoPipeline
+    from plenum_tpu.parallel.pipeline import MultiDeviceCryptoPipeline
+    assert Config().PIPELINE_REMOTE_HOSTS == ""
+    p1 = make_crypto_pipeline(Config(PIPELINE_DEVICES=1), "jax")
+    assert type(p1) is CryptoPipeline
+    p2 = make_crypto_pipeline(Config(PIPELINE_DEVICES=2), "jax")
+    assert type(p2) is MultiDeviceCryptoPipeline
+    assert not isinstance(p2, FederatedCryptoPipeline)
+    p2.close()
+    # hosts set -> the factory takes the federation branch, which fails
+    # FAST on an unreachable roster entry (operator error, not a silent
+    # single-host fallback)
+    with pytest.raises((OSError, RuntimeError)):
+        make_crypto_pipeline(
+            Config(PIPELINE_DEVICES=1,
+                   PIPELINE_REMOTE_HOSTS="/tmp/nonexistent-fed.sock"),
+            "jax")
+
+    def drive(pipe, n_ops=60):
+        rng = random.Random(89)
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            tok = pipe.submit_verify(_junk_items(rng, 8))
+            pipe.collect_verify(tok, wait=True)
+        return (time.perf_counter() - t0) / n_ops
+
+    base, _ = _multi_pipe(2)
+    fed, _, _ = _fed_pipe(2, 0)
+    drive(base, 10)
+    drive(fed, 10)
+    per_base = drive(base)
+    per_fed = drive(fed)
+    assert per_fed < per_base * 3 + 1e-3, \
+        f"zero-remote federation {per_fed * 1e6:.0f}us/op vs PR 14 " \
+        f"{per_base * 1e6:.0f}us/op"

@@ -628,6 +628,180 @@ def run_device_flap_multidevice(seed: int) -> None:
     assert_safety(pool)
 
 
+def _move_to_lane(pipeline, tok, lane) -> None:
+    """Re-stage an unhinted token onto a specific lane (scenario
+    plumbing: the federated ring only routes unhinted work to a remote
+    by occupancy, which a quiet sim pool rarely exercises)."""
+    src = next(l for l in pipeline.lanes if tok in l.staged)
+    if src is lane:
+        return
+    src.staged.remove(tok)
+    if not src.staged:
+        src.first_staged = None
+    if not lane.staged:
+        lane.first_staged = pipeline._now()
+    lane.staged.append(tok)
+
+
+def run_crypto_host_down_scenario(seed: int) -> None:
+    """crypto_host_down: a rostered REMOTE crypto host dies/wedges
+    mid-consensus under the federated pipeline (parallel/federation.py).
+    The pool's ring runs 2 local chip lanes plus one remote-host lane
+    (in-proc stand-in for the service client: the same supervised
+    submit/collect + breaker + re-warm surface, on the sim clock so
+    failing seeds replay). The seeded fault window targets ONLY the
+    remote: exactly its breaker opens, its queued waves steal BACK to
+    the local lanes (and are never double-verified), ordering never
+    stalls past the deadline budget, and after the heal the host
+    re-warms and REJOINS — fresh waves hit it again."""
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.faults import FaultPlan, FaultyVerifier
+    from plenum_tpu.parallel.federation import FederatedCryptoPipeline
+    from plenum_tpu.parallel.supervisor import (CLOSED, CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    rng = SimRandom(seed * 62233 + 29)
+    n_local = 2
+    remote_idx = n_local
+    kind = ("wedge", "drop", "corrupt")[rng.integer(0, 2)]
+    plan = FaultPlan.from_seed(seed, n_devices=n_local + 1, n_faults=0)
+    # the victim IS the scenario kind: force the plan onto the remote
+    # host's lane (seed still drives fault mode, timings, cooldowns)
+    plan.device = remote_idx
+
+    faulties, sups = [], []
+    for k in range(n_local + 1):
+        faulty = FaultyVerifier(CpuEd25519Verifier(), plan=plan,
+                                device_index=k)
+        sup = SupervisedVerifier(
+            faulty, fallback=CpuEd25519Verifier(),
+            breaker=CircuitBreaker(fail_threshold=2,
+                                   cooldown=rng.float(0.5, 1.5)),
+            budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
+                                  warm_max=1.0, cold_max=1.0),
+            label=f"lane{k}" if k < n_local else "remote0")
+        faulties.append(faulty)
+        sups.append(sup)
+    pipeline = FederatedCryptoPipeline(
+        ed_inners=sups[:n_local], remote_inners=[sups[remote_idx]],
+        hosts=["sim://crypto-host-0"],
+        config=Config(**FAST, PIPELINE_STEAL_THRESHOLD=4,
+                      PIPELINE_STEAL_COOLDOWN=0.1),
+        threaded=False)
+    remote_lane = pipeline.lanes[remote_idx]
+    pool = _track(Pool(seed=seed, config=Config(**FAST),
+                       pipeline=pipeline))
+    for obj in (*sups, *faulties):
+        obj.set_clock(pool.timer.get_current_time)
+
+    users = [Ed25519Signer(seed=(b"hdown%d-%d" % (seed, i))
+                           .ljust(32, b"\0")[:32]) for i in range(4)]
+    reqs = [signed_nym(pool.trustee, u, i + 1) for i, u in enumerate(users)]
+
+    def junk(tag: bytes, n: int = 3):
+        return [(b"%s-%d-%d" % (tag, seed, i), b"\x01" * 63 + b"\x00",
+                 bytes([i + 1]) * 32) for i in range(n)]
+
+    # pre-fault: ordering healthy, every lane (including the rented
+    # remote) carries at least one wave
+    pre = _order_and_time(pool, reqs[0], 2)
+    assert pre is not None, f"seed {seed}: healthy federated pool stalled"
+    for k in range(n_local):
+        pipeline.verifier(lane=k).verify_batch(junk(b"pre%d" % k))
+    rtok = pipeline.submit_verify(junk(b"pre-remote"))
+    rtok.lane_hint = None
+    _move_to_lane(pipeline, rtok, remote_lane)
+    assert pipeline.collect_verify(rtok, wait=True) is not None
+    assert remote_lane.stats["dispatches"] >= 1, \
+        f"seed {seed}: the remote lane never carried a wave pre-fault"
+    assert all(s.breaker.state == CLOSED for s in sups)
+
+    # the host dies MID-consensus: a request is in flight when the
+    # remote's fault window opens (local lanes carry the same plan but
+    # only device_index == remote reads it)
+    pool.submit(reqs[1])
+    pool.run(rng.float(0.0, 0.3))
+    plan.windows = [(pool.timer.get_current_time(), 1e9, kind)]
+    pool.run(0.2)
+    nudges = 0
+    while sups[remote_idx].breaker.state == CLOSED and nudges < 30:
+        nudges += 1
+        pool.run(0.2)
+        sups[remote_idx].verify_batch(junk(b"fault%d" % nudges))
+    assert sups[remote_idx].breaker.state != CLOSED, \
+        f"seed {seed}: remote host breaker never opened under {kind}"
+    # ONLY the remote lane degrades
+    for k in range(n_local):
+        assert sups[k].breaker.state == CLOSED, \
+            f"seed {seed}: local lane {k} breaker opened for the " \
+            f"remote host's {kind}"
+
+    # steal-back: waves queued on the dead host's lane evacuate to the
+    # LOCAL lanes (unconditionally — no threshold, no cooldown) and
+    # settle there exactly once
+    stok = pipeline.submit_verify(junk(b"stranded", n=4))
+    stok.lane_hint = None
+    _move_to_lane(pipeline, stok, remote_lane)
+    steals_before = pipeline.stats["steals"]
+    items_before = pipeline.stats["dispatched_items"]
+    pipeline.service()
+    assert pipeline.stats["steals"] > steals_before, \
+        f"seed {seed}: dead host's queue never stole back"
+    assert pipeline._lane_backlog(remote_lane) == 0, \
+        f"seed {seed}: the open lane kept queued waves"
+    out = pipeline.collect_verify(stok, wait=True)
+    assert out is not None and len(out) == 4
+    assert pipeline.stats["dispatched_items"] - items_before == 4, \
+        f"seed {seed}: a stolen wave was double-verified"
+
+    # local lanes keep dispatching; aggregate ordering continues within
+    # the deadline budget while the host is dark
+    before = [pipeline.lanes[k].stats["dispatches"]
+              for k in range(n_local)]
+    for k in range(n_local):
+        pipeline.verifier(lane=k).verify_batch(junk(b"during%d" % k))
+    after = [pipeline.lanes[k].stats["dispatches"] for k in range(n_local)]
+    assert all(b > a for a, b in zip(before, after)), \
+        f"seed {seed}: local lanes stalled: {before} -> {after}"
+    during = _order_and_time(pool, reqs[2], 4)
+    assert during is not None, \
+        f"seed {seed}: pool stopped ordering with the host down"
+    st = sups[remote_idx].supervisor_stats()
+    assert st["fallback_batches"] >= 1, \
+        f"seed {seed}: no fallback recorded on the dead host's lane"
+    assert st["max_stall_s"] <= st["max_budget_s"] + 0.3, \
+        f"seed {seed}: stall {st['max_stall_s']:.2f}s past budget " \
+        f"{st['max_budget_s']:.2f}s"
+    assert pipeline.federation_state()["remote_breakers_open"] == 1
+
+    # heal: the host returns, the probe re-warms (for a real service
+    # client this is the reconnect), the breaker re-closes
+    faulties[remote_idx].heal()
+    waited = 0.0
+    while sups[remote_idx].breaker.state != CLOSED and waited < 30.0:
+        pool.run(1.0)
+        waited += 1.0
+        sups[remote_idx].verify_batch(junk(b"heal%f" % waited))
+    assert sups[remote_idx].breaker.state == CLOSED, \
+        f"seed {seed}: host breaker never re-closed after heal ({kind})"
+    assert faulties[remote_idx].rewarms >= 1, \
+        "host re-admission skipped the re-warm"
+    assert all(s.stats["verdict_forks"] == 0 for s in sups)
+
+    # rejoin: a fresh wave through the ring reaches the host again
+    dev_before = sups[remote_idx].stats["device_batches"]
+    jtok = pipeline.submit_verify(junk(b"rejoin"))
+    jtok.lane_hint = None
+    _move_to_lane(pipeline, jtok, remote_lane)
+    assert pipeline.collect_verify(jtok, wait=True) is not None
+    assert sups[remote_idx].stats["device_batches"] > dev_before, \
+        f"seed {seed}: healed host never re-admitted ring traffic"
+    assert pipeline.federation_state()["remote_breakers_open"] == 0
+    post = _order_and_time(pool, reqs[3], 5)
+    assert post is not None, f"seed {seed}: pool dead after host heal"
+    assert_safety(pool)
+
+
 def run_device_flap_with_commit_wave(seed: int) -> None:
     """device_flap with the fault aimed at the COMMIT-WAVE lane: the
     pool's triple-root recommit (verkle state + ledger + audit) rides a
@@ -1272,6 +1446,22 @@ def test_sim_device_flap_commit_wave_smoke():
     roots keep advancing, the ed lane stays isolated, and the healed
     engine re-admits fresh waves."""
     _run_with_artifacts(run_device_flap_with_commit_wave, 1)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_crypto_host_down_fuzz(bucket):
+    for seed in range(bucket * 3, bucket * 3 + 3):
+        _run_with_artifacts(run_crypto_host_down_scenario, seed)
+
+
+def test_sim_crypto_host_down_smoke():
+    """One crypto_host_down scenario always runs in the default suite:
+    a rostered remote crypto host dies mid-consensus, only its lane's
+    breaker opens, its queued waves steal back to local lanes (never
+    double-verified), ordering holds the deadline budget, and the host
+    re-warms and rejoins."""
+    _run_with_artifacts(run_crypto_host_down_scenario, 2)
 
 
 # 100 seeds, bucketed so failures show their seed range and xdist can split
