@@ -152,6 +152,13 @@ class MetricsName:
     TELEMETRY_SNAPSHOTS = "telemetry.snapshots"
     TELEMETRY_ALERTS = "telemetry.alerts"
     TELEMETRY_SOURCE_ERRORS = "telemetry.source_errors"
+    # autopilot control plane (control/autopilot.py): evaluation passes,
+    # actions taken, undos of earlier actions, and decisions a cooldown
+    # held back — the flap story in four counters
+    AUTOPILOT_DECISIONS = "autopilot.decisions"
+    AUTOPILOT_ACTIONS = "autopilot.actions"
+    AUTOPILOT_REVERTS = "autopilot.reverts"
+    AUTOPILOT_HOLDS = "autopilot.holds"
     # observer read fan-out (ingress/observer_reads.py)
     OBSERVER_PUSHES = "observer.pushes"
     OBSERVER_MS_ADOPTED = "observer.ms_adopted"

@@ -234,6 +234,48 @@ class Config:
     # the whole moved prefix within this budget or the migration ABORTS
     # (descriptors unchanged, source keeps ownership — fail closed)
     RESHARD_COPY_TIMEOUT: float = 120.0
+    # after a migration finishes (DONE or ABORTED) the manager refuses
+    # a new `maybe_split` for this long: a reshard must never chase its
+    # own transient (the just-moved traffic skews the very imbalance
+    # index that would trigger the next one)
+    RESHARD_COOLDOWN: float = 30.0
+
+    # --- autopilot control plane (control/autopilot.py) ---
+    # False (the default) constructs NO autopilot at all: the fabric's
+    # construction seam returns None and every loop pays one `is None`
+    # check — today's behavior exactly, pinned by test
+    AUTOPILOT: bool = False
+    # decision cadence on the AGGREGATOR's fleet clock (seconds): the
+    # autopilot only evaluates when snapshot arrivals have advanced
+    # `aggregator.now` past the next mark, so decisions fire on
+    # aggregator-interval arrivals and a recorded run replays exactly
+    AUTOPILOT_INTERVAL: float = 1.0
+    # how many CONSECUTIVE pool-interval judgments a signal must hold
+    # before the autopilot acts on it (flap hysteresis, the breaker
+    # pattern at fleet scale), and the longer bar an undo/recovery must
+    # clear before an action is reverted
+    AUTOPILOT_SUSTAIN: int = 3
+    AUTOPILOT_RECOVER_SUSTAIN: int = 5
+    # per-(policy, subject) cooldown stamped on every action: the same
+    # policy may not touch the same subject again (including undoing
+    # itself) until the stamp expires — no action/undo pair can fit
+    # inside one cooldown window
+    AUTOPILOT_COOLDOWN: float = 30.0
+    # merges never shrink the fabric below this many shards
+    AUTOPILOT_MIN_SHARDS: int = 2
+    # a shard whose trailing ordered rate falls below mean * this factor
+    # is the under-load merge candidate (only judged while NO shard is
+    # hot, so under-load never fights a split)
+    SHARD_UNDERLOAD_FACTOR: float = 0.25
+    # degradation ladder: level 1 divides every front door's effective
+    # shed watermark by this factor (shed harder), level 2 parks
+    # ordering pool-wide (read-only) — entered only when burn persists
+    # for 2x AUTOPILOT_SUSTAIN despite the reshard/lane/observer
+    # policies, stepped back one level at a time on recovery
+    AUTOPILOT_SHED_FACTOR: int = 4
+    # observer fan-out bounds per region (policy 3)
+    AUTOPILOT_OBSERVER_MIN: int = 1
+    AUTOPILOT_OBSERVER_MAX: int = 4
 
     # --- proof-carrying cross-shard writes (shards/cross_write.py) ---
     # participant lock TTL: a remote shard holding a lock with no

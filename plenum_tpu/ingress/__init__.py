@@ -10,9 +10,9 @@ envelopes from replicated observer state so reads scale horizontally
 without touching consensus quorums. See docs/ingress.md.
 """
 from .controller import IngressController, make_ingress_controller
-from .observer_reads import ObserverReadGate, SimObserver
+from .observer_reads import ObserverFleet, ObserverReadGate, SimObserver
 from .plane import SHED_CLIENT_CAP, SHED_OVERLOAD, IngressPlane
 
 __all__ = ["IngressPlane", "IngressController", "make_ingress_controller",
-           "ObserverReadGate", "SimObserver", "SHED_OVERLOAD",
-           "SHED_CLIENT_CAP"]
+           "ObserverFleet", "ObserverReadGate", "SimObserver",
+           "SHED_OVERLOAD", "SHED_CLIENT_CAP"]

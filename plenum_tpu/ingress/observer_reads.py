@@ -251,3 +251,155 @@ class SimObserver:
 
     def handle_client_message(self, msg: dict, frm: str) -> None:
         self._send(self.gate.serve(msg), frm)
+
+
+class ObserverFleet:
+    """Region-scoped observer read fan-out with a SPAWN/RETIRE seam.
+
+    Observers were statically placed (build once, before traffic); the
+    fleet makes placement an actuator: ``spawn(region)`` boots a fresh
+    ``SimObserver`` over one shard's validator set mid-run and registers
+    it for pushes, ``retire(region)`` deregisters the newest one. The
+    autopilot (control/autopilot.py) drives both from read-latency burn.
+
+    The capacity model is deliberately explicit: each observer serves
+    ``capacity`` reads per telemetry interval; reads beyond the region's
+    pooled capacity count as read-SLO violations. ``service()`` (called
+    from the fabric's prod loop) drains the validators' push outboxes
+    into the member observers and rolls each region's (violations, total)
+    ledger into the aggregator's ``("reads", region)`` burn tracker — so
+    regional read burn rides the SAME multi-window burn-rate rule as the
+    ingress/batch SLOs and is visible to ``sustained()``.
+    """
+
+    def __init__(self, fabric, regions=("r0",), sid: int = 0,
+                 per_region: int = 1, capacity: float = 64.0, f: int = 1):
+        self.fabric = fabric
+        self.sid = sid
+        self.capacity = float(capacity)
+        self.f = f
+        self.regions: dict[str, list[SimObserver]] = \
+            {r: [] for r in regions}
+        self._interval = getattr(fabric.config, "TELEMETRY_INTERVAL", 1.0)
+        self._window_start = fabric.timer.get_current_time()
+        self._served = {r: 0 for r in regions}
+        self._viol = {r: 0 for r in regions}
+        self._last_served = {r: 0 for r in regions}
+        self._rr = {r: 0 for r in regions}
+        self._retired_ids: set = set()
+        self._n = 0
+        self.stats = {"spawned": 0, "retired": 0, "reads": 0,
+                      "violations": 0}
+        for r in regions:
+            for _ in range(per_region):
+                self.spawn(r)
+
+    def _shard(self):
+        return self.fabric.shards[self.sid]
+
+    # --- the spawn/retire seam --------------------------------------------
+
+    def spawn(self, region: str) -> str:
+        """Boot one more observer for `region` over the anchored shard's
+        validators; it replicates from the NEXT committed batch on (the
+        capacity model, not the replicated prefix, is what the read-burn
+        policy scales)."""
+        from plenum_tpu.tools.local_pool import pool_bls_keys
+        shard = self._shard()
+        self._n += 1
+        name = f"{region}-obs{self._n}"
+        obs = SimObserver(
+            name, shard.genesis, shard.names, pool_bls_keys(shard.names),
+            now=self.fabric.timer.get_current_time, f=self.f,
+            anchor_lag_max=None)
+        obs.register(lambda v, msg: shard.nodes[v]
+                     .handle_client_message(msg, obs.client_id))
+        self.regions[region].append(obs)
+        self.stats["spawned"] += 1
+        return name
+
+    def retire(self, region: str) -> Optional[str]:
+        """Deregister the newest observer of `region` (LIFO — the
+        longest-lived replica keeps serving); never below one."""
+        group = self.regions[region]
+        if len(group) <= 1:
+            return None
+        obs = group.pop()
+        self._retired_ids.add(obs.client_id)
+        for node in self._shard().nodes.values():
+            observable = getattr(node, "observable", None)
+            if observable is not None:
+                observable.remove_observer(obs.client_id)
+        self.stats["retired"] += 1
+        return obs.name
+
+    def count(self, region: str) -> int:
+        return len(self.regions[region])
+
+    # --- the pump ----------------------------------------------------------
+
+    def service(self) -> None:
+        """Drain validator push outboxes into member observers, drop
+        retired observers' in-flight pushes, roll the read-SLO window."""
+        shard = self._shard()
+        by_id = {obs.client_id: obs
+                 for group in self.regions.values() for obs in group}
+        for v in shard.names:
+            msgs = shard.client_msgs[v]
+            keep = []
+            for m, cid in msgs:
+                obs = by_id.get(cid)
+                if obs is not None:
+                    if isinstance(m, BatchCommitted):
+                        obs.deliver_push(m, v)
+                    # non-push traffic to an observer (OBSERVER_ACK)
+                    # just drains
+                elif cid not in self._retired_ids:
+                    keep.append((m, cid))
+            shard.client_msgs[v] = keep
+        self._roll_window()
+
+    def _roll_window(self) -> None:
+        now = self.fabric.timer.get_current_time()
+        if now - self._window_start < self._interval:
+            return
+        self._window_start = now
+        agg = self.fabric.aggregator
+        for region in self.regions:
+            n = self._served[region]
+            self._last_served[region] = n
+            if n:
+                agg.tracker("reads", region).note(
+                    now, self._viol[region], n)
+            self._served[region] = 0
+            self._viol[region] = 0
+
+    # --- read serving -------------------------------------------------------
+
+    def serve_read(self, region: str, msg: dict):
+        """One client read through the region's pool: round-robin over
+        members, over-capacity reads ledger an SLO violation."""
+        group = self.regions[region]
+        i = self._rr[region] % len(group)
+        self._rr[region] = i + 1
+        self._served[region] += 1
+        self.stats["reads"] += 1
+        if self._served[region] > self.capacity * len(group):
+            self._viol[region] += 1
+            self.stats["violations"] += 1
+        return group[i].gate.serve(msg)
+
+    def scale_in_safe(self, region: str, margin: float = 0.5) -> bool:
+        """True when the last completed window's demand fits in the
+        region MINUS one observer with 1/margin headroom — the guard
+        that keeps a retire from immediately re-triggering read burn."""
+        group = self.regions[region]
+        if len(group) <= 1:
+            return False
+        return self._last_served.get(region, 0) <= \
+            margin * self.capacity * (len(group) - 1)
+
+    def summary(self) -> dict:
+        return {"regions": {r: len(g) for r, g in
+                            sorted(self.regions.items())},
+                **self.stats}

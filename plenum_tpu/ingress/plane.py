@@ -76,6 +76,7 @@ class IngressPlane:
         self._weights: dict[str, int] = {}
         self._total = 0
         self._shedding = False          # watermark hysteresis latch
+        self._forced_watermark = None   # autopilot ladder clamp
         self._inflight = None           # (token, entries, polls)
 
         from .controller import make_ingress_controller
@@ -121,8 +122,18 @@ class IngressPlane:
         """Dequeues granted to `client` per fair-rotation pass (default 1)."""
         self._weights[client] = max(1, int(weight))
 
+    def force_shed_watermark(self, value) -> None:
+        """Orchestrated degradation (the autopilot ladder's shed-harder
+        step): clamp the effective shed watermark to `value`, overriding
+        both the static config mark and the AIMD controller's steering.
+        None releases the clamp (back to controller/config)."""
+        self._forced_watermark = None if value is None \
+            else max(1, int(value))
+
     @property
     def shed_watermark(self) -> int:
+        if self._forced_watermark is not None:
+            return self._forced_watermark
         if self.controller is not None:
             return self.controller.shed_watermark
         return self.config.INGRESS_HIGH_WATERMARK

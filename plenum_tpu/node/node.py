@@ -357,6 +357,7 @@ class Node:
         self._catchup_kicks = 0
         self._diverged_rounds = 0
         self.read_only_degraded = False
+        self._read_only_reason: Optional[str] = None
         self._catchup_watchdog_timer = RepeatingTimer(
             timer, self.config.CATCHUP_WATCHDOG_INTERVAL,
             self._catchup_watchdog)
@@ -1353,11 +1354,38 @@ class Node:
         if self.read_only_degraded:
             return
         self.read_only_degraded = True
+        self._read_only_reason = "catchup_diverged"
         self.metrics.add_event(MetricsName.CATCHUP_DEGRADED, 1)
         self.spylog.append(("degraded_read_only", None))
         if self.tracer.enabled:
             self.tracer.anomaly("degraded_read_only",
                                 {"diverged_rounds": self._diverged_rounds})
+
+    def set_read_only(self, on: bool, reason: str = "autopilot") -> bool:
+        """Orchestrated degradation (the autopilot's ladder, level 2):
+        park/unpark read-only mode EXTERNALLY. Entering is refused while
+        catchup divergence already parked the node (that state is not
+        the orchestrator's to own); leaving only clears a read-only the
+        SAME reason entered — a catchup-diverged node can never be
+        un-degraded by a recovering autopilot. Returns True when the
+        state changed."""
+        if on:
+            if self.read_only_degraded:
+                return False
+            self.read_only_degraded = True
+            self._read_only_reason = reason
+            self.spylog.append(("degraded_read_only", reason))
+            if self.tracer.enabled:
+                self.tracer.anomaly("degraded_read_only",
+                                    {"reason": reason})
+            return True
+        if not self.read_only_degraded \
+                or getattr(self, "_read_only_reason", None) != reason:
+            return False
+        self.read_only_degraded = False
+        self._read_only_reason = None
+        self.spylog.append(("undegraded_read_only", reason))
+        return True
 
     def start_catchup(self) -> None:
         """Pause ordering, revert uncommitted work, sync all ledgers

@@ -186,6 +186,17 @@ class FleetAggregator:
         self.latest: dict[str, dict] = {}
         self._ordered: dict[str, deque] = {}
         self._node_shard: dict[str, Optional[int]] = {}
+        # judgment streaks, noted ONCE per pool interval: consecutive
+        # active / consecutive clear counts per (kind, subject), so
+        # `sustained(kind, N)` means N consecutive INTERVALS over
+        # threshold — the one definition the autopilot and tests share
+        # instead of re-deriving "sustained" ad hoc from raw burn values
+        self._streaks: dict[tuple[str, str], int] = {}
+        self._clear_streaks: dict[tuple[str, str], int] = {}
+        # the autopilot (control/autopilot.py) publishes its live
+        # summary here so the fleet console renders it off the same
+        # aggregator handle it already holds; None = no autopilot
+        self.autopilot: Optional[dict] = None
 
     # --- intake -----------------------------------------------------------
 
@@ -230,6 +241,9 @@ class FleetAggregator:
             del self.burn[key]
         for key in [k for k in self._latched if k[1] == node]:
             self._latched[key] = None
+        for store in (self._streaks, self._clear_streaks):
+            for key in [k for k in store if k[1] == node]:
+                del store[key]
 
     # --- judgments ---------------------------------------------------------
 
@@ -338,6 +352,85 @@ class FleetAggregator:
         threshold = getattr(self.config, "SHARD_IMBALANCE_THRESHOLD", 1.5)
         return round(index, 3), (hot_sid if index >= threshold else None)
 
+    def cold_shard(self, rates: Optional[dict[int, float]] = None
+                   ) -> Optional[int]:
+        """The under-load merge candidate: the shard whose trailing
+        ordered rate fell below mean * SHARD_UNDERLOAD_FACTOR. None
+        until at least two shards report with a positive mean — an idle
+        pool is balanced, not under-loaded."""
+        if rates is None:
+            rates = self.ordered_rates()
+        if len(rates) < 2:
+            return None
+        mean = sum(rates.values()) / len(rates)
+        if mean <= 0:
+            return None
+        cold_sid, cold_rate = min(rates.items(), key=lambda kv: kv[1])
+        factor = getattr(self.config, "SHARD_UNDERLOAD_FACTOR", 0.25)
+        return cold_sid if cold_rate < mean * factor else None
+
+    def lane_breakers(self) -> dict[int, bool]:
+        """Pipeline lane -> any node's latest snapshot reports that
+        chip's breaker not closed (the `pipeline.devices` state section;
+        remote federation lanes report through the same gauges)."""
+        out: dict[int, bool] = {}
+        for snap in self.latest.values():
+            devices = snap.get("state", {}).get("pipeline", {}) \
+                .get("devices") or []
+            for dev in devices:
+                lane = dev.get("lane")
+                if lane is None:
+                    continue
+                sick = dev.get("breaker") not in (None, "none", "closed")
+                out[lane] = out.get(lane, False) or sick
+        return out
+
+    # --- sustained judgments (the autopilot's input) -------------------------
+
+    def tracker(self, kind: str, subject: str) -> BurnRateTracker:
+        """Get-or-create the burn tracker for (kind, subject) — the
+        seam external read planes (the observer fleet) feed their SLO
+        ledgers through; its judgments join the streak notes and the
+        `slo_burn.<kind>` sustained queries automatically."""
+        return self.burn.setdefault((kind, subject), self._mk_burn())
+
+    def _note_judgment(self, key: tuple[str, str], active: bool) -> None:
+        if active:
+            self._streaks[key] = self._streaks.get(key, 0) + 1
+            self._clear_streaks[key] = 0
+        else:
+            self._clear_streaks[key] = self._clear_streaks.get(key, 0) + 1
+            self._streaks[key] = 0
+
+    def sustained(self, kind: str, intervals: int,
+                  subject: Optional[str] = None) -> bool:
+        """True when the (kind, subject) judgment has held ACTIVE for at
+        least `intervals` CONSECUTIVE pool intervals. subject=None asks
+        whether ANY subject of that kind is sustained."""
+        if subject is not None:
+            return self._streaks.get((kind, subject), 0) >= intervals
+        return any(n >= intervals for (k, _s), n in self._streaks.items()
+                   if k == kind)
+
+    def sustained_subjects(self, kind: str, intervals: int) -> list[str]:
+        """Every subject of `kind` currently sustained — the evidence
+        list an autopilot decision records."""
+        return sorted(s for (k, s), n in self._streaks.items()
+                      if k == kind and n >= intervals)
+
+    def sustained_clear(self, kind: str, intervals: int,
+                        subject: Optional[str] = None) -> bool:
+        """True when the judgment has held CLEAR for `intervals`
+        consecutive pool intervals — subject=None requires EVERY
+        ever-noted subject of the kind to be clear (vacuously true when
+        none was ever noted)."""
+        if subject is not None:
+            return self._clear_streaks.get((kind, subject), 0) >= intervals
+        keys = {k for k in (*self._streaks, *self._clear_streaks)
+                if k[0] == kind}
+        return all(self._clear_streaks.get(k, 0) >= intervals
+                   for k in keys)
+
     def mapping_epochs(self) -> dict[int, int]:
         """shard id -> the MIN mapping epoch its members report (the
         `shard_map` telemetry state section) — the laggard is what an
@@ -443,11 +536,25 @@ class FleetAggregator:
                                  2)},
                             severity="warn")
         # shard imbalance: the flag clears as the rates re-balance
-        index, hot = self.load_imbalance()
+        rates = self.ordered_rates()
+        index, hot = self.load_imbalance(rates)
         if index is not None:
             self._raise(("shard.imbalance", "pool"), hot is not None, t,
                         {"index": index, "hot_shard": hot},
                         severity="warn")
+        # judgment streaks for sustained(): one note per pool interval
+        self._note_judgment(("shard.imbalance", "pool"),
+                            index is not None and hot is not None)
+        # under-load is only judged while NO shard is hot, so a merge
+        # streak can never accumulate while a split is warranted
+        cold = self.cold_shard(rates)
+        self._note_judgment(("shard.underload", "pool"),
+                            hot is None and cold is not None)
+        for (kind, node), tracker in self.burn.items():
+            self._note_judgment((f"slo_burn.{kind}", node),
+                                tracker.alerting(t))
+        for lane, open_ in self.lane_breakers().items():
+            self._note_judgment(("pipeline.lane", str(lane)), open_)
 
     def active_alerts(self) -> list[Alert]:
         return [a for a in self._latched.values() if a is not None]

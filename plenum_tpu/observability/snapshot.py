@@ -161,6 +161,10 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
         MetricsName.TELEMETRY_SNAPSHOTS, MetricsName.TELEMETRY_ALERTS,
         MetricsName.TELEMETRY_SOURCE_ERRORS,
     }),
+    "autopilot": frozenset({
+        MetricsName.AUTOPILOT_DECISIONS, MetricsName.AUTOPILOT_ACTIONS,
+        MetricsName.AUTOPILOT_REVERTS, MetricsName.AUTOPILOT_HOLDS,
+    }),
 }
 
 # MetricsNames deliberately OUTSIDE the fleet view, with the reason the

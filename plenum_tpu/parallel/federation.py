@@ -154,6 +154,19 @@ class FederatedCryptoPipeline(MultiDeviceCryptoPipeline):
         # serve unhinted overflow and stolen work
         return tag % self.n_local
 
+    def healthy_lane(self, exclude=()) -> Optional[int]:
+        # the autopilot's re-placement target keeps the same local-only
+        # pin discipline as place(): a shard re-pinned off a sick chip
+        # lands on another LOCAL chip, never a WAN lane — remote
+        # capacity stays overflow/steal-only. Falls back to any healthy
+        # remote only when every local lane is excluded or degraded.
+        skip = set(exclude)
+        local = [l for l in self.lanes[:self.n_local]
+                 if not l.degraded() and l.idx not in skip]
+        if local:
+            return min(local, key=lambda l: (l.occupancy(), l.idx)).idx
+        return super().healthy_lane(exclude)
+
     def _pick_lane(self, hint: Optional[int]) -> _DeviceLane:
         if hint is not None:
             return self.lanes[hint % self.n_local]

@@ -1402,6 +1402,18 @@ class MultiDeviceCryptoPipeline(CryptoPipeline):
     def place(self, tag: int) -> Optional[int]:
         return tag % len(self.lanes)
 
+    def healthy_lane(self, exclude=()) -> Optional[int]:
+        """The least-backlogged lane whose breaker is closed, skipping
+        `exclude` — the re-placement target the autopilot pins a sick
+        chip's shards to (the ring itself never reshuffles pinned
+        traffic; re-pinning is the EXTERNAL control plane's move)."""
+        skip = set(exclude)
+        pool = [l for l in self.lanes
+                if not l.degraded() and l.idx not in skip]
+        if not pool:
+            return None
+        return min(pool, key=lambda l: (l.occupancy(), l.idx)).idx
+
     def _pick_lane(self, hint: Optional[int]) -> _DeviceLane:
         if hint is not None:
             # pinned submitters STAY pinned: a degraded lane serves its
@@ -1709,6 +1721,17 @@ class PipelineVerifier(Ed25519Verifier):
         self._pipeline = pipeline
         self._lane = lane
         self._inner = pipeline._ed_inner
+
+    @property
+    def lane(self) -> Optional[int]:
+        return self._lane
+
+    def repin(self, lane: Optional[int]) -> None:
+        """Move this submitter's placement pin — the autopilot's lane
+        re-placement actuator. Staged/in-flight waves finish on the old
+        lane; only FUTURE submissions land on the new one (no wave is
+        ever torn out of a queue mid-dispatch)."""
+        self._lane = lane
 
     # last-attached node collector seam (node/__init__ assigns .metrics on
     # whatever verifier the authenticator holds): route it to the pipeline

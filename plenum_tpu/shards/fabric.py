@@ -158,6 +158,9 @@ class ShardedSimFabric:
             self.pipeline = CryptoPipeline(ed_inner=CpuEd25519Verifier(),
                                            config=self.config)
         self.shards: dict[int, SimShard] = {}
+        # shard id -> current pipeline lane pin (the autopilot's lane
+        # re-placement reads and rewrites these through repin_shard_lane)
+        self.lane_pins: dict[int, Optional[int]] = {}
         # kept for live splits: a pre-registered verifier for a future
         # shard id (add_shard looks the new sid up here, so a split
         # target can join the same faultable crypto plane)
@@ -165,12 +168,13 @@ class ShardedSimFabric:
         for sid in range(n_shards):
             # shard_verifiers: {sid: shared crypto plane} — the seam the
             # shard-confined device_flap fuzz faults ONE shard through
+            self.lane_pins[sid] = self._shard_lane(sid)
             shard = SimShard(sid, shard_node_names(sid, nodes_per_shard),
                              self.timer, seed * 1009 + sid * 7919 + 3,
                              self.config, pipeline=self.pipeline,
                              tracing=tracing,
                              verifier=self.shard_verifiers.get(sid),
-                             pipeline_lane=self._shard_lane(sid))
+                             pipeline_lane=self.lane_pins[sid])
             if latency is not None:
                 shard.net.set_latency(*latency)
             self.shards[sid] = shard
@@ -235,6 +239,16 @@ class ShardedSimFabric:
         self.reshard = ReshardManager(self)
         self.stale_nacks: list = []
         self._xsw = None
+        # every front door built through ingress_plane(), so the
+        # autopilot's degradation ladder can clamp them all; the
+        # optional region-scoped observer fleet (attach_observer_fleet)
+        self.ingress_planes: list = []
+        self.observers = None
+        # the autopilot control plane (control/autopilot.py): None
+        # unless AUTOPILOT=True — the disabled cost is one `is None`
+        # check per prod, pinned by the identity test
+        from plenum_tpu.control import make_autopilot
+        self.autopilot = make_autopilot(self)
 
     @property
     def nodes(self) -> dict:
@@ -304,6 +318,32 @@ class ShardedSimFabric:
             return None
         return self.pipeline.place(sid)
 
+    def repin_shard_lane(self, sid: int, lane) -> Optional[int]:
+        """Move shard `sid`'s pipeline pin to `lane` on every member
+        node's verifier — the autopilot's lane re-placement actuator.
+        In-flight waves finish where they were staged; only future
+        submissions land on the new chip. Returns the previous pin."""
+        prev = self.lane_pins.get(sid)
+        self.lane_pins[sid] = lane
+        shard = self.shards.get(sid)
+        if shard is None:
+            return prev
+        for node in shard.nodes.values():
+            verifier = getattr(node.c.authenticator.core_authenticator,
+                               "verifier", None)
+            repin = getattr(verifier, "repin", None)
+            if callable(repin):
+                repin(lane)
+        return prev
+
+    def attach_observer_fleet(self, regions=("r0",), **kw):
+        """Build the region-scoped observer fleet (spawn/retire seam,
+        ingress/observer_reads.py) and service it from the prod loop;
+        the autopilot's read-burn policy scales it per region."""
+        from plenum_tpu.ingress import ObserverFleet
+        self.observers = ObserverFleet(self, regions=regions, **kw)
+        return self.observers
+
     def _wire_shard_telemetry(self, sid: int, shard: "SimShard") -> None:
         for node in shard.nodes.values():
             if node.telemetry.enabled:
@@ -327,12 +367,13 @@ class ShardedSimFabric:
         for its sid in `shard_verifiers` (or passed here), so a split
         target is not silently outside the configured crypto plane."""
         n = nodes_per_shard or self.nodes_per_shard
+        self.lane_pins[sid] = self._shard_lane(sid)
         shard = SimShard(sid, shard_node_names(sid, n), self.timer,
                          self.seed * 1009 + sid * 7919 + 3, self.config,
                          pipeline=self.pipeline, tracing=self.tracing,
                          verifier=verifier
                          or self.shard_verifiers.get(sid),
-                         pipeline_lane=self._shard_lane(sid))
+                         pipeline_lane=self.lane_pins[sid])
         if self.latency is not None:
             shard.net.set_latency(*self.latency)
         self.shards[sid] = shard
@@ -353,6 +394,7 @@ class ShardedSimFabric:
         if shard is None:
             return
         self.retired[sid] = shard
+        self.lane_pins.pop(sid, None)
         self.router.remove_sink(sid)
         self.ingress_router.remove_sink(sid)
         for name, node in shard.nodes.items():
@@ -389,7 +431,9 @@ class ShardedSimFabric:
                     identifier=request.identifier, req_id=request.req_id,
                     reason="no shard owns this key"), frm)
 
-        return IngressPlane(node, sink=sink, **kw)
+        plane = IngressPlane(node, sink=sink, **kw)
+        self.ingress_planes.append(plane)
+        return plane
 
     def cross_writes(self):
         """The fabric's proof-carrying cross-shard write manager
@@ -404,6 +448,10 @@ class ShardedSimFabric:
     def prod_all(self) -> None:
         self.timer.service()
         self.reshard.service()
+        if self.observers is not None:
+            self.observers.service()
+        if self.autopilot is not None:
+            self.autopilot.service()
         for shard in list(self.shards.values()):
             shard.prod()
 
@@ -413,6 +461,10 @@ class ShardedSimFabric:
         elapsed = 0.0
         while elapsed < seconds:
             self.reshard.service()
+            if self.observers is not None:
+                self.observers.service()
+            if self.autopilot is not None:
+                self.autopilot.service()
             for shard in list(self.shards.values()):
                 shard.prod()
             self.timer.advance(step)
@@ -570,6 +622,10 @@ class ShardedSimFabric:
             "hot_shard": hot,
             "reshard": self.reshard.summary(),
             "stale_nacks": len(self.stale_nacks),
+            **({"autopilot": self.autopilot.summary()}
+               if self.autopilot is not None else {}),
+            **({"observers": self.observers.summary()}
+               if self.observers is not None else {}),
             **({"cross_writes": self._xsw.summary()}
                if self._xsw is not None else {}),
             "alerts": [a.to_dict() for a in self.aggregator.alerts[-20:]],

@@ -128,8 +128,23 @@ class ReshardManager:
         self.active: Optional[Migration] = None
         self.history: list[Migration] = []
         self._in_service = False
+        # stamped when a migration finishes (DONE or ABORTED): the
+        # idempotent entry guard below refuses a fresh `maybe_split`
+        # until it expires, so a reshard never chases its own transient
+        self.cooldown_until = 0.0
 
     # --- planning ----------------------------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        return self.active is not None
+
+    def can_start(self) -> bool:
+        """The idempotent entry guard: a second caller during an
+        in-flight migration, or any caller inside the post-migration
+        cooldown, gets a clean no-op instead of the double-entry
+        assert — external discipline is no longer what prevents it."""
+        return self.active is None and self._now() >= self.cooldown_until
 
     def maybe_split(self, nodes_per_shard: Optional[int] = None
                     ) -> Optional[Migration]:
@@ -138,7 +153,7 @@ class ReshardManager:
         (the recent ledger's routing-key points) onto a new sub-pool —
         a geometric midpoint would halve the keyspace, not the traffic,
         and a skewed key population would stay flagged after the split."""
-        if self.active is not None:
+        if not self.can_start():
             return None
         _index, hot = self.fabric.aggregator.load_imbalance()
         if hot is None or hot not in self.fabric.shards:
@@ -260,6 +275,8 @@ class ReshardManager:
             self.fabric.retire_shard(m.source)
         self.history.append(m)
         self.active = None
+        self.cooldown_until = now + getattr(self.config,
+                                            "RESHARD_COOLDOWN", 30.0)
 
     def _ratchet(self, m: Migration) -> None:
         """The commit point: publish the new map under a bumped epoch."""
@@ -303,6 +320,8 @@ class ReshardManager:
             self.fabric.retire_shard(m.target)
         self.history.append(m)
         self.active = None
+        self.cooldown_until = self._now() + getattr(
+            self.config, "RESHARD_COOLDOWN", 30.0)
 
     # --- the copy cursor ---------------------------------------------------
 
@@ -426,7 +445,8 @@ class ReshardManager:
     def summary(self) -> dict:
         out = {"epoch": self.fabric.mapping.epoch,
                "migrations": len(self.history)
-               + (1 if self.active else 0)}
+               + (1 if self.active else 0),
+               "cooldown_until": round(self.cooldown_until, 3)}
         if self.active is not None:
             out["active"] = self.active.to_dict()
         if self.history:

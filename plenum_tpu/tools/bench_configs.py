@@ -1857,6 +1857,119 @@ def config13_commitment(page_size: int = 16, n_dids: int = 48,
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def config18_autopilot(n_users: int = 320, phase_s: float = 20.0) -> dict:
+    """Hands-off heal of the config12 zipfian hot-range flood
+    (docs/robustness.md "Autopilot"): the SAME 2-shard fabric and
+    90%-hot workload, but ``AUTOPILOT=True`` and the driver never
+    touches the control plane — no ``maybe_split`` call, no lane
+    pokes, zero test-driven actuation. The autopilot's reshard policy
+    must flag the sustained imbalance on its own cadence and live-
+    split the hot range UNDER the flood (possibly already inside the
+    first phase: the control plane acts as soon as the signal
+    sustains, it does not wait for the driver's phase boundaries).
+
+    * pre/post aggregate TPS and the recovery ratio — the acceptance
+      gate is post >= 0.8 * pre, same bar as config12;
+    * the control ledger (reserved CONTROL_LEDGER_ID txns) with the
+      split decision's seq/time and its full audit
+      (tools/control_audit.py) — must lint clean;
+    * the migration ledger, exactly as config12 reports it.
+    """
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.config import Config
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import NYM
+    from plenum_tpu.shards import ShardedSimFabric
+    from plenum_tpu.tools.control_audit import audit_records
+
+    try:
+        # generous batch/ingress SLOs: this run grades the RESHARD
+        # policy end-to-end; the degradation ladder has its own fuzz
+        # scenario and must not park the pool read-only over sim-time
+        # batching noise mid-split
+        config = Config(Max3PCBatchWait=0.05, TELEMETRY_INTERVAL=0.5,
+                        SLO_BURN_SLOW_WINDOW=30.0,
+                        STATE_FRESHNESS_UPDATE_INTERVAL=600.0,
+                        AUTOPILOT=True, AUTOPILOT_INTERVAL=0.5,
+                        BATCH_SLO_P95=30.0, INGRESS_SLO_P95=30.0)
+        fab = ShardedSimFabric(n_shards=2, nodes_per_shard=3, seed=23,
+                               config=config)
+        hot, cold = [], []
+        i = 0
+        while (len(hot) < n_users or len(cold) < n_users // 6) \
+                and i < 12 * n_users:
+            i += 1
+            u = Ed25519Signer(seed=(b"rz%08d" % i).ljust(32, b"\0")[:32])
+            req = Request(fab.trustee.identifier, i,
+                          {"type": NYM, "dest": u.identifier,
+                           "verkey": u.verkey_b58})
+            req.signature = fab.trustee.sign_b58(req.signing_bytes())
+            (hot if fab.router.shard_of(req) == 0 else cold).append(req)
+
+        cursor = {"h": 0, "c": 0, "n": 0}
+
+        def drive(seconds: float) -> float:
+            t0 = fab.timer.get_current_time()
+            base = sum(s.ordered_count() for s in fab.shards.values())
+            steps = int(seconds / 0.25)
+            for k in range(steps):
+                cursor["n"] += 1
+                if cursor["n"] % 10 and cursor["h"] < len(hot):
+                    fab.submit_write(hot[cursor["h"]])
+                    cursor["h"] += 1
+                elif cursor["c"] < len(cold):
+                    fab.submit_write(cold[cursor["c"]])
+                    cursor["c"] += 1
+                fab.run(0.25)
+                fab.ordered_counts()
+            dt = fab.timer.get_current_time() - t0
+            done = sum(s.ordered_count()
+                       for s in fab.shards.values()) - base
+            return round(done / dt, 2) if dt else 0.0
+
+        pre_tps = drive(phase_s)               # flood onset
+        index_flood, hot_sid = fab.aggregator.load_imbalance()
+        during_tps = drive(phase_s)            # autopilot acts in here
+        elapsed = 0.0                          # run any migration out
+        while fab.reshard.active is not None and elapsed < 120.0:
+            fab.run(0.5)
+            elapsed += 0.5
+        post_tps = drive(2 * phase_s)          # post-heal steady state
+        index_after, hot_after = fab.aggregator.load_imbalance()
+        records = fab.autopilot.ledger.to_dicts()
+        splits = [r for r in records if r["action"] == "split"]
+        if not splits:
+            return {"error": "the autopilot never split the hot shard "
+                             f"(imbalance={index_flood}, "
+                             f"records={len(records)})"}
+        m = fab.reshard.history[0] if fab.reshard.history else None
+        return {
+            "pre_tps": pre_tps,
+            "during_tps": during_tps,
+            "post_tps": post_tps,
+            "recovery_ratio": round(post_tps / pre_tps, 2)
+            if pre_tps else None,
+            "imbalance_flood": index_flood,
+            "hot_shard_flagged": hot_sid,
+            "imbalance_after": index_after,
+            "hot_shard_after": hot_after,
+            "test_driven_actuations": 0,       # by construction
+            "split_seq": splits[0]["seq"],
+            "split_t": splits[0]["t"],
+            "split_evidence": splits[0]["evidence"],
+            "control_records": len(records),
+            "control_holds": sum(1 for r in records
+                                 if r["action"] == "hold"),
+            "audit_problems": audit_records(records),
+            "migration": m.to_dict() if m is not None else None,
+            "epoch": fab.mapping.epoch,
+            "shards_after": len(fab.shards),
+            "autopilot": fab.autopilot.summary(),
+        }
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     for name, fn in (("config1b", config1b_distinct_signers),
                      ("config2", config2_three_instances_mixed),
@@ -1871,7 +1984,8 @@ def main():
                      ("config12", config12_reshard),
                      ("config13", config13_commitment),
                      ("config16", config16_ordered_path),
-                     ("config17", config17_federation)):
+                     ("config17", config17_federation),
+                     ("config18", config18_autopilot)):
         print(name, json.dumps(fn()), flush=True)
 
 

@@ -151,6 +151,17 @@ def render(agg, incidents, last_n: int = 5) -> str:
             + (f", DARK: {', '.join(dark)}" if dark else ""))
     if remote_lines:
         lines.append("  REMOTE LANES: " + "; ".join(remote_lines))
+    # autopilot control plane: what the closed loop has decided — level,
+    # action/revert/hold counts, and any live lane re-pins, so an
+    # operator can tell actuation from drift at a glance
+    ap = getattr(agg, "autopilot", None)
+    if ap:
+        lines.append(
+            f"  AUTOPILOT: level={ap.get('state', '?')} "
+            f"decisions={ap.get('decisions', 0)} "
+            f"actions={ap.get('actions', 0)} "
+            f"reverts={ap.get('reverts', 0)} holds={ap.get('holds', 0)}"
+            + (f" repins={ap.get('repins')}" if ap.get("repins") else ""))
     for kind, per_node in s["burn"].items():
         burning = {n: b for n, b in per_node.items()
                    if b["fast"] > 0 or b["slow"] > 0}
@@ -299,6 +310,18 @@ def self_check() -> int:
     elif "/run/ch0.sock=open" not in text or "steals=3" not in text:
         problems.append("console did not name the dark remote host "
                         "or its steal traffic")
+
+    # 3d) autopilot seam: when the control plane published a summary,
+    # the console renders the AUTOPILOT line (level + counts + repins)
+    agg3d = FleetAggregator(config=config)
+    agg3d.ingest(healthy("N1", 0, 0.0))
+    agg3d.autopilot = {"level": 1, "state": "shed_harder",
+                       "decisions": 12, "actions": 3, "reverts": 1,
+                       "holds": 2, "repins": {0: {"prev": 0, "sick": 2}}}
+    text = render(agg3d, [])
+    if "AUTOPILOT: level=shed_harder" not in text \
+            or "actions=3" not in text or "repins=" not in text:
+        problems.append("console did not render the autopilot line")
 
     # 4) hot shard: skewed ordered rates flag shard 0
     agg4 = FleetAggregator(config=config)

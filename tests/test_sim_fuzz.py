@@ -2664,3 +2664,418 @@ def test_sim_membership_churn_dir_rotation_fuzz(bucket):
 
 def test_sim_membership_churn_dir_rotation_smoke():
     _run_with_artifacts(run_membership_churn_dir_rotation_scenario, 1)
+
+
+# --- autopilot: the control plane under composed stress ----------------------
+# The `autopilot` fuzz kind: telemetry -> actuation closed-loop
+# (control/autopilot.py). Every scenario runs with ZERO test-driven
+# actuation — the test injects load and faults, the autopilot alone
+# splits, re-pins, scales and degrades — and every run must leave a
+# control ledger that AUDITS CLEAN (tools/control_audit.py): the pinned
+# no-flap property (no action/undo pair inside one cooldown window, no
+# oscillating split/merge), every action evidenced, every undo citing
+# its action.
+
+def _autopilot_config(**over):
+    cfg = dict(FAST)
+    cfg.update(AUTOPILOT=True, AUTOPILOT_INTERVAL=0.5,
+               AUTOPILOT_SUSTAIN=2, AUTOPILOT_RECOVER_SUSTAIN=3,
+               AUTOPILOT_COOLDOWN=6.0, RESHARD_COOLDOWN=6.0,
+               TELEMETRY_INTERVAL=0.5, SLO_BURN_FAST_WINDOW=2.0,
+               SLO_BURN_SLOW_WINDOW=6.0)
+    cfg.update(over)
+    return Config(**cfg)
+
+
+def _autopilot_audit(ap, seed: int) -> list[dict]:
+    from plenum_tpu.tools.control_audit import audit_records
+    recs = ap.ledger.to_dicts()
+    problems = audit_records(recs)
+    assert problems == [], \
+        f"seed {seed}: control ledger failed its audit: {problems}"
+    return recs
+
+
+def _supervised_lanes(rng, n_lanes):
+    from plenum_tpu.crypto.ed25519 import CpuEd25519Verifier
+    from plenum_tpu.parallel.faults import FaultyVerifier
+    from plenum_tpu.parallel.supervisor import (CircuitBreaker,
+                                                DeadlineBudget,
+                                                SupervisedVerifier)
+    faulties, sups = [], []
+    for k in range(n_lanes):
+        faulty = FaultyVerifier(CpuEd25519Verifier())
+        sup = SupervisedVerifier(
+            faulty, fallback=CpuEd25519Verifier(),
+            breaker=CircuitBreaker(fail_threshold=2,
+                                   cooldown=rng.float(0.5, 1.5)),
+            budget=DeadlineBudget(base=rng.float(0.3, 0.6), min_s=0.2,
+                                  warm_max=1.0, cold_max=1.0),
+            label=f"lane{k}")
+        faulties.append(faulty)
+        sups.append(sup)
+    return faulties, sups
+
+
+def _junk(tag: bytes, seed: int, n: int = 3):
+    return [(b"%s-%d-%d" % (tag, seed, i), b"\x01" * 63 + b"\x00",
+             bytes([i % 250 + 1]) * 32) for i in range(n)]
+
+
+def run_autopilot_split_scenario(seed: int) -> None:
+    """Zipfian flood onto shard 0: the autopilot's SUSTAINED imbalance
+    judgment must drive maybe_split on its own, the migration completes
+    exactly-once, and the ledger shows ONE split (evidence + pre/post
+    shard state) with no merge chasing it."""
+    from plenum_tpu.shards import ShardedSimFabric
+    from test_shards import signed_write, user_on_shard
+
+    rng = SimRandom(seed * 93179 + 3)
+    fab = _track(ShardedSimFabric(n_shards=2, nodes_per_shard=3,
+                                  seed=seed, config=_autopilot_config()))
+    ap = fab.autopilot
+    assert ap is not None
+
+    writes, rid = [], 0
+    for k in range(10 + rng.integer(0, 4)):
+        sid = 1 if k % 8 == 7 else 0           # ~90% keyed into shard 0
+        u = user_on_shard(fab, sid, b"as%d-" % seed, start=k * 13)
+        rid += 1
+        req = signed_write(fab, u, rid)
+        writes.append((u, req))
+        assert fab.submit_write(req) is not None
+
+    elapsed = 0.0
+    while elapsed < 60.0 and not any(
+            r.action == "split" for r in ap.ledger.records):
+        fab.run(0.5)
+        elapsed += 0.5
+    splits = [r for r in ap.ledger.records if r.action == "split"]
+    assert splits, \
+        f"seed {seed}: sustained imbalance never actuated a split " \
+        f"({ap.summary()})"
+    m = fab.reshard.active or fab.reshard.history[-1]
+    _drive_migration(fab, m, timeout=120.0)
+    assert m.phase == "done", \
+        f"seed {seed}: autopilot split never completed: {m.to_dict()}"
+    assert len(fab.shards) == 3 and fab.mapping.epoch == 1
+    rec = splits[0]
+    assert rec.evidence.get("hot_shard") == 0 and \
+        rec.evidence.get("index", 0) >= \
+        fab.config.SHARD_IMBALANCE_THRESHOLD, rec.evidence
+    assert rec.pre["shards"] == [0, 1] and rec.post["shards"] == [0, 1, 2]
+    # no oscillation: one split, zero merges, audit-clean ledger
+    fab.run(fab.config.AUTOPILOT_COOLDOWN + 3.0)
+    assert len([r for r in ap.ledger.records
+                if r.action == "split"]) == 1, \
+        f"seed {seed}: the split chased its own transient"
+    assert not [r for r in ap.ledger.records if r.action == "merge"], \
+        f"seed {seed}: split/merge oscillation"
+    _autopilot_audit(ap, seed)
+    _assert_exactly_once(fab, seed, writes)
+    for shard in fab.shards.values():
+        assert_safety(shard)
+
+
+def run_autopilot_repin_scenario(seed: int) -> None:
+    """One chip of the shared multi-device ring flaps: the sustained
+    open breaker re-pins the sick lane's shards to a healthy chip, a
+    write ordered mid-sickness survives, and after the breaker holds
+    closed through the recovery window (+cooldown) the pins RESTORE —
+    the unpin citing its repin, never both inside one window."""
+    from plenum_tpu.parallel.pipeline import MultiDeviceCryptoPipeline
+    from plenum_tpu.parallel.supervisor import CLOSED
+    from plenum_tpu.shards import ShardedSimFabric
+    from test_shards import signed_write, user_on_shard
+
+    rng = SimRandom(seed * 69623 + 29)
+    faulties, sups = _supervised_lanes(rng, n_lanes=3)
+    pipeline = MultiDeviceCryptoPipeline(
+        ed_inners=sups, config=Config(**FAST), threaded=False)
+    fab = _track(ShardedSimFabric(n_shards=2, nodes_per_shard=3,
+                                  seed=seed, config=_autopilot_config(),
+                                  pipeline=pipeline))
+    ap = fab.autopilot
+    for obj in (*sups, *faulties):
+        obj.set_clock(fab.timer.get_current_time)
+
+    sick = fab.lane_pins[0]
+    assert sick is not None
+    kind = ("wedge", "drop", "corrupt")[rng.integer(0, 2)]
+    getattr(faulties[sick], kind)()
+    elapsed = 0.0
+    while elapsed < 40.0 and not any(
+            r.action == "repin" for r in ap.ledger.records):
+        pipeline.verifier(lane=sick).verify_batch(
+            _junk(b"ap-sick%d" % int(elapsed * 2), seed))
+        fab.run(0.5)
+        elapsed += 0.5
+    repins = [r for r in ap.ledger.records if r.action == "repin"]
+    assert repins, \
+        f"seed {seed}: sustained open breaker never re-pinned " \
+        f"(breaker={sups[sick].breaker.state}, {ap.summary()})"
+    target = fab.lane_pins[0]
+    assert target != sick, f"seed {seed}: pin did not move off lane {sick}"
+    assert repins[0].evidence.get("sick_lane") == sick
+
+    # ordering continues on the re-pinned lane while the chip is dark
+    u = user_on_shard(fab, 0, b"ar%d-" % seed)
+    req = signed_write(fab, u, 1)
+    assert fab.submit_write(req) is not None
+    before = fab.shards[0].ordered_count()
+    elapsed = 0.0
+    while elapsed < 30.0 and fab.shards[0].ordered_count() <= before:
+        fab.run(0.5)
+        elapsed += 0.5
+    assert fab.shards[0].ordered_count() > before, \
+        f"seed {seed}: shard stopped ordering after the re-pin"
+
+    # heal: probe traffic re-closes the breaker; the clear streak plus
+    # the repin's cooldown stamp gate the restore
+    faulties[sick].heal()
+    elapsed = 0.0
+    while elapsed < 60.0 and not any(
+            r.action == "unpin" for r in ap.ledger.records):
+        if sups[sick].breaker.state != CLOSED:
+            pipeline.verifier(lane=sick).verify_batch(
+                _junk(b"ap-heal%d" % int(elapsed * 2), seed))
+        fab.run(0.5)
+        elapsed += 0.5
+    unpins = [r for r in ap.ledger.records if r.action == "unpin"]
+    assert unpins, \
+        f"seed {seed}: pins never restored after the re-warm " \
+        f"({ap.summary()})"
+    assert fab.lane_pins[0] == sick            # back on its own chip
+    assert unpins[0].cites == repins[0].seq
+    # hysteresis, not a flap: the undo landed OUTSIDE the cooldown
+    assert unpins[0].t >= repins[0].cooldown_until
+    _autopilot_audit(ap, seed)
+    for shard in fab.shards.values():
+        assert_safety(shard)
+
+
+def run_autopilot_observer_scenario(seed: int) -> None:
+    """Regional read burn: reads beyond the region's pooled capacity
+    ledger SLO violations, the sustained burn spawns an observer, and
+    after demand falls back (with measured headroom) the newest one
+    retires — the retire citing its spawn."""
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.shards import ShardedSimFabric
+
+    rng = SimRandom(seed * 50329 + 13)
+    cap = 3.0 + rng.integer(0, 3)
+    fab = _track(ShardedSimFabric(n_shards=2, nodes_per_shard=3,
+                                  seed=seed, config=_autopilot_config()))
+    fleet = fab.attach_observer_fleet(regions=("r0",), capacity=cap)
+    ap = fab.autopilot
+    q = Request("rdr", 1, {"type": GET_NYM,
+                           "dest": fab.trustee.identifier}).to_dict()
+
+    elapsed = 0.0
+    while elapsed < 40.0 and fleet.count("r0") == 1:
+        for _ in range(int(cap * 3) + 2):      # ~3x pooled capacity
+            fleet.serve_read("r0", q)
+        fab.run(0.5)
+        elapsed += 0.5
+    assert fleet.count("r0") == 2, \
+        f"seed {seed}: read burn never spawned an observer " \
+        f"({fleet.summary()}, {ap.summary()})"
+    spawns = [r for r in ap.ledger.records
+              if r.action == "observer_spawn"]
+    assert spawns[0].subject == "r0" and spawns[0].evidence
+
+    # demand falls to a trickle one observer holds with headroom
+    elapsed = 0.0
+    while elapsed < 60.0 and fleet.count("r0") == 2:
+        fleet.serve_read("r0", q)
+        fab.run(0.5)
+        elapsed += 0.5
+    assert fleet.count("r0") == 1, \
+        f"seed {seed}: observer never retired after recovery " \
+        f"({fleet.summary()}, {ap.summary()})"
+    retires = [r for r in ap.ledger.records
+               if r.action == "observer_retire"]
+    assert retires[0].cites == spawns[0].seq
+    assert retires[0].t >= spawns[0].cooldown_until
+    assert fleet.stats["reads"] > 0 and fleet.stats["violations"] > 0
+    _autopilot_audit(ap, seed)
+    for shard in fab.shards.values():
+        assert_safety(shard)
+
+
+def run_autopilot_ladder_scenario(seed: int) -> None:
+    """A front door's SLO ledger burns hot and STAYS hot: the ladder
+    steps down (shed-harder clamps every ingress plane, then pool-wide
+    read-only), holds at the floor, and steps back UP one level at a
+    time on sustained recovery — recovers citing their degrades LIFO,
+    and a catchup-parked read-only is never the autopilot's to clear."""
+    from plenum_tpu.shards import ShardedSimFabric
+
+    rng = SimRandom(seed * 104729 + 5)
+    fab = _track(ShardedSimFabric(n_shards=2, nodes_per_shard=3,
+                                  seed=seed, config=_autopilot_config()))
+    ap = fab.autopilot
+    entry = fab.shards[0].names[0]
+    plane = fab.ingress_plane(entry, tick=False)
+    base_wm = plane.shed_watermark
+    tracker = fab.aggregator.tracker("ingress", "front-door")
+
+    def feed(viol: int, n: int = 5) -> None:
+        tracker.note(fab.timer.get_current_time(), viol, n)
+        fab.run(0.5)
+
+    burn = 3 + rng.integer(0, 2)
+    elapsed = 0.0
+    while elapsed < 60.0 and ap.level < 1:
+        feed(burn)
+        elapsed += 0.5
+    assert ap.level >= 1, f"seed {seed}: ladder never degraded " \
+                          f"({ap.summary()})"
+    assert plane.shed_watermark == max(
+        1, fab.config.INGRESS_HIGH_WATERMARK
+        // fab.config.AUTOPILOT_SHED_FACTOR)
+    while elapsed < 120.0 and ap.level < 2:
+        feed(burn)
+        elapsed += 0.5
+    assert ap.level == 2, f"seed {seed}: ladder stuck below read-only " \
+                          f"({ap.summary()})"
+    assert all(n.read_only_degraded for n in fab.nodes.values())
+    # held at the floor: more burn, no action past the ladder's end
+    floor_actions = ap.counts["actions"]
+    for _ in range(8):
+        feed(burn)
+    assert ap.counts["actions"] == floor_actions
+
+    # recovery: clean intervals age the burn out of both windows
+    while elapsed < 300.0 and ap.level > 0:
+        feed(0)
+        elapsed += 0.5
+    assert ap.level == 0, f"seed {seed}: ladder never recovered " \
+                          f"({ap.summary()})"
+    assert not any(n.read_only_degraded for n in fab.nodes.values())
+    assert plane.shed_watermark == base_wm
+    recs = _autopilot_audit(ap, seed)
+    degrades = [r for r in recs if r["action"] == "degrade"]
+    recovers = [r for r in recs if r["action"] == "recover"]
+    assert [r["subject"] for r in degrades] == ["shed_harder",
+                                                "read_only"]
+    assert [r["cites"] for r in recovers] == \
+        [degrades[1]["seq"], degrades[0]["seq"]]
+    for shard in fab.shards.values():
+        assert_safety(shard)
+
+
+def run_autopilot_composed_scenario(seed: int) -> None:
+    """The acceptance run: zipfian client flood + a flapping chip lane
+    + the live-split membership churn the autopilot itself drives, all
+    at once, healed end-to-end with zero test-driven actuation. Pinned:
+    the ledger audits clean (no action/undo inside a cooldown window),
+    no split/merge oscillation, exactly-once ordering, no fork."""
+    from plenum_tpu.parallel.pipeline import MultiDeviceCryptoPipeline
+    from plenum_tpu.parallel.supervisor import CLOSED
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.shards import ShardedSimFabric
+    from test_shards import signed_write, user_on_shard
+
+    rng = SimRandom(seed * 122949823 + 19)
+    faulties, sups = _supervised_lanes(rng, n_lanes=3)
+    pipeline = MultiDeviceCryptoPipeline(
+        ed_inners=sups, config=Config(**FAST), threaded=False)
+    # generous SLO budgets: the composed run exercises split + re-pin +
+    # observer scale; the ladder has its own dedicated scenario and
+    # must not park the pool read-only mid-migration over sim timing
+    fab = _track(ShardedSimFabric(
+        n_shards=2, nodes_per_shard=3, seed=seed,
+        config=_autopilot_config(BATCH_SLO_P95=30.0,
+                                 INGRESS_SLO_P95=30.0),
+        pipeline=pipeline))
+    ap = fab.autopilot
+    for obj in (*sups, *faulties):
+        obj.set_clock(fab.timer.get_current_time)
+    cap = 3.0 + rng.integer(0, 2)
+    fleet = fab.attach_observer_fleet(regions=("r0",), capacity=cap)
+    q = Request("rdr", 1, {"type": GET_NYM,
+                           "dest": fab.trustee.identifier}).to_dict()
+
+    # zipfian flood: ~90% of the writes key into shard 0
+    writes, rid = [], 0
+    for k in range(8 + rng.integer(0, 4)):
+        sid = 1 if k % 8 == 7 else 0
+        u = user_on_shard(fab, sid, b"ac%d-" % seed, start=k * 19)
+        rid += 1
+        req = signed_write(fab, u, rid)
+        writes.append((u, req))
+        assert fab.submit_write(req) is not None
+
+    sick = fab.lane_pins[0]
+    kind = ("wedge", "drop", "corrupt")[rng.integer(0, 2)]
+    fab.run(rng.float(0.5, 1.5))
+    getattr(faulties[sick], kind)()            # the chip flaps mid-flood
+    heal_step = 24 + rng.integer(0, 8)
+    for step in range(120):
+        if step == heal_step:
+            faulties[sick].heal()
+        if step < heal_step:
+            pipeline.verifier(lane=sick).verify_batch(
+                _junk(b"cx%d" % step, seed))
+        elif sups[sick].breaker.state != CLOSED:
+            pipeline.verifier(lane=sick).verify_batch(
+                _junk(b"ch%d" % step, seed))
+        for _ in range(int(cap * 3) + 2 if step < 40 else 1):
+            fleet.serve_read("r0", q)          # read burn, then trickle
+        fab.run(0.5)
+        if step > 80 and fab.reshard.active is None \
+                and sups[sick].breaker.state == CLOSED \
+                and not ap._repins:
+            break
+    if fab.reshard.active is not None:
+        _drive_migration(fab, fab.reshard.active, timeout=120.0)
+
+    recs = _autopilot_audit(ap, seed)          # the pinned no-flap gate
+    splits = [r for r in recs if r["action"] == "split"]
+    merges = [r for r in recs if r["action"] == "merge"]
+    assert len(splits) <= 1 and not merges, \
+        f"seed {seed}: split/merge oscillation under composed stress " \
+        f"({[r['action'] for r in recs]})"
+    assert splits, \
+        f"seed {seed}: the hot-shard flood never split ({ap.summary()})"
+    assert fab.reshard.history and \
+        fab.reshard.history[-1].phase == "done", \
+        f"seed {seed}: composed stress starved the migration"
+    repins = [r for r in recs if r["action"] == "repin"]
+    assert repins, \
+        f"seed {seed}: the flapping chip never forced a re-pin " \
+        f"({ap.summary()})"
+    _assert_exactly_once(fab, seed, writes)
+    for shard in fab.shards.values():
+        assert_safety(shard)
+
+
+AUTOPILOT_SEEDS = 12
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_autopilot_fuzz(bucket):
+    for seed in range(bucket * 3, (bucket + 1) * 3):
+        _run_with_artifacts(run_autopilot_composed_scenario, seed)
+
+
+def test_sim_autopilot_split_smoke():
+    _run_with_artifacts(run_autopilot_split_scenario, 1)
+
+
+def test_sim_autopilot_repin_smoke():
+    _run_with_artifacts(run_autopilot_repin_scenario, 1)
+
+
+def test_sim_autopilot_observer_smoke():
+    _run_with_artifacts(run_autopilot_observer_scenario, 1)
+
+
+def test_sim_autopilot_ladder_smoke():
+    _run_with_artifacts(run_autopilot_ladder_scenario, 1)
+
+
+def test_sim_autopilot_composed_smoke():
+    _run_with_artifacts(run_autopilot_composed_scenario, 1)

@@ -429,3 +429,20 @@ def test_start_node_chunked_backend_is_durable(tmp_path):
     log = node.c.db.get_ledger(lid)._log
     assert isinstance(log, KvChunked), type(log)
     node.c.db.close()
+
+
+@pytest.mark.slow
+def test_config18_autopilot_heals_zipfian_flood_hands_off():
+    """The ISSUE 18 acceptance bench: config12's zipfian hot-range
+    flood with AUTOPILOT=True and ZERO test-driven actuation — the
+    autopilot must split the hot shard on its own cadence and the run
+    must recover to >= 0.8x pre-flood TPS with a clean control-ledger
+    audit."""
+    from plenum_tpu.tools.bench_configs import config18_autopilot
+    out = config18_autopilot()
+    assert "error" not in out, out
+    assert out["test_driven_actuations"] == 0
+    assert out["recovery_ratio"] >= 0.8, out
+    assert out["audit_problems"] == [], out
+    assert out["split_evidence"]["hot_shard"] == 0
+    assert out["migration"]["phase"] == "done", out
