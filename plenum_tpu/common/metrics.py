@@ -164,6 +164,19 @@ class MetricsName:
     OBSERVER_MS_ADOPTED = "observer.ms_adopted"
     OBSERVER_MS_REJECTED = "observer.ms_rejected"
     OBSERVER_STALE_SUPPRESSED = "observer.stale_suppressed"
+    # Proof-CDN edge tier (reads/edge.py): cache traffic counters, the
+    # anchor-advance invalidation/revalidation churn, bytes served off
+    # the pool, and client-rejected edge replies (the deny-but-never-
+    # forge ledger — a keyless cache cannot judge its own bytes, so the
+    # verify-failure count is wired back from the verifying client)
+    EDGE_QUERIES = "edge.queries"
+    EDGE_HITS = "edge.hits"
+    EDGE_MISSES = "edge.misses"
+    EDGE_REVALIDATIONS = "edge.revalidations"
+    EDGE_INVALIDATIONS = "edge.invalidations"
+    EDGE_NEGATIVE_HITS = "edge.negative_hits"
+    EDGE_BYTES_SERVED = "edge.bytes_served"
+    EDGE_VERIFY_FAILURES = "edge.verify_failures"
     # consensus
     # closed-loop batch controller (consensus/batch_controller.py): knob
     # gauges (read back via `last`) + a cumulative decision counter

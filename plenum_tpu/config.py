@@ -276,6 +276,12 @@ class Config:
     # observer fan-out bounds per region (policy 3)
     AUTOPILOT_OBSERVER_MIN: int = 1
     AUTOPILOT_OBSERVER_MAX: int = 4
+    # Proof-CDN absorption bar (reads/edge.py): a region whose windowed
+    # edge hit-rate is at or above this fraction has its read demand
+    # absorbed by the keyless cache tier — the observer spawn policy
+    # HOLDS (with the rate as ledger evidence) instead of adding
+    # observer capacity the edges already make redundant
+    AUTOPILOT_EDGE_ABSORB: float = 0.95
 
     # --- proof-carrying cross-shard writes (shards/cross_write.py) ---
     # participant lock TTL: a remote shard holding a lock with no

@@ -126,6 +126,7 @@ class Autopilot:
         self._min_shards = getattr(cfg, "AUTOPILOT_MIN_SHARDS", 2)
         self._obs_min = getattr(cfg, "AUTOPILOT_OBSERVER_MIN", 1)
         self._obs_max = getattr(cfg, "AUTOPILOT_OBSERVER_MAX", 4)
+        self._edge_absorb = getattr(cfg, "AUTOPILOT_EDGE_ABSORB", 0.95)
         self._shed_factor = getattr(cfg, "AUTOPILOT_SHED_FACTOR", 4)
         self._next_eval = 0.0
         # (policy, subject) -> timestamp before which the policy may not
@@ -361,6 +362,21 @@ class Autopilot:
                 burn = self.agg.burn.get(("reads", region))
                 evidence = {"region": region, "observers": n,
                             **(burn.summary(t) if burn else {})}
+                # the Proof-CDN signal (aggregator.note_edge): when the
+                # region's edges already absorb nearly every verified
+                # read, more observer capacity can't move the burn —
+                # hold with the hit-rate as evidence instead of
+                # spawning. No edge fleet -> no signal -> policy as
+                # before (the observer fuzz pins that identity).
+                rate_fn = getattr(self.agg, "edge_hit_rate", None)
+                rate = rate_fn(region) if callable(rate_fn) else None
+                if rate is not None:
+                    evidence["edge_hit_rate"] = round(rate, 4)
+                    if rate >= self._edge_absorb:
+                        self._hold(t, "observer", "observer_spawn",
+                                   region,
+                                   {**evidence, "edge_absorbing": True})
+                        continue
                 if n >= self._obs_max:
                     # capacity exhausted: the ladder's cue, not ours
                     self._hold(t, "observer", "observer_spawn", region,

@@ -197,6 +197,12 @@ class FleetAggregator:
         # summary here so the fleet console renders it off the same
         # aggregator handle it already holds; None = no autopilot
         self.autopilot: Optional[dict] = None
+        # the Proof-CDN edge tier (reads/edge.py): per-region windowed
+        # (t, hits, served, bytes) ledgers fed by EdgeFleet.note_edge,
+        # plus the published per-region summary the console's EDGE line
+        # renders; None = no edge fleet attached
+        self.edge: Optional[dict] = None
+        self._edge_hist: dict[str, deque] = {}
 
     # --- intake -----------------------------------------------------------
 
@@ -393,6 +399,47 @@ class FleetAggregator:
         ledgers through; its judgments join the streak notes and the
         `slo_burn.<kind>` sustained queries automatically."""
         return self.burn.setdefault((kind, subject), self._mk_burn())
+
+    def note_edge(self, region: str, hits: int, served: int,
+                  edges: int = 0, bytes_served: int = 0,
+                  now: Optional[float] = None) -> None:
+        """One edge-tier window for `region` (EdgeFleet._roll_window):
+        DELTAS, not lifetime totals. Feeds the windowed hit-rate fold
+        `edge_hit_rate` (the autopilot's absorbed-capacity signal) and
+        publishes the per-region summary the console's EDGE line
+        renders. The edge tier is untrusted, so this is capacity
+        telemetry only — never a correctness judgment."""
+        t = self.now if now is None else now
+        hist = self._edge_hist.setdefault(region, deque(maxlen=256))
+        hist.append((t, int(hits), int(served)))
+        ed = self.edge if isinstance(self.edge, dict) else {}
+        regions = ed.setdefault("regions", {})
+        row = regions.setdefault(region, {"served": 0, "bytes": 0})
+        row["edges"] = edges
+        row["served"] += int(served)
+        row["bytes"] += int(bytes_served)
+        rate = self.edge_hit_rate(region)
+        if rate is not None:
+            row["hit_rate"] = round(rate, 4)
+        ed["served"] = sum(r["served"] for r in regions.values())
+        ed["bytes"] = sum(r["bytes"] for r in regions.values())
+        self.edge = ed
+
+    def edge_hit_rate(self, region: str) -> Optional[float]:
+        """The region's edge hit-rate folded over the slow SLO window
+        (None = no edge windows noted inside it). The observer fan-out
+        policy reads this before spawning: a region whose edges absorb
+        nearly every read doesn't need more observer capacity."""
+        hist = self._edge_hist.get(region)
+        if not hist:
+            return None
+        cutoff = hist[-1][0] - self.window
+        hits = served = 0
+        for t, h, n in hist:
+            if t >= cutoff:
+                hits += h
+                served += n
+        return hits / served if served else None
 
     def _note_judgment(self, key: tuple[str, str], active: bool) -> None:
         if active:

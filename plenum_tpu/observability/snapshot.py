@@ -128,6 +128,12 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
         MetricsName.OBSERVER_MS_REJECTED,
         MetricsName.OBSERVER_STALE_SUPPRESSED,
     }),
+    "edge": frozenset({
+        MetricsName.EDGE_QUERIES, MetricsName.EDGE_HITS,
+        MetricsName.EDGE_MISSES, MetricsName.EDGE_REVALIDATIONS,
+        MetricsName.EDGE_INVALIDATIONS, MetricsName.EDGE_NEGATIVE_HITS,
+        MetricsName.EDGE_BYTES_SERVED, MetricsName.EDGE_VERIFY_FAILURES,
+    }),
     "ingress": frozenset({
         MetricsName.INGRESS_ADMITTED, MetricsName.INGRESS_SHED,
         MetricsName.INGRESS_QUEUE_WAIT, MetricsName.INGRESS_QUEUE_DEPTH,

@@ -16,7 +16,9 @@ from .proofs import (READ_PROOF, result_core, result_digest,
 from .plane import ReadPlane
 from .client import ReadCheck, ReadClientStats, SimReadDriver, \
     VerifyingReadClient
+from .edge import EDGE_CANNOT_SERVE, EdgeCache, EdgeFleet, SimEdge
 
-__all__ = ["READ_PROOF", "ReadPlane", "ReadCheck", "ReadClientStats",
+__all__ = ["EDGE_CANNOT_SERVE", "EdgeCache", "EdgeFleet", "READ_PROOF",
+           "ReadPlane", "ReadCheck", "ReadClientStats", "SimEdge",
            "SimReadDriver", "VerifyingReadClient", "result_core",
            "result_digest", "verify_read_proof"]

@@ -45,6 +45,13 @@ class ReadClientStats:
         # the ladder to a validator (anchor lag / unanchorable replica)
         self.observer_ok = 0
         self.observer_escalations = 0
+        # edge tier (reads/edge.py): reads served by a keyless Proof-CDN
+        # cache rung, proofless edge replies that escalated, and edge
+        # replies the client REJECTED (forged/over-stale — the deny-but-
+        # never-forge ledger the lying_edge fuzz pins)
+        self.edge_ok = 0
+        self.edge_escalations = 0
+        self.edge_verify_failures = 0
         # sharded-plane ladder: reads that refreshed the client's map
         # view and retried once against the new epoch (a healthy reshard
         # in flight must not surface as a client error)
@@ -67,6 +74,11 @@ class ReadClientStats:
         if self.observer_ok or self.observer_escalations:
             out["observer_ok"] = self.observer_ok
             out["observer_escalations"] = self.observer_escalations
+        if self.edge_ok or self.edge_escalations \
+                or self.edge_verify_failures:
+            out["edge_ok"] = self.edge_ok
+            out["edge_escalations"] = self.edge_escalations
+            out["edge_verify_failures"] = self.edge_verify_failures
         if self.map_retries:
             out["map_retries"] = self.map_retries
         if self.reads:
@@ -129,6 +141,12 @@ class VerifyingReadClient(PoolClient):
     only a proofless VALIDATOR reply means the pool cannot anchor yet
     and escalates to the legacy f+1 broadcast — which never includes
     observers (f counts validators; the quorum stays a validator quorum).
+
+    With `edge_addrs`, the keyless Proof-CDN tier (reads/edge.py) rides
+    a rung BEFORE the observers: an edge holds no keys and no state, so
+    a tampered, stale, or refused edge reply is just one more failover
+    (deny-but-never-forge — the client's verify gate is the only trust
+    anchor), and edges never join the escalation broadcast either.
     """
 
     def __init__(self, node_addrs: dict, f: int,
@@ -140,10 +158,13 @@ class VerifyingReadClient(PoolClient):
                  shard_resolver: Optional[Callable[[Request],
                                                    Optional[Sequence[str]]]]
                  = None,
-                 map_refresh: Optional[Callable[[], bool]] = None):
+                 map_refresh: Optional[Callable[[], bool]] = None,
+                 edge_addrs: Optional[dict] = None):
         super().__init__(node_addrs, f)
         self.observer_addrs = dict(observer_addrs or {})
-        self._all_addrs = {**self.observer_addrs, **self.node_addrs}
+        self.edge_addrs = dict(edge_addrs or {})
+        self._all_addrs = {**self.edge_addrs, **self.observer_addrs,
+                          **self.node_addrs}
         # checker: injectable verification core — the sharded plane's
         # CrossShardReadCheck (mapping-ownership proof + the OWNING
         # shard's BLS keys) rides the same ladder as the flat ReadCheck
@@ -219,10 +240,11 @@ class VerifyingReadClient(PoolClient):
         shard_nodes = self._shard_ladder(request)
         if shard_nodes is not None:
             # owning-shard ladder: fail over WITHIN the shard first; the
-            # observer tier is skipped (observers anchor one flat pool)
+            # edge/observer tiers are skipped (both anchor one flat pool)
             ladder = ladder_order(shard_nodes, request)
         else:
-            ladder = (ladder_order(list(self.observer_addrs), request)
+            ladder = (ladder_order(list(self.edge_addrs), request)
+                      + ladder_order(list(self.observer_addrs), request)
                       + ladder_order(list(self.node_addrs), request))
         for rung, name in enumerate(ladder):
             if rung:
@@ -240,9 +262,15 @@ class VerifyingReadClient(PoolClient):
             ok, reason = self.checker.check(request, msg.get("result", {}))
             if ok:
                 self.stats.single_reply_ok += 1
-                if name in self.observer_addrs:
+                if name in self.edge_addrs:
+                    self.stats.edge_ok += 1
+                elif name in self.observer_addrs:
                     self.stats.observer_ok += 1
                 return msg
+            if name in self.edge_addrs and reason != proofs.NO_PROOF:
+                # a rejected edge reply (forgery/over-stale cache): the
+                # deny-but-never-forge ledger; the ladder falls over
+                self.stats.edge_verify_failures += 1
             if reason == "stale_map" and self.map_refresh is not None:
                 # the answering node served a superseded map: cut
                 # straight to the refresh-and-retry path. WITHOUT a
@@ -251,6 +279,11 @@ class VerifyingReadClient(PoolClient):
                 # verified single reply beats the broadcast fallback
                 return None
             if reason == proofs.NO_PROOF:
+                if name in self.edge_addrs:
+                    # a proofless edge reply (pass-through miss): the
+                    # next rung can still prove — never break the ladder
+                    self.stats.edge_escalations += 1
+                    continue
                 if name in self.observer_addrs:
                     # anchor-lagged observer escalates to the next rung
                     # (a validator CAN prove); never straight to broadcast
@@ -281,7 +314,10 @@ class SimReadDriver:
                  shard_resolver: Optional[Callable[[Request],
                                                    Optional[Sequence[str]]]]
                  = None,
-                 map_refresh: Optional[Callable[[], bool]] = None):
+                 map_refresh: Optional[Callable[[], bool]] = None,
+                 edge_names: Optional[Sequence[str]] = None,
+                 on_edge_verify_failure: Optional[Callable[[str], None]]
+                 = None):
         self._submit = submit
         self._collect = collect
         self._pump = pump
@@ -289,6 +325,13 @@ class SimReadDriver:
         # observer tier, tried BEFORE validators (same escalation rules
         # as VerifyingReadClient: observer proofless -> next rung)
         self.observer_names = list(observer_names or [])
+        # edge tier (reads/edge.py), tried BEFORE observers: a keyless
+        # cache rung whose failures are always just failover. The
+        # optional on_edge_verify_failure(name) hook reports a rejected
+        # edge reply back to the serving fleet (only the client can
+        # judge the cache's bytes — EdgeFleet.note_verify_failure)
+        self.edge_names = list(edge_names or [])
+        self.on_edge_verify_failure = on_edge_verify_failure
         # injectable verification core + owning-shard ladder, exactly as
         # on VerifyingReadClient (the TCP twin documents the contract)
         self.checker = checker if checker is not None else ReadCheck(
@@ -336,9 +379,11 @@ class SimReadDriver:
                     [n for n in shard_nodes if n in self.node_names],
                     request)
             else:
-                order = (ladder_order(self.observer_names, request)
+                order = (ladder_order(self.edge_names, request)
+                         + ladder_order(self.observer_names, request)
                          + ladder_order(self.node_names, request))
         observers = set(self.observer_names)
+        edges = set(self.edge_names)
         for rung, name in enumerate(order):
             if rung:
                 self.stats.failovers += 1
@@ -352,9 +397,17 @@ class SimReadDriver:
             ok, reason = self.checker.check(request, result)
             if ok:
                 self.stats.single_reply_ok += 1
-                if name in observers:
+                if name in edges:
+                    self.stats.edge_ok += 1
+                elif name in observers:
                     self.stats.observer_ok += 1
                 return result
+            if name in edges and reason != proofs.NO_PROOF:
+                # rejected edge bytes: deny-but-never-forge in action —
+                # count it, tell the fleet, keep walking the ladder
+                self.stats.edge_verify_failures += 1
+                if self.on_edge_verify_failure is not None:
+                    self.on_edge_verify_failure(name)
             if reason == "stale_map" and self.map_refresh is not None:
                 # the answering node served a superseded map: cut to
                 # the refresh-and-retry path. Without a refresh hook,
@@ -362,6 +415,9 @@ class SimReadDriver:
                 # epoch (VerifyingReadClient documents the contract)
                 return None
             if reason == proofs.NO_PROOF:
+                if name in edges:
+                    self.stats.edge_escalations += 1
+                    continue             # deeper rungs can still prove
                 if name in observers:
                     self.stats.observer_escalations += 1
                     continue             # a validator can still prove

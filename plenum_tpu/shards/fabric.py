@@ -244,6 +244,10 @@ class ShardedSimFabric:
         # optional region-scoped observer fleet (attach_observer_fleet)
         self.ingress_planes: list = []
         self.observers = None
+        # the optional Proof-CDN edge tier (attach_edge_fleet): keyless
+        # caches one rung OUTSIDE the observers, serviced from the same
+        # prod loop
+        self.edges = None
         # the autopilot control plane (control/autopilot.py): None
         # unless AUTOPILOT=True — the disabled cost is one `is None`
         # check per prod, pinned by the identity test
@@ -343,6 +347,16 @@ class ShardedSimFabric:
         from plenum_tpu.ingress import ObserverFleet
         self.observers = ObserverFleet(self, regions=regions, **kw)
         return self.observers
+
+    def attach_edge_fleet(self, regions=("r0",), **kw):
+        """Build the region-scoped Proof-CDN edge fleet (reads/edge.py):
+        keyless envelope caches fed by the validators' BatchCommitted
+        push stream, serviced from the prod loop; their per-region
+        hit-rate feeds the aggregator so the autopilot's observer
+        policy counts absorbed read capacity."""
+        from plenum_tpu.reads.edge import EdgeFleet
+        self.edges = EdgeFleet(self, regions=regions, **kw)
+        return self.edges
 
     def _wire_shard_telemetry(self, sid: int, shard: "SimShard") -> None:
         for node in shard.nodes.values():
@@ -450,6 +464,8 @@ class ShardedSimFabric:
         self.reshard.service()
         if self.observers is not None:
             self.observers.service()
+        if self.edges is not None:
+            self.edges.service()
         if self.autopilot is not None:
             self.autopilot.service()
         for shard in list(self.shards.values()):
@@ -463,6 +479,8 @@ class ShardedSimFabric:
             self.reshard.service()
             if self.observers is not None:
                 self.observers.service()
+            if self.edges is not None:
+                self.edges.service()
             if self.autopilot is not None:
                 self.autopilot.service()
             for shard in list(self.shards.values()):

@@ -1970,6 +1970,166 @@ def config18_autopilot(n_users: int = 320, phase_s: float = 20.0) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def config19_edge(n_reads: int = 1800, write_every: int = 20,
+                  timeout: float = 120.0) -> dict:
+    """The Proof CDN under a 95:5 read:write flood (docs/edge.md): the
+    config6 pool with ONE keyless edge cache (reads/edge.py) in front —
+    every read walks the edge-first ladder and verifies client-side.
+    Reports the edge hit-rate and the client-facing edge service rate
+    (the acceptance bar: >95% of verified reads served by edges), the
+    POOL read load left behind (validator-served reads + CDN origin
+    refills — what the edge tier exists to keep near zero), bytes per
+    edge-served read, client verify p95, and `jax_source` provenance
+    (the pool's crypto plane is the jax-on-cpu pipeline build_pool
+    compiles)."""
+    import plenum_tpu.tools.local_pool as lp
+    from plenum_tpu.common.node_messages import BatchCommitted
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.crypto.ed25519 import Ed25519Signer
+    from plenum_tpu.execution.txn import GET_NYM, NYM
+    from plenum_tpu.reads import SimEdge, SimReadDriver
+
+    try:
+        (names, nodes, timer, trustee,
+         replies, ReplyCls, DOMAIN, plane, net) = lp.build_pool(4, "cpu")
+        users = []
+        setup = []
+        for i in range(20):
+            user = Ed25519Signer(seed=(b"ed%08d" % i).ljust(32, b"\0")[:32])
+            users.append(user)
+            req = Request(trustee.identifier, i + 1,
+                          {"type": NYM, "dest": user.identifier,
+                           "verkey": user.verkey_b58})
+            req.signature = trustee.sign_b58(req.signing_bytes())
+            setup.append(req)
+        done, _ = _drive_inprocess(names, nodes, timer, replies, ReplyCls,
+                                   plane, setup, 60.0)
+        if done < len(setup):
+            return {"error": f"setup ordered only {done}/{len(setup)}"}
+
+        rr = {"i": 0}
+
+        def origin(request):
+            name = names[rr["i"] % len(names)]
+            rr["i"] += 1
+            return nodes[name].read_plane.answer(request)
+
+        edge = SimEdge("edge1", origin, now=timer.get_current_time,
+                       freshness_s=1e9)
+        edge.register(lambda v, msg: nodes[v]
+                      .handle_client_message(msg, edge.client_id), names)
+
+        def route_pushes(name):
+            keep = []
+            for t, m, c in replies[name]:
+                if c == edge.client_id:
+                    if isinstance(m, BatchCommitted):
+                        edge.deliver_push(m, name)
+                else:
+                    keep.append((t, m, c))
+            replies[name][:] = keep
+
+        def submit(name, req):
+            if name == edge.name:
+                edge.handle_client_message(req.to_dict(), "rdr")
+            else:
+                nodes[name].handle_client_message(req.to_dict(), "rdr")
+
+        def collect(name):
+            if name == edge.name:
+                out = [m.result for m, _ in edge.sent
+                       if isinstance(m, ReplyCls)]
+                edge.sent.clear()
+                return out
+            route_pushes(name)
+            out = [m.result for _, m, c in replies[name]
+                   if isinstance(m, ReplyCls) and c == "rdr"]
+            replies[name].clear()
+            return out
+
+        def pump(seconds):
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                timer.service()
+                for node in nodes.values():
+                    node.prod()
+
+        bls_keys = lp.pool_bls_keys(names)
+        driver = SimReadDriver(submit, collect, pump, names, bls_keys,
+                               freshness_s=1e9,
+                               now=timer.get_current_time,
+                               edge_names=[edge.name])
+        served = 0
+        writes = 0
+        write_id = 1000
+        t0 = time.perf_counter()
+        for i in range(n_reads):
+            if time.perf_counter() > t0 + timeout:
+                break
+            if i % write_every == write_every - 1:
+                # the 5% write share: fire-and-forget, and the commit's
+                # push fan-out invalidates the edge (anchor advance)
+                user = Ed25519Signer(
+                    seed=(b"edw%07d" % i).ljust(32, b"\0")[:32])
+                w = Request(trustee.identifier, write_id,
+                            {"type": NYM, "dest": user.identifier,
+                             "verkey": user.verkey_b58})
+                w.signature = trustee.sign_b58(w.signing_bytes())
+                write_id += 1
+                for n in names:
+                    nodes[n].handle_client_message(w.to_dict(), "bench-w")
+                writes += 1
+                # let the write order: edge serving is synchronous (the
+                # ladder never pumps on a cache hit), so the pool only
+                # progresses when driven — and the commit's push
+                # fan-out is what exercises invalidation + SWR
+                pump(0.05)
+            # CDN-shaped traffic: 90% of reads hammer 3 hot entries,
+            # the tail rotates the cold set — hot entries amortize each
+            # anchor-advance refill across many stale-while-revalidate
+            # hits, the tail pays ~one refill per epoch per touched key
+            hot = i % 10 < 9
+            dest = users[i % 3] if hot else users[3 + i % 17]
+            q = Request("reader", i + 1, {"type": GET_NYM,
+                                          "dest": dest.identifier})
+            if driver.read(q, per_node_s=2.0, step_s=0.001) is not None:
+                served += 1
+            for n in names:        # the push fan-out (anchor advances)
+                route_pushes(n)
+        dt = time.perf_counter() - t0
+        s = driver.stats.summary()
+        cs = edge.cache.stats
+        # client-facing pool load (reads a VALIDATOR had to serve on the
+        # ladder — the acceptance bar wants this ~0) vs CDN origin
+        # refills (cold fills + revalidations: background traffic the
+        # edge pays so clients don't)
+        ladder_reads = s["single_reply_ok"] - s.get("edge_ok", 0)
+        out = {"reads_served": served, "writes_submitted": writes,
+               "reads_per_s": round(served / dt, 1) if dt else 0.0,
+               "edge_served_rate": round(s.get("edge_ok", 0) / served, 4)
+               if served else None,
+               "edge_cache_hit_rate": round(cs["hits"] / cs["queries"], 4)
+               if cs["queries"] else None,
+               "edge_stale_served": cs["stale_served"],
+               "edge_revalidations": cs["revalidations"],
+               "edge_invalidations": cs["invalidations"],
+               "pool_ladder_reads": ladder_reads,
+               "origin_refills": cs["origin_fetches"],
+               "origin_offload": round(
+                   1.0 - cs["origin_fetches"] / cs["queries"], 4)
+               if cs["queries"] else None,
+               "bytes_per_edge_read": round(
+                   cs["bytes_served"] / cs["hits"]) if cs["hits"] else None,
+               "edge_verify_failures": s.get("edge_verify_failures", 0),
+               "failovers": s["failovers"], "fallbacks": s["fallbacks"],
+               "verify_ms_p50": s.get("verify_ms_p50"),
+               "verify_ms_p95": s.get("verify_ms_p95"),
+               "jax_source": "jax-on-cpu"}
+        return out
+    except Exception as e:                       # pragma: no cover
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     for name, fn in (("config1b", config1b_distinct_signers),
                      ("config2", config2_three_instances_mixed),
@@ -1985,7 +2145,8 @@ def main():
                      ("config13", config13_commitment),
                      ("config16", config16_ordered_path),
                      ("config17", config17_federation),
-                     ("config18", config18_autopilot)):
+                     ("config18", config18_autopilot),
+                     ("config19", config19_edge)):
         print(name, json.dumps(fn()), flush=True)
 
 

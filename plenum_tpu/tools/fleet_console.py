@@ -162,6 +162,21 @@ def render(agg, incidents, last_n: int = 5) -> str:
             f"actions={ap.get('actions', 0)} "
             f"reverts={ap.get('reverts', 0)} holds={ap.get('holds', 0)}"
             + (f" repins={ap.get('repins')}" if ap.get("repins") else ""))
+    # Proof-CDN edge tier (reads/edge.py): per-region keyless-cache
+    # absorption — how much read traffic never reaches the pool, and
+    # at what hit rate (the autopilot's observer policy reads the same
+    # number before spawning)
+    ed = getattr(agg, "edge", None)
+    if ed:
+        cells = []
+        for region, row in sorted(ed.get("regions", {}).items()):
+            rate = row.get("hit_rate")
+            cells.append(
+                f"{region} edges={row.get('edges', 0)} "
+                f"served={row.get('served', 0)} "
+                f"hit={'-' if rate is None else format(rate, '.0%')}")
+        lines.append(f"  EDGE: " + ", ".join(cells)
+                     + f"  bytes={ed.get('bytes', 0)}")
     for kind, per_node in s["burn"].items():
         burning = {n: b for n, b in per_node.items()
                    if b["fast"] > 0 or b["slow"] > 0}
@@ -323,6 +338,23 @@ def self_check() -> int:
             or "actions=3" not in text or "repins=" not in text:
         problems.append("console did not render the autopilot line")
 
+    # 3e) edge tier seam: windows fed through note_edge render the EDGE
+    # line (per-region fleet size + served volume + windowed hit rate),
+    # and the windowed fold exposes the hit rate the autopilot reads
+    agg3e = FleetAggregator(config=config)
+    agg3e.ingest(healthy("N1", 0, 0.0))
+    agg3e.note_edge("r0", hits=90, served=100, edges=2,
+                    bytes_served=4096, now=1.0)
+    agg3e.note_edge("r0", hits=98, served=100, edges=2,
+                    bytes_served=4096, now=2.0)
+    rate = agg3e.edge_hit_rate("r0")
+    if rate is None or abs(rate - 0.94) > 1e-9:
+        problems.append(f"edge hit-rate fold wrong: {rate}")
+    text = render(agg3e, [])
+    if "EDGE:" not in text or "r0 edges=2" not in text \
+            or "hit=94%" not in text:
+        problems.append("console did not render the edge line")
+
     # 4) hot shard: skewed ordered rates flag shard 0
     agg4 = FleetAggregator(config=config)
     for i in range(30):
@@ -396,7 +428,7 @@ def self_check() -> int:
 
     # 6) the renderer survives every view above (smoke, not goldens)
     try:
-        for a in (agg, agg2, agg3, agg4, agg4b):
+        for a in (agg, agg2, agg3, agg3e, agg4, agg4b):
             render(a, incidents)
     except Exception as e:
         problems.append(f"render failed: {type(e).__name__}: {e}")

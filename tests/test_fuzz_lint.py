@@ -17,6 +17,8 @@ def test_fuzz_suite_smoke_coverage():
     assert out["smoke_covered"] == out["scenarios"]
     # the reshard kind introduced with live split/merge is registered
     assert "run_reshard_fuzz_scenario" in out["kinds"]
+    # the Proof-CDN kind (a lying edge cache can deny, never forge)
+    assert "run_lying_edge_scenario" in out["kinds"]
 
 
 def test_fuzz_lint_catches_sweep_only_kind(tmp_path):

@@ -225,6 +225,48 @@ def test_freshness_bound_rejects_old_anchor(rpool):
     assert not ok and reason == "stale"
 
 
+def _anchor_ts(res) -> float:
+    return MultiSignature.from_list(
+        list(res[READ_PROOF]["multi_signature"])).value.timestamp
+
+
+def test_freshness_exactly_at_bound_passes(rpool):
+    """The bound is inclusive (`abs(skew) > freshness_s` rejects): an
+    anchor EXACTLY freshness_s old still verifies — the edge tier's
+    stale-while-revalidate window leans on this edge."""
+    q, res, keys = _verified_result(rpool, req_id=130)
+    ts = _anchor_ts(res)
+    ok, reason = verify_read_proof(
+        GET_NYM, q.operation, res, keys, freshness_s=5.0,
+        now=lambda: ts + 5.0)
+    assert ok, reason
+
+
+def test_freshness_just_past_bound_rejects(rpool):
+    q, res, keys = _verified_result(rpool, req_id=131)
+    ts = _anchor_ts(res)
+    ok, reason = verify_read_proof(
+        GET_NYM, q.operation, res, keys, freshness_s=5.0,
+        now=lambda: ts + 5.0001)
+    assert not ok and reason == "stale"
+
+
+def test_freshness_rejects_future_anchor_clock_skew(rpool):
+    """abs() makes the window symmetric: an anchor from the FUTURE
+    (skewed or lying clock) beyond the bound fails exactly like an old
+    one; inside the bound the skew is tolerated."""
+    q, res, keys = _verified_result(rpool, req_id=132)
+    ts = _anchor_ts(res)
+    ok, _ = verify_read_proof(
+        GET_NYM, q.operation, res, keys, freshness_s=5.0,
+        now=lambda: ts - 5.0)
+    assert ok                     # skew inside the bound: tolerated
+    ok, reason = verify_read_proof(
+        GET_NYM, q.operation, res, keys, freshness_s=5.0,
+        now=lambda: ts - 5.0001)
+    assert not ok and reason == "stale"
+
+
 # --- cache + invalidation -------------------------------------------------
 
 def test_result_cache_hits_and_commit_invalidation():

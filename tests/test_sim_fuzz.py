@@ -1394,6 +1394,141 @@ def test_sim_lying_reader_stale_replay():
     assert driver.stats.verify_failures >= 1
 
 
+def run_lying_edge_scenario(seed: int, force_rung=None) -> None:
+    """The `lying_edge` fuzz kind: the Proof CDN's trust claim
+    (reads/edge.py — deny-but-never-forge) under seeded attack. A
+    malicious KEYLESS edge cache serves poisoned cached envelopes,
+    strips proofs, or refuses outright; the verifying client must
+    convert every forgery into a rejected reply + ladder failover and
+    every denial into escalation — the read always completes with the
+    true value, within the ladder deadline, with ZERO forged
+    acceptances across all seeds. Rungs:
+
+    * ``forge_value``: a state-proof entry's value bytes are reversed
+      inside the cached envelope;
+    * ``forge_root``: the envelope cites a root the pool never signed,
+      with the result digest rebound by a smart liar;
+    * ``tamper_data``: the result data is swapped and the digest
+      rebound — only proof verification stands;
+    * ``strip``: the proof is removed -> NO_PROOF escalation (a deeper
+      rung can still prove);
+    * ``deny``: the edge refuses -> NACK, one timed-out rung.
+    """
+    import copy
+
+    from plenum_tpu.execution.txn import GET_NYM
+    from plenum_tpu.reads import READ_PROOF, result_digest
+    from test_edge import attach_edge, make_edge_driver
+
+    rng = SimRandom(seed * 9311 + 7)
+    pool = _track(Pool(seed=seed, config=Config(**FAST)))
+    edge = attach_edge(pool, name="liar-edge")
+    user = Ed25519Signer(seed=(b"eliar%d" % seed).ljust(32, b"\0")[:32])
+    assert _order_and_time(pool, signed_nym(pool.trustee, user, 1), 2) \
+        is not None
+
+    rejected: list = []
+    driver = make_edge_driver(pool, edge, client="efuzz",
+                              on_fail=rejected.append)
+    # warm the cache HONESTLY first: the attack then mutates cached
+    # bytes (a poisoned entry), not a mere forwarding proxy
+    q0 = Request("efuzz", 50, {"type": GET_NYM, "dest": user.identifier})
+    warm = driver.read(q0, per_node_s=2.0)
+    assert warm is not None and driver.stats.edge_ok == 1, f"seed {seed}"
+
+    def forge_value(result):
+        env = result.get(READ_PROOF)
+        if env and env.get("entries"):
+            e = env["entries"][0]
+            if e.get("value"):
+                e["value"] = bytes(
+                    reversed(bytes.fromhex(e["value"]))).hex()
+        return result
+
+    def forge_root(result):
+        env = result.get(READ_PROOF)
+        if env and env.get("root_hash"):
+            env["root_hash"] = "ab" * 32
+            env["result_digest"] = result_digest(result).hex()
+        return result
+
+    def tamper_data(result):
+        if isinstance(result.get("data"), dict):
+            result["data"] = dict(result["data"], verkey="EvilVerkey1111")
+            env = result.get(READ_PROOF)
+            if env:
+                env["result_digest"] = result_digest(result).hex()
+        return result
+
+    def strip(result):
+        result.pop(READ_PROOF, None)
+        return result
+
+    def deny(result):
+        return None
+
+    kinds = [("forge_value", forge_value), ("forge_root", forge_root),
+             ("tamper_data", tamper_data), ("strip", strip),
+             ("deny", deny)]
+    kind, mutate = kinds[force_rung if force_rung is not None
+                         else rng.integer(0, 4)]
+
+    real_serve = edge.cache.serve
+
+    def lying(request):
+        res = real_serve(request)
+        return mutate(copy.deepcopy(res)) if isinstance(res, dict) else res
+
+    edge.cache.serve = lying
+
+    q = Request("efuzz", 51, {"type": GET_NYM, "dest": user.identifier})
+    t0 = pool.timer.get_current_time()
+    res = driver.read(q, per_node_s=2.0)
+    took = pool.timer.get_current_time() - t0
+    deadline = 2.0 * (len(pool.names) + 1) + 1.0
+    assert took <= deadline, \
+        f"seed {seed}: {kind} read took {took:.1f}s > {deadline:.1f}s"
+    s = driver.stats
+    # the ONE invariant every rung shares: the lying edge never forges
+    # an acceptance and never kills the read — a validator answers
+    assert res is not None, f"seed {seed}: {kind} denied service for good"
+    assert res["data"]["verkey"] == user.verkey_b58, \
+        f"seed {seed}: {kind} FORGED an accepted read"
+    assert s.edge_ok == 1 and s.fallbacks == 0, \
+        f"seed {seed}: {kind} ({s.summary()})"
+    if kind in ("forge_value", "forge_root", "tamper_data"):
+        assert s.edge_verify_failures >= 1 and s.failovers >= 1, \
+            f"seed {seed}: {kind} not rejected ({s.summary()})"
+        assert rejected == [edge.name], f"seed {seed}"  # fleet was told
+    elif kind == "strip":
+        assert s.edge_escalations >= 1 and s.failovers >= 1, \
+            f"seed {seed}: strip did not escalate ({s.summary()})"
+        assert s.edge_verify_failures == 0, f"seed {seed}"
+    else:                                   # deny
+        assert s.timeouts >= 1 and s.failovers >= 1, \
+            f"seed {seed}: deny did not fail over ({s.summary()})"
+        assert s.edge_verify_failures == 0, f"seed {seed}"
+    assert_safety(pool)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bucket", range(4))
+def test_sim_lying_edge_fuzz(bucket):
+    for seed in range(bucket * 5, (bucket + 1) * 5):
+        _run_with_artifacts(run_lying_edge_scenario, seed)
+
+
+def test_sim_lying_edge_smoke():
+    """Two edge rungs always run in the default suite: the poisoned
+    cached entry (forgery -> rejected + failover) and the denial rung
+    (NACK -> timed-out rung + failover) — deny-but-never-forge in
+    tier-1."""
+    _run_with_artifacts(
+        lambda s: run_lying_edge_scenario(s, force_rung=2), 2)
+    _run_with_artifacts(
+        lambda s: run_lying_edge_scenario(s, force_rung=4), 3)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("bucket", range(4))
 def test_sim_device_flap_fuzz(bucket):
