@@ -279,6 +279,21 @@ class MetricsName:
     GC_GEN2_COLLECTIONS = "process.gc_gen2_collections"
     GC_UNCOLLECTABLE = "process.gc_uncollectable"
     GC_PAUSE_TIME = "process.gc_pause_time"
+    # resource footprint (observability/history.py): size-now gauges for
+    # every bounded structure a long soak must prove bounded — one name
+    # per gauge so the fleet aggregator can fit per-gauge growth trends
+    # and raise anomaly.alert.unbounded_growth naming the culprit
+    FOOTPRINT_KV_ENTRIES = "footprint.kv_entries"
+    FOOTPRINT_KV_DISK_BYTES = "footprint.kv_disk_bytes"
+    FOOTPRINT_FLIGHT_RING = "footprint.flight_ring_entries"
+    FOOTPRINT_STASHED = "footprint.stashed_entries"
+    FOOTPRINT_REQUEST_STATE = "footprint.request_state_entries"
+    FOOTPRINT_DEDUP_MAP = "footprint.dedup_map_entries"
+    FOOTPRINT_READ_CACHE = "footprint.read_cache_entries"
+    FOOTPRINT_VC_VOTES = "footprint.vc_vote_entries"
+    FOOTPRINT_BLS_SIGS = "footprint.bls_sig_entries"
+    FOOTPRINT_BLS_VERDICT_CACHE = "footprint.bls_verdict_cache_entries"
+    FOOTPRINT_EDGE_CACHE = "footprint.edge_cache_entries"
 
 
 class _GcPauseTimer:
@@ -326,6 +341,19 @@ def tune_gc_for_server() -> None:
     gc.set_threshold(g0, g1, max(g2, 100))
 
 
+def process_rss_bytes() -> Optional[int]:
+    """Resident-set size of this process in bytes, or None on a
+    non-procfs platform. The footprint telemetry source and the process
+    gauges below share this one read."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        import resource
+        return rss_pages * resource.getpagesize()
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 def sample_process_gauges(collector: "MetricsCollector") -> None:
     """One cheap sample of RSS + GC health, recorded as ordinary metric
     events so they ride the same flush cadence and KV history as
@@ -336,14 +364,9 @@ def sample_process_gauges(collector: "MetricsCollector") -> None:
     if _gc_pause_timer is None:
         _gc_pause_timer = _GcPauseTimer()
         gc.callbacks.append(_gc_pause_timer)
-    try:
-        with open("/proc/self/statm") as f:
-            rss_pages = int(f.read().split()[1])
-        import resource
-        collector.add_event(MetricsName.PROCESS_RSS_BYTES,
-                            rss_pages * resource.getpagesize())
-    except (OSError, ValueError, IndexError):
-        pass                                   # non-procfs platform
+    rss = process_rss_bytes()
+    if rss is not None:
+        collector.add_event(MetricsName.PROCESS_RSS_BYTES, rss)
     # a real leak signal: long-lived objects live in gen2, and its count
     # only grows if the heap does (gc.get_count() is collection counters,
     # bounded by the thresholds — useless for soak-leak detection). The

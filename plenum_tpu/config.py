@@ -219,6 +219,23 @@ class Config:
     # flagged — the exact signal live split/merge will consume
     HEALTH_ALERT_FLOOR: float = 0.5
     SHARD_IMBALANCE_THRESHOLD: float = 1.5
+    # --- fleet history plane (observability/history.py) ---
+    # the aggregator appends one compact fleet row per pool interval to
+    # a HistoryRecorder ring; rows rotate over this many on-disk slots
+    # (tmp+rename, the telemetry-spool discipline) so a sim-time week
+    # costs bounded disk and a console can query a downsampled window
+    HISTORY_MAX_SLOTS: int = 512
+    # growth-rate trending over the resource-footprint gauges: a
+    # windowed least-squares fit per gauge; "growing" means projected
+    # growth over one window exceeds max(FLOOR, FRACTION * mean level),
+    # and only after SUSTAIN consecutive growing pool intervals does the
+    # edge-triggered anomaly.alert.unbounded_growth page (one blip of a
+    # breathing cache must not)
+    HISTORY_GROWTH_WINDOW: float = 120.0
+    HISTORY_GROWTH_MIN_POINTS: int = 8
+    HISTORY_GROWTH_FLOOR: float = 64.0
+    HISTORY_GROWTH_FRACTION: float = 0.5
+    HISTORY_GROWTH_SUSTAIN: int = 3
 
     # --- elastic resharding (shards/reshard.py) ---
     # After the mapping epoch ratchets, the OLD owner keeps forwarding

@@ -16,6 +16,7 @@ path would (ack/nack/reject/suspicion).
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Optional
 
@@ -59,6 +60,21 @@ from plenum_tpu.execution.write_manager import ThreePcBatch
 from plenum_tpu.common.metrics import (KvMetricsCollector, MetricsCollector,
                                        MetricsName)
 from plenum_tpu.common import tracing
+
+# footprint gauge key (Node.footprint()) -> flushed MetricsName; the
+# schema's "footprint" section and tools/metrics_lint.py cover each name
+_FOOTPRINT_METRIC_NAMES = {
+    "kv_entries": MetricsName.FOOTPRINT_KV_ENTRIES,
+    "kv_disk_bytes": MetricsName.FOOTPRINT_KV_DISK_BYTES,
+    "flight_ring_entries": MetricsName.FOOTPRINT_FLIGHT_RING,
+    "stashed_entries": MetricsName.FOOTPRINT_STASHED,
+    "request_state_entries": MetricsName.FOOTPRINT_REQUEST_STATE,
+    "dedup_map_entries": MetricsName.FOOTPRINT_DEDUP_MAP,
+    "read_cache_entries": MetricsName.FOOTPRINT_READ_CACHE,
+    "vc_vote_entries": MetricsName.FOOTPRINT_VC_VOTES,
+    "bls_sig_entries": MetricsName.FOOTPRINT_BLS_SIGS,
+    "bls_verdict_cache_entries": MetricsName.FOOTPRINT_BLS_VERDICT_CACHE,
+}
 from plenum_tpu.node.blacklister import Blacklister
 from plenum_tpu.node.bootstrap import NodeComponents
 from plenum_tpu.node.message_req_processor import MessageReqProcessor
@@ -491,6 +507,8 @@ class Node:
             self._telemetry_deltas = CumulativeDelta()
             self.telemetry.add_source("node", self._telemetry_node_state)
             self.telemetry.add_source("crypto", self._telemetry_crypto_state)
+            self.telemetry.add_source("footprint",
+                                      self._telemetry_footprint_state)
             if self.c.pipeline is not None:
                 self.telemetry.add_source(
                     "pipeline", self._telemetry_pipeline_state)
@@ -726,6 +744,84 @@ class Node:
                 if d.get("breaker") not in ("closed", "none"))
         return out
 
+    def footprint(self) -> dict:
+        """Size-now of every bounded in-memory/on-disk structure — the
+        resource-footprint gauges the fleet history plane fits growth
+        trends over (observability/history.py), and the ONE inventory
+        the soaks assert bounded growth through. Every value is an
+        integer size, deterministic given the same ordered stream —
+        except the two wall/host-derived gauges the telemetry source
+        strips under ``wall_sums=False``."""
+        out = {"kv_entries": 0, "kv_disk_bytes": 0}
+        for kv in self.c.db.iter_kv_stores():
+            try:
+                size = kv.size
+                out["kv_entries"] += int(size() if callable(size) else size)
+            except Exception:
+                pass
+            path = getattr(kv, "_file_path", None)
+            if path:
+                try:
+                    out["kv_disk_bytes"] += os.path.getsize(path)
+                except OSError:
+                    pass
+        out["flight_ring_entries"] = (
+            len(self.tracer.ring) if self.tracer.enabled else 0)
+        stashed = 0
+        for replica in self.replicas:
+            for svc in (replica.ordering, replica.checkpointer,
+                        replica.view_changer):
+                stasher = getattr(svc, "_stasher", None)
+                if stasher is not None:
+                    stashed += sum(len(q) for q in stasher._queues.values())
+                    stashed += len(stasher.discarded)
+        out["stashed_entries"] = stashed
+        out["request_state_entries"] = len(self.propagator.requests)
+        out["dedup_map_entries"] = len(self._seen_propagates)
+        out["read_cache_entries"] = sum(
+            len(s) for s in self.read_plane._cache.values())
+        vcs = self.master_replica.view_changer
+        votes = sum(len(d) for d in vcs._view_changes.values())
+        trigger = self.master_replica.vc_trigger
+        if trigger is not None:
+            votes += sum(len(d) for d in trigger._votes.values())
+        out["vc_vote_entries"] = votes
+        bls = self.master_replica.bls
+        out["bls_sig_entries"] = (
+            len(bls._sigs) + len(bls._pending_order)
+            if bls is not None else 0)
+        # process-wide verdict cache: real size, but NOT per-run
+        # deterministic (shared across every node in the process)
+        from plenum_tpu.crypto.bls import _BLS_VERDICTS
+        out["bls_verdict_cache_entries"] = len(_BLS_VERDICTS)
+        return out
+
+    def _telemetry_footprint_state(self) -> dict:
+        """Footprint gauges for the snapshot's state section. Under
+        ``wall_sums=False`` (record/replay comparisons) the host- and
+        process-derived gauges are stripped — RSS reads the HOST and the
+        BLS verdict cache is process-wide across nodes — so the replayed
+        stream stays byte-identical; everything left derives from the
+        ordered stream alone."""
+        out = self.footprint()
+        if getattr(self.telemetry, "wall_sums", True):
+            from plenum_tpu.common.metrics import process_rss_bytes
+            rss = process_rss_bytes()
+            if rss is not None:
+                out["process_rss_bytes"] = rss
+        else:
+            out.pop("bls_verdict_cache_entries", None)
+        return out
+
+    def _sample_footprint_gauges(self) -> None:
+        """Footprint sizes as ordinary metric events at flush cadence, so
+        the on-disk metrics history carries the same growth story the
+        live telemetry plane trends (footprint.* names, lint-covered)."""
+        fp = self.footprint()
+        for key, name in _FOOTPRINT_METRIC_NAMES.items():
+            if key in fp:
+                self.metrics.add_event(name, fp[key])
+
     def attach_fleet_aggregator(self, aggregator) -> None:
         """Route inbound TELEMETRY snapshots (and this node's own) into
         `aggregator` — the seam fleet_console/tests/fabrics use to host
@@ -765,6 +861,7 @@ class Node:
             sample_process_gauges(self.metrics)
             self._sample_queue_gauges()
             self._sample_crypto_gauges()
+            self._sample_footprint_gauges()
             self.metrics.flush()
         finally:
             self._in_metrics_flush = False

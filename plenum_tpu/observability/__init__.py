@@ -19,7 +19,12 @@ package turns the node-local counters into a LIVE, pool-wide signal:
   ring;
 - ``correlate.py`` — cross-node anomaly correlation: flight-recorder
   anomalies from every node stitched onto one aligned clock (reusing
-  trace_report's alignment) into pool-wide incident timelines.
+  trace_report's alignment) into pool-wide incident timelines, with
+  autopilot control-ledger decisions and history-ring context merged in;
+- ``history.py``   — the fleet history plane: a bounded on-disk
+  :class:`HistoryRecorder` ring of per-interval fleet rows and the
+  :class:`GrowthWatch` resource-footprint trend fit behind the
+  ``unbounded_growth`` alert.
 
 Disabled (``TELEMETRY: false``) the whole plane collapses to the shared
 :data:`NULL_TELEMETRY` — one attribute check per call site, no timer
@@ -28,10 +33,13 @@ registered — pinned by a microbenchmark assertion like ``NullTracer``.
 from .snapshot import (NULL_TELEMETRY, CumulativeDelta, NullTelemetry,
                        SNAPSHOT_SCHEMA, TelemetryEmitter, make_telemetry,
                        snapshot_bytes)
+from .history import (GROWTH_EXEMPT_GAUGES, GrowthWatch, HistoryRecorder,
+                      linear_slope)
 from .aggregator import Alert, BurnRateTracker, FleetAggregator
 from .correlate import incident_timelines
 
 __all__ = ["NULL_TELEMETRY", "CumulativeDelta", "NullTelemetry",
            "SNAPSHOT_SCHEMA", "TelemetryEmitter", "make_telemetry",
            "snapshot_bytes", "Alert", "BurnRateTracker", "FleetAggregator",
-           "incident_timelines"]
+           "incident_timelines", "GROWTH_EXEMPT_GAUGES", "GrowthWatch",
+           "HistoryRecorder", "linear_slope"]

@@ -32,6 +32,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from plenum_tpu.common.metrics import MetricsName
+from plenum_tpu.observability.history import (GROWTH_EXEMPT_GAUGES,
+                                              GrowthWatch)
 
 
 @dataclass
@@ -203,6 +205,49 @@ class FleetAggregator:
         # renders; None = no edge fleet attached
         self.edge: Optional[dict] = None
         self._edge_hist: dict[str, deque] = {}
+        # fleet history plane (observability/history.py): when a
+        # HistoryRecorder is attached, one compact fleet row per pool
+        # interval lands in its bounded ring; the growth watch trends
+        # every resource-footprint gauge and raises the edge-triggered
+        # unbounded_growth alert after HISTORY_GROWTH_SUSTAIN growing
+        # intervals — the one bounded-growth primitive the soaks assert
+        self.history = None
+        self._growth = GrowthWatch(
+            window=getattr(config, "HISTORY_GROWTH_WINDOW", 120.0),
+            min_points=getattr(config, "HISTORY_GROWTH_MIN_POINTS", 8),
+            floor=getattr(config, "HISTORY_GROWTH_FLOOR", 64.0),
+            fraction=getattr(config, "HISTORY_GROWTH_FRACTION", 0.5),
+            floors={
+                # RSS is in bytes: its jitter floor is megabytes
+                "process_rss_bytes": 64 << 20,
+                # Gauges bounded BY CONSTRUCTION (capped rings/LRUs,
+                # GC'd maps, TTL-swept tables) get their floor set AT
+                # the design cap: below it, growth is the structure
+                # filling its budget (a cold flight ring fills linearly
+                # for minutes; BLS sig maps climb until the first
+                # stable checkpoint GC — those trends are design, not
+                # leaks); past it, the bound itself is broken and the
+                # trend pages. The soaks' hard caps police the same
+                # budgets instantaneously.
+                "flight_ring_entries":
+                    float(getattr(config, "TRACE_RING_SIZE", 4096)) + 1,
+                "read_cache_entries": 4 * 4096 + 1,
+                "bls_verdict_cache_entries": 16384 + 1,
+                "stashed_entries": 8 * 1000 + 1,
+                "request_state_entries": 5000 + 1,
+                "dedup_map_entries": 5000 + 1,
+                # per-validator-scaled caps use a generous 8-node bound
+                "vc_vote_entries": (4 + 130) * 8 + 1,
+                "bls_sig_entries":
+                    2 * getattr(config, "CHK_FREQ", 100) * 8 + 1,
+            })
+        self._growth_sustain = getattr(config, "HISTORY_GROWTH_SUSTAIN", 3)
+
+    def attach_history(self, recorder) -> None:
+        """Record one fleet row per pool interval into `recorder` (a
+        history.HistoryRecorder) — the console's TREND source and the
+        post-mortem record correlate.py reads context from."""
+        self.history = recorder
 
     # --- intake -----------------------------------------------------------
 
@@ -402,7 +447,8 @@ class FleetAggregator:
 
     def note_edge(self, region: str, hits: int, served: int,
                   edges: int = 0, bytes_served: int = 0,
-                  now: Optional[float] = None) -> None:
+                  now: Optional[float] = None,
+                  cache_entries: Optional[int] = None) -> None:
         """One edge-tier window for `region` (EdgeFleet._roll_window):
         DELTAS, not lifetime totals. Feeds the windowed hit-rate fold
         `edge_hit_rate` (the autopilot's absorbed-capacity signal) and
@@ -421,8 +467,12 @@ class FleetAggregator:
         rate = self.edge_hit_rate(region)
         if rate is not None:
             row["hit_rate"] = round(rate, 4)
+        if cache_entries is not None:
+            row["cache_entries"] = int(cache_entries)
         ed["served"] = sum(r["served"] for r in regions.values())
         ed["bytes"] = sum(r["bytes"] for r in regions.values())
+        ed["cache_entries"] = sum(r.get("cache_entries", 0)
+                                  for r in regions.values())
         self.edge = ed
 
     def edge_hit_rate(self, region: str) -> Optional[float]:
@@ -515,6 +565,31 @@ class FleetAggregator:
                 out.pop(sid, None)
         return out
 
+    def _footprint(self) -> dict[str, float]:
+        """Fleet-wide resource footprint: per-gauge MAX across each
+        node's latest `state.footprint` section (the worst node is the
+        leak candidate; a sum would double-count the replicated state),
+        plus the edge tier's total cache entries when one is attached."""
+        out: dict[str, float] = {}
+        for snap in self.latest.values():
+            fp = snap.get("state", {}).get("footprint") or {}
+            for gauge, value in fp.items():
+                try:
+                    v = float(value)
+                except (TypeError, ValueError):
+                    continue
+                out[gauge] = max(out.get(gauge, 0.0), v)
+        ed = self.edge if isinstance(self.edge, dict) else None
+        if ed and ed.get("cache_entries") is not None:
+            out["edge_cache_entries"] = float(ed["cache_entries"])
+        return out
+
+    def growth_verdicts(self) -> dict[str, dict]:
+        """gauge -> growth verdict (history.GrowthWatch.verdict) over
+        every footprint gauge seen so far — the soaks' single
+        bounded-growth assertion surface."""
+        return self._growth.verdicts(now=self.now)
+
     def staleness(self) -> dict[str, float]:
         """node (or region, with a region_of map) -> newest anchor age."""
         out: dict[str, float] = {}
@@ -602,6 +677,86 @@ class FleetAggregator:
                                 tracker.alerting(t))
         for lane, open_ in self.lane_breakers().items():
             self._note_judgment(("pipeline.lane", str(lane)), open_)
+        # growth trends over the resource-footprint gauges: note one
+        # sample per gauge per pool interval, judge the windowed fit,
+        # and page (edge-triggered, latched) only after the growth has
+        # SUSTAINED — a cache filling its working set must not alarm.
+        # Ledger-backed gauges (GROWTH_EXEMPT_GAUGES) are trended for
+        # the console but never judged: a chain grows by design.
+        fp = self._footprint()
+        for gauge, value in sorted(fp.items()):
+            self._growth.note(gauge, t, value)
+        for gauge, v in self._growth.verdicts(now=t).items():
+            growing = (v.get("verdict") == "growing"
+                       and gauge not in GROWTH_EXEMPT_GAUGES)
+            key = ("unbounded_growth", gauge)
+            self._note_judgment(key, growing)
+            self._raise(key,
+                        self._streaks.get(key, 0) >= self._growth_sustain,
+                        t, {"gauge": gauge, **v})
+        if self.history is not None:
+            self.history.append(self._history_row(t, fp, rates, index, hot))
+
+    def _history_row(self, t: float, fp: dict, rates: dict,
+                     index, hot) -> dict:
+        """One compact fleet row for the history ring. Every field
+        derives from ingested snapshots and the fleet clock — replaying
+        the same stream reproduces the ring byte-for-byte (sampled
+        percentiles only appear when the emitters ran wall_sums=True)."""
+        row: dict = {"t": round(t, 6), "nodes": len(self.latest)}
+        healths = [h for h in (self.node_health(n) for n in self.latest)
+                   if h is not None]
+        if healths:
+            row["health_min"] = round(min(healths), 3)
+            row["health_mean"] = round(sum(healths) / len(healths), 3)
+        row["tps"] = round(sum(rates.values()) if rates
+                           else self._pool_rate(), 2)
+        if index is not None:
+            row["imbalance"] = index
+        if hot is not None:
+            row["hot_shard"] = hot
+        if self.burn:
+            summaries = [tr.summary(t) for tr in self.burn.values()]
+            row["burn_fast"] = max(s["fast"] for s in summaries)
+            row["burn_slow"] = max(s["slow"] for s in summaries)
+        row["alerts"] = len(self.active_alerts())
+        if isinstance(self.autopilot, dict):
+            ap = {k: self.autopilot[k]
+                  for k in ("state", "actions", "reverts", "holds")
+                  if k in self.autopilot}
+            if ap:
+                row["autopilot"] = ap
+        p95 = None
+        for snap in self.latest.values():
+            s = snap.get("sampled", {}).get(MetricsName.ORDERING_TIME)
+            if s:
+                p95 = max(p95 or 0.0, float(s[1]))
+        if p95 is not None:
+            row["ordering_p95"] = round(p95, 6)
+        if fp:
+            row["footprint"] = {k: round(v, 2)
+                                for k, v in sorted(fp.items())}
+        return row
+
+    def _pool_rate(self) -> float:
+        """Ordered txns/s for an UNSHARDED pool: the per-shard fold in
+        ordered_rates skips nodes without a shard tag, so the history
+        row's TPS needs its own max-across-nodes window (all nodes
+        order the same replicated stream)."""
+        t_end = self.now
+        best = 0.0
+        for hist in self._ordered.values():
+            first = last = None
+            for (ts, n) in reversed(hist):
+                if ts < t_end - self.window:
+                    break
+                first = (ts, n)
+                if last is None:
+                    last = (ts, n)
+            if first is not None and t_end > first[0]:
+                best = max(best, (last[1] - first[1])
+                           / (t_end - first[0]))
+        return best
 
     def active_alerts(self) -> list[Alert]:
         return [a for a in self._latched.values() if a is not None]
@@ -640,4 +795,12 @@ class FleetAggregator:
             "burn": burn,
             "alerts": [a.to_dict() for a in self.alerts[-50:]],
             "active_alerts": [a.to_dict() for a in self.active_alerts()],
+            **({"footprint": {k: round(v, 2)
+                              for k, v in sorted(fp.items())}}
+               if (fp := self._footprint()) else {}),
+            **({"growth": growth}
+               if (growth := {g: v for g, v in
+                              self.growth_verdicts().items()
+                              if v.get("verdict") != "insufficient"})
+               else {}),
         }

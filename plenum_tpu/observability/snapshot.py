@@ -171,6 +171,25 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
         MetricsName.AUTOPILOT_DECISIONS, MetricsName.AUTOPILOT_ACTIONS,
         MetricsName.AUTOPILOT_REVERTS, MetricsName.AUTOPILOT_HOLDS,
     }),
+    # resource footprint: size-now gauges for every bounded structure —
+    # the raw series observability/history.py fits growth trends over.
+    # PROCESS_RSS_BYTES graduates out of EXEMPT here: a host gauge is a
+    # poor fleet AGGREGATE but a fine fleet TREND (any node's RSS curve
+    # bending up is a fleet problem).
+    "footprint": frozenset({
+        MetricsName.FOOTPRINT_KV_ENTRIES,
+        MetricsName.FOOTPRINT_KV_DISK_BYTES,
+        MetricsName.FOOTPRINT_FLIGHT_RING,
+        MetricsName.FOOTPRINT_STASHED,
+        MetricsName.FOOTPRINT_REQUEST_STATE,
+        MetricsName.FOOTPRINT_DEDUP_MAP,
+        MetricsName.FOOTPRINT_READ_CACHE,
+        MetricsName.FOOTPRINT_VC_VOTES,
+        MetricsName.FOOTPRINT_BLS_SIGS,
+        MetricsName.FOOTPRINT_BLS_VERDICT_CACHE,
+        MetricsName.FOOTPRINT_EDGE_CACHE,
+        MetricsName.PROCESS_RSS_BYTES,
+    }),
 }
 
 # MetricsNames deliberately OUTSIDE the fleet view, with the reason the
@@ -178,7 +197,6 @@ SNAPSHOT_SCHEMA: dict[str, frozenset] = {
 # meaningless to aggregate across a fleet); transport byte totals are
 # per-link volumes whose fleet story the per-type dynamic rows tell.
 EXEMPT_METRICS: dict[str, str] = {
-    MetricsName.PROCESS_RSS_BYTES: "host gauge, not a fleet signal",
     MetricsName.GC_TRACKED_OBJECTS: "host gauge, not a fleet signal",
     MetricsName.GC_GEN2_COLLECTIONS: "host gauge, not a fleet signal",
     MetricsName.GC_UNCOLLECTABLE: "host gauge, not a fleet signal",

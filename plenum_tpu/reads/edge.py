@@ -500,13 +500,14 @@ class EdgeFleet:
         self._roll_window()
 
     def _fold(self, region: str) -> tuple:
-        hits = queries = nbytes = 0
+        hits = queries = nbytes = entries = 0
         for edge in self.regions[region]:
             s = edge.cache.stats
             hits += s["hits"]
             queries += s["queries"]
             nbytes += s["bytes_served"]
-        return hits, queries, nbytes
+            entries += len(edge.cache)
+        return hits, queries, nbytes, entries
 
     def _roll_window(self) -> None:
         now = self.fabric.timer.get_current_time()
@@ -516,13 +517,14 @@ class EdgeFleet:
         agg = self.fabric.aggregator
         note = getattr(agg, "note_edge", None)
         for region in self.regions:
-            hits, queries, nbytes = self._fold(region)
+            hits, queries, nbytes, entries = self._fold(region)
             lh, lq, lb = self._last_fold[region]
             self._last_fold[region] = (hits, queries, nbytes)
             if queries - lq and callable(note):
                 note(region, hits - lh, queries - lq,
                      edges=len(self.regions[region]),
-                     bytes_served=nbytes - lb, now=now)
+                     bytes_served=nbytes - lb, now=now,
+                     cache_entries=entries)
 
     # --- read serving -------------------------------------------------------
 
@@ -543,10 +545,10 @@ class EdgeFleet:
     def summary(self) -> dict:
         per_region = {}
         for r in sorted(self.regions):
-            hits, queries, nbytes = self._fold(r)
+            hits, queries, nbytes, entries = self._fold(r)
             per_region[r] = {
                 "edges": len(self.regions[r]), "queries": queries,
-                "hits": hits, "bytes": nbytes,
+                "hits": hits, "bytes": nbytes, "cache_entries": entries,
                 "hit_rate": round(hits / queries, 4) if queries else None}
         origin = sum(e.cache.stats["origin_fetches"]
                      for g in self.regions.values() for e in g)

@@ -7,16 +7,31 @@ leak while the WAN is degraded and the membership itself keeps changing —
 demotions, re-promotions, BLS key rotations, primary demotions — for
 minutes on end? Every churn event exercises exactly the structures that
 have historically grown without bound (stashed future-view messages,
-request state, per-view vote sets, verdict caches), so the soak samples
-them between waves and FAILS if any of them trends past its cap:
+request state, per-view vote sets, verdict caches).
+
+The bounded-growth verdicts come from the fleet history plane
+(observability/history.py): every node's TelemetryEmitter ships its
+``footprint`` section into one FleetAggregator, whose GrowthWatch fits
+growth-rate trends per gauge and raises edge-triggered
+``unbounded_growth`` alerts, and whose HistoryRecorder keeps a
+queryable per-interval ring of the whole run. The soak FAILS if any
+growth alert pages (exempt chain-growth gauges aside) — plus a
+hard-cap backstop over the same ``Node.footprint()`` gauges, because a
+leak that plateaus below the trend threshold but above its design cap
+is still a leak:
 
 * flight-recorder rings            (<= TRACE_RING_SIZE per node)
 * metrics accumulators             (bounded name set, samples <= cap)
 * stashing-router queues+discarded (<= router limit / 1000-deque)
-* propagator request state         (TTL-swept)
+* propagator request state / dedup map (TTL-swept)
 * read-plane result cache          (bounded per-ledger shards)
-* view-change / instance-change vote sets (retired per view)
+* view-change + instance-change vote sets (retired per view)
 * BLS sig/pending-order maps       (GC'd at stable checkpoints)
+
+``leak_rate > 0`` injects a synthetic unbounded gauge (``leaky_stash``)
+into one node's footprint source — the self-test that proves the
+detector pages, and pages exactly once (edge-triggered), naming the
+gauge.
 
 Runs on SIMULATED time (MockTimer + SimNetwork under the `lossy_wan`
 topology preset), so "10 minutes" means 10 simulated minutes of timer
@@ -33,83 +48,61 @@ import argparse
 import json
 
 
-def _stash_sizes(node) -> int:
-    """Total stashed messages across every service router on the node."""
-    total = 0
-    for replica in node.replicas:
-        for svc in (replica.ordering, replica.checkpointer,
-                    replica.view_changer):
-            stasher = getattr(svc, "_stasher", None)
-            if stasher is not None:
-                total += sum(len(q) for q in stasher._queues.values())
-                total += len(stasher.discarded)
-    return total
-
-
 def _bounds_snapshot(pool) -> dict:
-    """One sample of every bounded-growth structure, max across nodes."""
-    out = {"flight_ring": 0, "metrics_accs": 0, "metrics_samples_max": 0,
-           "stashed": 0, "request_state": 0, "seen_propagates": 0,
-           "read_cache": 0, "vc_votes": 0, "ic_votes": 0, "bls_sigs": 0}
+    """One sample of every bounded-growth structure, max across nodes.
+
+    The per-structure walk lives in ``Node.footprint()`` now — the same
+    gauges the telemetry footprint section ships — so the soak, the
+    emitter, and the aggregator's growth trends all read ONE
+    accounting. Only the metrics-collector internals (not footprint
+    gauges: they meter the meter) stay hand-sampled here.
+    """
+    out = {"metrics_accs": 0, "metrics_samples_max": 0}
     for node in pool.nodes.values():
-        snap = node.tracer.snapshot() if node.tracer.enabled else None
-        if snap is not None:
-            out["flight_ring"] = max(out["flight_ring"],
-                                     len(snap["events"]))
+        for gauge, value in node.footprint().items():
+            out[gauge] = max(out.get(gauge, 0), value)
         accs = node.metrics.accumulators
         out["metrics_accs"] = max(out["metrics_accs"], len(accs))
         out["metrics_samples_max"] = max(
             out["metrics_samples_max"],
             max((len(a.samples or ()) for a in accs.values()), default=0))
-        out["stashed"] = max(out["stashed"], _stash_sizes(node))
-        out["request_state"] = max(out["request_state"],
-                                   len(node.propagator.requests))
-        out["seen_propagates"] = max(out["seen_propagates"],
-                                     len(node._seen_propagates))
-        out["read_cache"] = max(
-            out["read_cache"],
-            sum(len(s) for s in node.read_plane._cache.values()))
-        vcs = node.master_replica.view_changer
-        out["vc_votes"] = max(
-            out["vc_votes"],
-            sum(len(d) for d in vcs._view_changes.values()))
-        trigger = node.master_replica.vc_trigger
-        if trigger is not None:
-            out["ic_votes"] = max(
-                out["ic_votes"],
-                sum(len(d) for d in trigger._votes.values()))
-        bls = node.master_replica.bls
-        if bls is not None:
-            out["bls_sigs"] = max(
-                out["bls_sigs"],
-                len(bls._sigs) + len(bls._pending_order))
     return out
 
 
 def _check_bounds(sample: dict, config, n_validators: int) -> list[str]:
-    """-> list of violated-bound descriptions (empty = healthy)."""
+    """-> list of violated-bound descriptions (empty = healthy).
+
+    Hard caps backstop the growth verdicts: kv_* gauges (chain growth
+    by design, GROWTH_EXEMPT) carry no cap.
+    """
     caps = {
-        "flight_ring": config.TRACE_RING_SIZE,
+        "flight_ring_entries": config.TRACE_RING_SIZE,
         "metrics_accs": 256,                 # the MetricsName namespace
         "metrics_samples_max": 256,          # metrics.SAMPLE_CAP
-        "stashed": 8 * 1000,                 # routers' discarded deques +
+        "stashed_entries": 8 * 1000,         # routers' discarded deques +
         #                                      transient stash churn
-        "request_state": 5000,               # TTL-swept under FAST sweeps
-        "seen_propagates": 5000,
-        "read_cache": 4 * 4096,
-        "vc_votes": 4 * n_validators,        # <= a few views in flight
-        "ic_votes": 130 * n_validators,      # MAX_FUTURE_VIEWS rows
-        "bls_sigs": 2 * config.CHK_FREQ * n_validators,
+        "request_state_entries": 5000,       # TTL-swept under FAST sweeps
+        "dedup_map_entries": 5000,
+        "read_cache_entries": 4 * 4096,
+        # view-change votes (a few views in flight) + instance-change
+        # votes (MAX_FUTURE_VIEWS rows) land in ONE combined gauge
+        "vc_vote_entries": (4 + 130) * n_validators,
+        "bls_sig_entries": 2 * config.CHK_FREQ * n_validators,
+        "bls_verdict_cache_entries": 16384,  # bls._BLS_VERDICTS_MAX
     }
     return [f"{k}={sample[k]} > cap {caps[k]}"
-            for k in caps if sample[k] > caps[k]]
+            for k in caps if sample.get(k, 0) > caps[k]]
 
 
 def run_churn_soak(seconds: float = 600.0, seed: int = 11,
-                   wave_s: float = 20.0) -> dict:
+                   wave_s: float = 20.0, leak_rate: float = 0.0) -> dict:
     """Drive a 5-node sim pool (4 validators + 1 churning member) over the
     lossy_wan topology for `seconds` of SIMULATED time: steady writes
-    plus one churn event per wave, bounds sampled between waves."""
+    plus one churn event per wave; the fleet aggregator's growth
+    verdicts + history ring judge bounded growth, with the hard caps as
+    backstop. `leak_rate > 0` adds a synthetic ever-growing
+    ``leaky_stash`` gauge (entries per telemetry tick) to Alpha's
+    footprint — the detector self-test."""
     import sys
     sys.path.insert(0, _tests_dir())
     from test_pool import Pool, signed_nym                  # noqa: E402
@@ -122,6 +115,8 @@ def run_churn_soak(seconds: float = 600.0, seed: int = 11,
     from plenum_tpu.common.request import Request
     from plenum_tpu.execution.txn import NODE
     from plenum_tpu.network import make_topology
+    from plenum_tpu.observability import (GROWTH_EXEMPT_GAUGES,
+                                          FleetAggregator, HistoryRecorder)
 
     names = ["Alpha", "Beta", "Gamma", "Delta", "Eps"]
     config = Config(Max3PCBatchWait=0.05,
@@ -134,6 +129,28 @@ def run_churn_soak(seconds: float = 600.0, seed: int = 11,
                     PROPAGATE_BODYLESS_REQ_TIMEOUT=10.0)
     pool = Pool(names=names, seed=seed, config=config)
     pool.net.set_topology(make_topology("lossy_wan", names))
+
+    # The history plane: every node ships snapshots into one aggregator;
+    # growth trends + the per-interval ring come for free with ingest.
+    agg = FleetAggregator(config=config)
+    agg.attach_history(HistoryRecorder(
+        max_slots=getattr(config, "HISTORY_MAX_SLOTS", 512)))
+    for node in pool.nodes.values():
+        node.telemetry.add_sink(agg.ingest)
+
+    if leak_rate > 0:
+        alpha = pool.nodes["Alpha"]
+        real_footprint = alpha._telemetry_footprint_state
+        ticks = {"n": 0}
+
+        def leaky_footprint() -> dict:
+            out = real_footprint()
+            ticks["n"] += 1
+            out["leaky_stash"] = int(64 + ticks["n"] * leak_rate)
+            return out
+
+        # re-registering under the same source name replaces the real one
+        alpha.telemetry.add_source("footprint", leaky_footprint)
 
     req_id = 0
     rotation_no = 0
@@ -223,14 +240,34 @@ def run_churn_soak(seconds: float = 600.0, seed: int = 11,
              for n in validators if n in pool.nodes}
     converged = len(set(sizes.values())) == 1
 
+    # growth verdicts + alert audit from the history plane
+    verdicts = agg.growth_verdicts()
+    growth_alerts = [a.to_dict() for a in agg.alerts
+                     if a.kind == "unbounded_growth"
+                     and a.severity == "page"]
+    unexpected = [a for a in growth_alerts
+                  if not (leak_rate > 0 and a["subject"] == "leaky_stash")]
+    growing = sorted(g for g, v in verdicts.items()
+                     if v.get("verdict") == "growing"
+                     and g not in GROWTH_EXEMPT_GAUGES
+                     and not (leak_rate > 0 and g == "leaky_stash"))
+    hist = agg.history
+
     first, last = samples[0], samples[-1]
     return {
         "sim_seconds": elapsed, "waves": wave_no, "events": events,
         "txns_submitted": req_id,
         "converged": converged, "ledger_sizes": sizes,
-        "bounds_ok": not violations, "violations": violations,
+        "bounds_ok": not violations and not unexpected and not growing,
+        "violations": violations,
         "bounds_first": first, "bounds_last": last,
-        "bounds_max": {k: max(s[k] for s in samples) for k in first},
+        "bounds_max": {k: max(s.get(k, 0) for s in samples)
+                       for k in last},
+        "growth_verdicts": verdicts,
+        "growth_alerts": growth_alerts,
+        "growth_unexpected": [a["subject"] for a in unexpected] + growing,
+        "history_rows": len(hist.rows), "history_seq": hist.seq,
+        "history_tail": hist.query(max_points=12),
     }
 
 
@@ -249,9 +286,13 @@ def main(argv=None):
     ap.add_argument("--seconds", type=float, default=600.0,
                     help="SIMULATED seconds of churn load")
     ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--leak-rate", type=float, default=0.0,
+                    help="inject a synthetic leak of N entries per "
+                         "telemetry tick (detector self-test)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
-    out = run_churn_soak(args.seconds, seed=args.seed)
+    out = run_churn_soak(args.seconds, seed=args.seed,
+                         leak_rate=args.leak_rate)
     print(json.dumps(out if args.json else out, indent=None
                      if args.json else 2))
     return 0 if (out["bounds_ok"] and out["converged"]) else 1

@@ -60,14 +60,20 @@ def load_flight_dumps(paths) -> list[dict]:
 
 
 def build_view(paths, config=None):
-    """-> (aggregator, incidents) from on-disk artifacts."""
-    from plenum_tpu.observability import (FleetAggregator,
+    """-> (aggregator, incidents) from on-disk artifacts. The console's
+    aggregator carries its own IN-MEMORY history ring (rebuilt from the
+    spool window each refresh — writing slots from a reader would fight
+    the pool's own on-disk ring), so TREND renders without extra I/O."""
+    from plenum_tpu.observability import (FleetAggregator, HistoryRecorder,
                                           incident_timelines)
     agg = FleetAggregator(config=config)
+    agg.attach_history(HistoryRecorder(
+        max_slots=getattr(config, "HISTORY_MAX_SLOTS", 512)))
     for snap in load_spools(paths):
         agg.ingest(snap)
     dumps = load_flight_dumps(paths)
-    incidents = incident_timelines(dumps, alerts=agg.alerts) \
+    incidents = incident_timelines(
+        dumps, alerts=agg.alerts, history=agg.history) \
         if (dumps or agg.alerts) else []
     return agg, incidents
 
@@ -177,6 +183,41 @@ def render(agg, incidents, last_n: int = 5) -> str:
                 f"hit={'-' if rate is None else format(rate, '.0%')}")
         lines.append(f"  EDGE: " + ", ".join(cells)
                      + f"  bytes={ed.get('bytes', 0)}")
+    # fleet history plane: the TREND sparklines come from the attached
+    # history ring's downsampled window; FOOTPRINT is the current
+    # resource-gauge inventory with growing gauges marked — the same
+    # verdicts behind the unbounded_growth alert
+    hist = getattr(agg, "history", None)
+    if hist is not None and getattr(hist, "rows", None):
+        from plenum_tpu.tools.perf_sentinel import sparkline
+        rows = hist.query(max_points=24)
+        tps = [float(r.get("tps", 0.0)) for r in rows]
+        hmin = [float(r["health_min"]) for r in rows
+                if r.get("health_min") is not None]
+        lines.append(
+            f"  TREND: tps {sparkline(tps)} {tps[-1]:.1f}"
+            + (f"  health_min {sparkline(hmin)} {hmin[-1]:.2f}"
+               if hmin else "")
+            + f"  rows={len(hist.rows)}/{hist.seq}")
+    fp = s.get("footprint")
+    if fp:
+        from plenum_tpu.observability import GROWTH_EXEMPT_GAUGES
+        growth = s.get("growth", {})
+        cells = []
+        for gauge in sorted(fp):
+            mark = "↑!" if (gauge not in GROWTH_EXEMPT_GAUGES
+                            and growth.get(gauge, {}).get("verdict")
+                            == "growing") else ""
+            cells.append(f"{gauge}={int(fp[gauge])}{mark}")
+        lines.append("  FOOTPRINT: " + " ".join(cells))
+        growing = sorted(g for g, v in growth.items()
+                         if v.get("verdict") == "growing"
+                         and g not in GROWTH_EXEMPT_GAUGES)
+        if growing:
+            lines.append("  UNBOUNDED GROWTH: " + ", ".join(
+                f"{g} +{growth[g].get('slope_per_s', 0)}/s "
+                f"(projected {growth[g].get('projected')} > "
+                f"{growth[g].get('threshold')})" for g in growing))
     for kind, per_node in s["burn"].items():
         burning = {n: b for n, b in per_node.items()
                    if b["fast"] > 0 or b["slow"] > 0}
@@ -432,6 +473,48 @@ def self_check() -> int:
             render(a, incidents)
     except Exception as e:
         problems.append(f"render failed: {type(e).__name__}: {e}")
+
+    # 7) fleet history plane: bounded footprint gauges stay quiet, an
+    # injected leak raises EXACTLY ONE unbounded_growth page naming the
+    # gauge, ledger-backed gauges never page, the history ring honors
+    # its slot bound, query() downsamples, and the console renders the
+    # TREND/FOOTPRINT rungs off the same ring
+    from plenum_tpu.observability import HistoryRecorder
+    agg7 = FleetAggregator(config=config)
+    agg7.attach_history(HistoryRecorder(max_slots=16))
+    for i in range(60):
+        snap = healthy("N1", i, i * 1.0, ordered=i * 3)
+        snap["state"]["footprint"] = {
+            # breathing inside its working set: bounded
+            "stashed_entries": 120 + (i % 5) * 8,
+            # the injected leak: grows without bound
+            "leaky_stash": 80 + 10 * i,
+            # ledger-backed: grows by design, exempt from paging
+            "kv_entries": 1000 * (i + 1),
+        }
+        agg7.ingest(snap)
+    pages = [a for a in agg7.alerts if a.kind == "unbounded_growth"
+             and a.severity == "page"]
+    if len(pages) != 1 or pages[0].subject != "leaky_stash" \
+            or pages[0].detail.get("gauge") != "leaky_stash":
+        problems.append(
+            f"leak should page exactly once naming leaky_stash: "
+            f"{[a.to_dict() for a in pages]}")
+    if any(a.subject in ("stashed_entries", "kv_entries")
+           for a in agg7.alerts if a.kind == "unbounded_growth"):
+        problems.append("bounded/exempt gauge paged unbounded_growth")
+    if len(agg7.history.rows) > 16 or agg7.history.seq != 60:
+        problems.append(
+            f"history ring unbounded: rows={len(agg7.history.rows)} "
+            f"seq={agg7.history.seq}")
+    down = agg7.history.query(max_points=5)
+    full = agg7.history.window()
+    if len(down) != 5 or down[0] != full[0] or down[-1] != full[-1]:
+        problems.append(f"query downsample wrong: {len(down)} rows")
+    text = render(agg7, [])
+    if "TREND:" not in text or "FOOTPRINT:" not in text \
+            or "leaky_stash" not in text:
+        problems.append("console did not render TREND/FOOTPRINT rungs")
 
     print(json.dumps({"check": "ok" if not problems else "FAIL",
                       "problems": problems}))

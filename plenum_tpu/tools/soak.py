@@ -8,6 +8,13 @@ RSS / gc-tracked objects / gc pause time between waves via the same
 sample_process_gauges the node flushes (common/metrics.py), and prints one
 JSON summary: per-wave TPS + rss trajectory + first/last deltas.
 
+Bounded-growth is judged by the shared history-plane primitive
+(observability/history.py GrowthWatch): the per-wave rss/gc samples
+feed a windowed linear fit per gauge, and ``growth_verdicts`` in the
+summary says bounded / growing / insufficient — the same verdict rule
+the fleet aggregator pages through, instead of a hand-rolled
+first-vs-last delta.
+
     python -m plenum_tpu.tools.soak --seconds 600 [--wave 200]
 """
 from __future__ import annotations
@@ -24,15 +31,31 @@ def run_soak(seconds: float = 600.0, wave: int = 200,
     from plenum_tpu.common.request import Request
     from plenum_tpu.crypto.ed25519 import Ed25519Signer
     from plenum_tpu.execution.txn import NYM
+    from plenum_tpu.observability.history import GrowthWatch
     from plenum_tpu.tools.local_pool import build_pool
 
     (names, nodes, timer, trustee,
      replies, Reply, DOMAIN_LEDGER_ID, plane, net) = build_pool(n_nodes, "cpu")
 
+    # rss/gc-tracked trends judged by the shared growth-verdict rule;
+    # cumulative counters (gc pause, gen2 count) grow by design and are
+    # reported, not judged
+    watch = GrowthWatch(window=max(60.0, seconds), min_points=5,
+                        floors={"rss_mb": 64.0, "gc_tracked": 200_000.0})
+    t_start = time.perf_counter()
+
     def sample() -> dict:
         c = MetricsCollector()
         sample_process_gauges(c)
         s = c.summary()
+        out = _fold_sample(s, MetricsName)
+        t = time.perf_counter() - t_start
+        for gauge in ("rss_mb", "gc_tracked"):
+            if out.get(gauge) is not None:
+                watch.note(gauge, t, out[gauge])
+        return out
+
+    def _fold_sample(s, MetricsName) -> dict:
         return {
             "rss_mb": round(
                 s[MetricsName.PROCESS_RSS_BYTES]["max"] / 2**20, 1)
@@ -101,6 +124,9 @@ def run_soak(seconds: float = 600.0, wave: int = 200,
         "gc_gen2_collections": last["gc_gen2"],
         "ledgers_agree": len(ledger_sizes) == 1,
         "samples": samples[:: max(1, len(samples) // 10)],
+        "growth_verdicts": watch.verdicts(),
+        "growth_ok": not any(v.get("verdict") == "growing"
+                             for v in watch.verdicts().values()),
     }
 
 

@@ -443,12 +443,38 @@ def test_metrics_report_churn_sections():
 
 def test_churn_soak_smoke_bounded_and_converged():
     """Fast tier-1 slice of the 10-minute churn soak: two churn waves
-    over lossy_wan, every bounded-growth cap respected, pool converged."""
+    over lossy_wan, every bounded-growth cap respected, pool converged —
+    and the history plane wired in: growth verdicts on every footprint
+    gauge with zero unbounded_growth alerts, and a queryable,
+    downsampled history ring covering the run."""
     from plenum_tpu.tools.churn_soak import run_churn_soak
     out = run_churn_soak(seconds=40.0, seed=3)
-    assert out["bounds_ok"], out["violations"]
+    assert out["bounds_ok"], (out["violations"], out["growth_unexpected"])
     assert out["converged"], out["ledger_sizes"]
     assert out["waves"] >= 2 and "demote" in out["events"][0]
+    # every footprint gauge got a growth verdict, none alerted
+    for gauge in ("stashed_entries", "flight_ring_entries",
+                  "bls_sig_entries", "kv_entries"):
+        assert gauge in out["growth_verdicts"], out["growth_verdicts"]
+    assert out["growth_alerts"] == []
+    # the ring recorded one row per pool interval, downsampled on query
+    assert out["history_seq"] >= out["waves"]
+    assert 0 < len(out["history_tail"]) <= 12
+    assert out["history_tail"][0]["seq"] < out["history_tail"][-1]["seq"]
+
+
+def test_churn_soak_injected_leak_pages_once_naming_gauge():
+    """The detector self-test: an injected unbounded gauge (leak_rate)
+    raises EXACTLY ONE edge-triggered unbounded_growth page naming the
+    gauge, while every real structure stays quiet."""
+    from plenum_tpu.tools.churn_soak import run_churn_soak
+    out = run_churn_soak(seconds=40.0, seed=3, leak_rate=8.0)
+    assert out["bounds_ok"], (out["violations"], out["growth_unexpected"])
+    pages = out["growth_alerts"]
+    assert len(pages) == 1, pages
+    assert pages[0]["subject"] == "leaky_stash"
+    assert pages[0]["detail"]["gauge"] == "leaky_stash"
+    assert out["growth_verdicts"]["leaky_stash"]["verdict"] == "growing"
 
 
 @pytest.mark.slow
@@ -457,8 +483,17 @@ def test_churn_soak_ten_minutes():
     """The full bounded-growth soak: 10 SIMULATED minutes of sustained
     writes + one churn event per 20 s wave (demote/promote, BLS
     rotation, primary demotion) over lossy_wan. Fails on the first
-    bound violation, so a leak names its structure and its wave."""
+    bound violation or unbounded_growth page, so a leak names its
+    structure and its wave; the history ring must hold a queryable,
+    downsampled record of the whole run."""
     from plenum_tpu.tools.churn_soak import run_churn_soak
     out = run_churn_soak(seconds=600.0, seed=11)
-    assert out["bounds_ok"], out["violations"]
+    assert out["bounds_ok"], (out["violations"], out["growth_unexpected"])
     assert out["converged"], out["ledger_sizes"]
+    assert out["growth_alerts"] == []
+    # 600 sim-seconds at 1 s telemetry intervals: the ring saw the whole
+    # run (seq counts every row) while holding at most HISTORY_MAX_SLOTS
+    assert out["history_seq"] >= 500
+    assert out["history_rows"] <= 512
+    tail = out["history_tail"]
+    assert 0 < len(tail) <= 12 and tail[0]["seq"] < tail[-1]["seq"]
